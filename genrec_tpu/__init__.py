@@ -1,0 +1,20 @@
+"""genrec_tpu — a TPU-native generative-recommendation framework.
+
+A ground-up JAX / XLA / Pallas re-design of the capabilities of the
+phonism/genrec reference (see SURVEY.md): six trainable model families
+(SASRec, HSTU, RQ-VAE, TIGER, LCRec, COBRA, plus NoteLLM), a shared ops
+library, Amazon-Reviews-2014 data pipelines, and gin-configured trainers —
+built TPU-first:
+
+- pure-functional Flax models, params as pytrees, explicit RNG threading
+- one jitted train step per model (grad -> clip -> optax update, microbatch
+  accumulation via lax.scan, bf16 compute)
+- SPMD via jax.sharding.Mesh + NamedSharding; XLA collectives over ICI/DCN
+  replace the reference's NCCL/Accelerate stack
+- decode loops (trie-constrained beam search) compiled on device with
+  dense prefix legality tables instead of host-side Python tries
+- Pallas kernels for the hot ops (HSTU fused attention-bias, residual
+  quantizer distance/assign)
+"""
+
+__version__ = "0.1.0"

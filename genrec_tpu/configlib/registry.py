@@ -1,0 +1,268 @@
+"""Configurable registry: the ``@configurable`` decorator and binding store.
+
+Semantics follow gin: a binding ``target.param = value`` supplies the value
+of ``param`` whenever the configurable ``target`` is called *without* an
+explicit ``param`` argument. Explicit call-site arguments always win.
+"""
+
+from __future__ import annotations
+
+import enum
+import functools
+import inspect
+import threading
+from typing import Any, Callable
+
+_LOCK = threading.RLock()
+
+# name -> wrapped callable. Both the short name ("train", "AmazonItemDataset")
+# and the fully-qualified "module.qualname" are registered.
+_REGISTRY: dict[str, Callable] = {}
+
+# (configurable key, param) -> value. Keyed by the canonical (full) name.
+_BINDINGS: dict[tuple[str, str], Any] = {}
+
+# short name -> canonical name (for binding resolution before/after import).
+_ALIASES: dict[str, str] = {}
+
+# Short names claimed by more than one distinct configurable. Using such a
+# name in a binding or lookup is an error (gin's ambiguity rule); bindings
+# stored under it stop applying.
+_AMBIGUOUS: set[str] = set()
+
+# dotted path -> enum class, for %module.Enum.MEMBER constants.
+_ENUMS: dict[str, type[enum.Enum]] = {}
+
+
+class Ref:
+    """Base for lazily-resolved config values (resolved at injection time)."""
+
+    def resolve(self):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+class ConfigurableRef(Ref):
+    """A ``@Name`` value in a config file: resolves lazily to the callable."""
+
+    def __init__(self, name: str, evaluate: bool = False):
+        # gin scopes ("@scope/Name") are accepted and flattened, matching
+        # the LHS treatment in the parser.
+        self.name = name.rsplit("/", 1)[-1]
+        self.evaluate = evaluate
+
+    def resolve(self):
+        fn = lookup(self.name)
+        if fn is None:
+            raise KeyError(f"@{self.name} does not name a registered configurable")
+        return fn() if self.evaluate else fn
+
+    def __repr__(self):
+        return f"ConfigurableRef(@{self.name}{'()' if self.evaluate else ''})"
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, ConfigurableRef)
+            and other.name == self.name
+            and other.evaluate == self.evaluate
+        )
+
+    def __hash__(self):
+        return hash((self.name, self.evaluate))
+
+
+def _canonical(fn: Callable, name: str | None) -> tuple[str, str]:
+    short = name or fn.__name__
+    full = f"{fn.__module__}.{fn.__qualname__}"
+    return short, full
+
+
+def configurable(fn_or_name: Callable | str | None = None, *, name: str | None = None):
+    """Register a function or class so config bindings apply to its calls.
+
+    Usable as ``@configurable``, ``@configurable("other_name")`` or
+    ``@configurable(name="other_name")``.
+    """
+    if isinstance(fn_or_name, str):
+        return functools.partial(configurable, name=fn_or_name)
+    if fn_or_name is None:
+        return functools.partial(configurable, name=name)
+
+    fn = fn_or_name
+    short, full = _canonical(fn, name)
+
+    names = (full, short)
+    if inspect.isclass(fn):
+        sig = inspect.signature(fn.__init__)
+        sig = sig.replace(parameters=list(sig.parameters.values())[1:])  # drop self
+        wrapped = _wrap_class(fn, names)
+    else:
+        sig = inspect.signature(fn)
+        wrapped = _wrap_function(fn, names)
+
+    wrapped.__signature__ = sig  # type: ignore[attr-defined]
+    with _LOCK:
+        _REGISTRY[full] = wrapped
+        if short in _ALIASES and _ALIASES[short] != full:
+            # Two distinct configurables claim the same short name: the
+            # short name becomes ambiguous (gin errors on ambiguous use).
+            _AMBIGUOUS.add(short)
+            _REGISTRY.pop(short, None)
+            _ALIASES.pop(short, None)
+        elif short not in _AMBIGUOUS:
+            _REGISTRY[short] = wrapped
+            _ALIASES[short] = full
+    return wrapped
+
+
+def _merge_kwargs(names: tuple[str, ...], fn: Callable, args: tuple, kwargs: dict) -> dict:
+    """Compute binding-supplied kwargs not covered by explicit arguments.
+
+    ``names`` holds every name the configurable answers to (full dotted path
+    and short name) so bindings parsed before the module was imported still
+    apply. Ambiguous short names are excluded.
+    """
+    with _LOCK:
+        live = [n for n in names if n not in _AMBIGUOUS]
+        bound = {p: v for (k, p), v in _BINDINGS.items() if k in live}
+    if not bound:
+        return kwargs
+    try:
+        sig = inspect.signature(fn)
+        params = [p for p in sig.parameters if p != "self"]
+    except (TypeError, ValueError):
+        params = []
+    # Parameters consumed positionally cannot also come from bindings.
+    positional = set(params[: len(args)])
+    merged = dict(kwargs)
+    for p, v in bound.items():
+        if p in merged or p in positional:
+            continue
+        merged[p] = _materialize(v)
+    return merged
+
+
+def _materialize(value):
+    """Resolve lazy Refs (incl. nested inside containers)."""
+    if isinstance(value, Ref):
+        return value.resolve()
+    if isinstance(value, list):
+        return [_materialize(v) for v in value]
+    if isinstance(value, tuple):
+        return tuple(_materialize(v) for v in value)
+    if isinstance(value, dict):
+        return {k: _materialize(v) for k, v in value.items()}
+    return value
+
+
+def _wrap_function(fn: Callable, names: tuple[str, ...]) -> Callable:
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        return fn(*args, **_merge_kwargs(names, fn, args, kwargs))
+
+    wrapper.__gin_name__ = names[0]  # type: ignore[attr-defined]
+    return wrapper
+
+
+def _wrap_class(cls: type, names: tuple[str, ...]) -> type:
+    orig_init = cls.__init__
+
+    @functools.wraps(orig_init)
+    def __init__(self, *args, **kwargs):
+        orig_init(self, *args, **_merge_kwargs(names, orig_init, args, kwargs))
+
+    cls.__init__ = __init__
+    cls.__gin_name__ = names[0]  # type: ignore[attr-defined]
+    return cls
+
+
+def register_enum(cls: type[enum.Enum]) -> type[enum.Enum]:
+    """Register an enum for ``%module.Enum.MEMBER`` constants (gin's
+    ``constants_from_enum``, reference rqvae.py:43-51)."""
+    path = f"{cls.__module__}.{cls.__qualname__}"
+    with _LOCK:
+        _ENUMS[path] = cls
+        _ENUMS[cls.__qualname__] = cls
+    return cls
+
+
+def resolve_enum(dotted: str):
+    """Resolve ``pkg.module.Enum.MEMBER`` to the enum member, or None."""
+    if "." not in dotted:
+        return None
+    path, member = dotted.rsplit(".", 1)
+    with _LOCK:
+        cls = _ENUMS.get(path) or _ENUMS.get(path.rsplit(".", 1)[-1])
+    if cls is None:
+        # Try importing the module holding the enum.
+        mod_path, _, cls_name = path.rpartition(".")
+        if mod_path:
+            try:
+                import importlib
+
+                mod = importlib.import_module(mod_path)
+                cls = getattr(mod, cls_name, None)
+            except ImportError:
+                cls = None
+    if cls is not None and isinstance(cls, type) and issubclass(cls, enum.Enum):
+        return cls[member]
+    return None
+
+
+def lookup(name: str) -> Callable | None:
+    with _LOCK:
+        if name in _AMBIGUOUS:
+            raise KeyError(
+                f"{name!r} is ambiguous (registered by multiple modules); "
+                "use the full module.qualname path"
+            )
+        return _REGISTRY.get(name)
+
+
+def _binding_key(target: str) -> str:
+    with _LOCK:
+        return _ALIASES.get(target, target)
+
+
+def bind(target: str, param: str, value: Any) -> None:
+    with _LOCK:
+        if target in _AMBIGUOUS:
+            raise KeyError(
+                f"binding target {target!r} is ambiguous; use the full "
+                "module.qualname path"
+            )
+        _BINDINGS[(_binding_key(target), param)] = value
+
+
+def _target_names(target: str) -> set[str]:
+    names = {target, _binding_key(target)}
+    # A full dotted path also answers to its trailing qualname.
+    if "." in target:
+        names.add(target.rsplit(".", 1)[-1])
+    return names
+
+
+def get_binding(target: str, param: str, default: Any = None) -> Any:
+    names = _target_names(target)
+    with _LOCK:
+        for n in names:
+            if (n, param) in _BINDINGS:
+                return _materialize(_BINDINGS[(n, param)])
+    return default
+
+
+def get_bindings(target: str) -> dict[str, Any]:
+    names = _target_names(target)
+    with _LOCK:
+        return {
+            p: _materialize(v) for (k, p), v in _BINDINGS.items() if k in names
+        }
+
+
+def query(target_dot_param: str, default: Any = None) -> Any:
+    target, _, param = target_dot_param.rpartition(".")
+    return get_binding(target, param, default)
+
+
+def clear_bindings() -> None:
+    with _LOCK:
+        _BINDINGS.clear()

@@ -1,0 +1,31 @@
+"""Test harness: force an 8-device virtual CPU platform before JAX import.
+
+The reference has no tests at all (SURVEY.md §4); here every distributed
+code path is exercised on a faked 8-device host mesh so CI needs no TPU.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_config():
+    """Isolate configlib global state between tests."""
+    from genrec_tpu.configlib import clear_bindings
+    from genrec_tpu.configlib.parser import clear_macros
+
+    yield
+    clear_bindings()
+    clear_macros()
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,163 @@
+"""Tests for the gin-compatible config system."""
+
+import enum
+import textwrap
+
+import pytest
+
+from genrec_tpu import configlib
+from genrec_tpu.configlib import parser as cfg_parser
+from genrec_tpu.configlib import registry
+
+
+@configlib.configurable
+def _sample_train(epochs=1, lr=0.1, dataset=None, mode=None, dims=None):
+    return dict(epochs=epochs, lr=lr, dataset=dataset, mode=mode, dims=dims)
+
+
+@configlib.configurable
+class _SampleDataset:
+    def __init__(self, split="beauty", size=10):
+        self.split = split
+        self.size = size
+
+
+@configlib.register_enum
+class _Mode(enum.Enum):
+    STE = 1
+    SINKHORN = 2
+
+
+def test_binding_injected_and_explicit_wins():
+    configlib.parse_string("_sample_train.epochs = 7\n_sample_train.lr = 1e-3")
+    out = _sample_train()
+    assert out["epochs"] == 7 and out["lr"] == 1e-3
+    assert _sample_train(epochs=2)["epochs"] == 2
+
+
+def test_literals_lists_and_macros():
+    configlib.parse_string(
+        textwrap.dedent(
+            """
+            # a comment
+            HIDDEN = [512, 256,
+                      128, 64]   # continuation over lines
+            _sample_train.dims = %HIDDEN
+            _sample_train.lr = 0.001
+            """
+        )
+    )
+    out = _sample_train()
+    assert out["dims"] == [512, 256, 128, 64]
+    assert out["lr"] == 0.001
+
+
+def test_enum_constant():
+    configlib.parse_string(
+        "_sample_train.mode = %tests.test_configlib._Mode.SINKHORN"
+    )
+    assert _sample_train()["mode"] is _Mode.SINKHORN
+
+
+def test_configurable_reference():
+    configlib.parse_string(
+        "_sample_train.dataset = @_SampleDataset\n_SampleDataset.split = 'toys'"
+    )
+    ds_cls = _sample_train()["dataset"]
+    ds = ds_cls()
+    assert ds.split == "toys" and ds.size == 10
+
+
+def test_evaluated_reference():
+    configlib.parse_string(
+        "_sample_train.dataset = @_SampleDataset()\n_SampleDataset.size = 3"
+    )
+    assert _sample_train()["dataset"].size == 3
+
+
+def test_include_and_split_substitution(tmp_path):
+    base = tmp_path / "base.gin"
+    base.write_text("LR_MACRO = 0.5\n")
+    main = tmp_path / "main.gin"
+    main.write_text(
+        f'include "{base}"\n'
+        "_sample_train.lr = %LR_MACRO\n"
+        '_SampleDataset.split = "{split}"\n'
+    )
+    cfg_parser.parse_file(str(main), substitutions={"split": "sports"})
+    assert _sample_train()["lr"] == 0.5
+    assert _SampleDataset().split == "sports"
+
+
+def test_cli_overrides(tmp_path):
+    cfg = tmp_path / "c.gin"
+    cfg.write_text("_sample_train.epochs = 100\n")
+    args = configlib.parse_config(
+        [str(cfg), "--split", "toys", "--gin", "_sample_train.epochs=2"]
+    )
+    assert args.split == "toys"
+    assert _sample_train()["epochs"] == 2
+
+
+def test_query_and_get_binding():
+    configlib.parse_string("_sample_train.epochs = 9")
+    assert configlib.query("_sample_train.epochs") == 9
+    assert configlib.get_binding("_sample_train", "missing", 42) == 42
+
+
+def test_string_with_hash_not_comment():
+    configlib.parse_string('_SampleDataset.split = "a#b"')
+    assert _SampleDataset().split == "a#b"
+
+
+def test_bad_binding_raises():
+    with pytest.raises(ValueError):
+        cfg_parser.parse_binding("no equals sign here")
+
+
+def test_positional_class_arg_beats_binding():
+    configlib.parse_string("_SampleDataset.split = 'bound'")
+    assert _SampleDataset("explicit").split == "explicit"
+
+
+def test_include_forwards_split_substitution(tmp_path):
+    inner = tmp_path / "inner.gin"
+    inner.write_text('_SampleDataset.split = "{split}"\n')
+    main = tmp_path / "main.gin"
+    main.write_text(f'include "{inner}"\n')
+    cfg_parser.parse_file(str(main), substitutions={"split": "toys"})
+    assert _SampleDataset().split == "toys"
+
+
+def test_macro_redefinition_retroapplies():
+    configlib.parse_string("LR = 0.5\n_sample_train.lr = %LR")
+    cfg_parser.parse_binding("LR = 0.9")  # e.g. a --gin override
+    assert _sample_train()["lr"] == 0.9
+
+
+def test_scoped_configurable_ref_resolves():
+    configlib.parse_string("_sample_train.dataset = @eval/_SampleDataset")
+    assert _sample_train()["dataset"]().size == 10
+
+
+def test_class_signature_drops_self():
+    import inspect
+
+    assert "self" not in inspect.signature(_SampleDataset).parameters
+
+
+def test_short_name_collision_becomes_ambiguous():
+    @configlib.configurable(name="_collide_me")
+    def a(x=1):
+        return x
+
+    @configlib.configurable(name="_collide_me")
+    def b(x=2):
+        return x
+
+    with pytest.raises(KeyError):
+        registry.bind("_collide_me", "x", 3)
+    # Full paths still work.
+    full = f"{b.__module__}.{b.__qualname__}"
+    registry.bind(full, "x", 5)
+    assert b() == 5
