@@ -26,7 +26,12 @@ from genrec_tpu.configlib.registry import (
     query,
     register_enum,
 )
-from genrec_tpu.configlib.parser import parse_file, parse_string, parse_binding
+from genrec_tpu.configlib.parser import (
+    parse_file,
+    parse_string,
+    parse_binding,
+    clear_macros,
+)
 from genrec_tpu.configlib.cli import parse_config
 
 __all__ = [
@@ -41,4 +46,5 @@ __all__ = [
     "parse_string",
     "parse_binding",
     "parse_config",
+    "clear_macros",
 ]

@@ -77,12 +77,9 @@ class MacroRef(registry.Ref):
 
 
 def _resolve_pct(name: str) -> Any:
-    ref = MacroRef(name)
-    # Fail fast at parse time when the name is known to be bogus *now*
-    # (neither a defined macro nor resolvable enum) — but keep the lazy ref
-    # so later redefinitions still apply.
-    ref.resolve()
-    return ref
+    # Fully lazy (gin semantics): forward references and --gin-supplied
+    # macros are legal; unknown names fail at injection time instead.
+    return MacroRef(name)
 
 
 def parse_value(expr: str) -> Any:
@@ -156,6 +153,8 @@ def parse_string(
     base_dir: str = ".",
     substitutions: dict[str, str] | None = None,
 ) -> None:
+    for key, val in (substitutions or {}).items():
+        text = text.replace("{%s}" % key, val)
     for line in _logical_lines(text):
         if line.startswith("include "):
             path = parse_value(line[len("include ") :])
@@ -177,8 +176,6 @@ def parse_string(
 def parse_file(path: str, *, substitutions: dict[str, str] | None = None) -> None:
     with open(path) as f:
         text = f.read()
-    for key, val in (substitutions or {}).items():
-        text = text.replace("{%s}" % key, val)
     parse_string(
         text,
         base_dir=os.path.dirname(os.path.abspath(path)),

@@ -114,7 +114,25 @@ def configurable(fn_or_name: Callable | str | None = None, *, name: str | None =
     return wrapped
 
 
-def _merge_kwargs(names: tuple[str, ...], fn: Callable, args: tuple, kwargs: dict) -> dict:
+def _positional_params(fn: Callable) -> list[str]:
+    """Names of parameters that can be filled positionally, in order
+    (POSITIONAL_ONLY / POSITIONAL_OR_KEYWORD, minus self)."""
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return []
+    return [
+        p.name
+        for p in sig.parameters.values()
+        if p.name != "self"
+        and p.kind
+        in (inspect.Parameter.POSITIONAL_ONLY, inspect.Parameter.POSITIONAL_OR_KEYWORD)
+    ]
+
+
+def _merge_kwargs(
+    names: tuple[str, ...], pos_params: list[str], args: tuple, kwargs: dict
+) -> dict:
     """Compute binding-supplied kwargs not covered by explicit arguments.
 
     ``names`` holds every name the configurable answers to (full dotted path
@@ -126,13 +144,8 @@ def _merge_kwargs(names: tuple[str, ...], fn: Callable, args: tuple, kwargs: dic
         bound = {p: v for (k, p), v in _BINDINGS.items() if k in live}
     if not bound:
         return kwargs
-    try:
-        sig = inspect.signature(fn)
-        params = [p for p in sig.parameters if p != "self"]
-    except (TypeError, ValueError):
-        params = []
     # Parameters consumed positionally cannot also come from bindings.
-    positional = set(params[: len(args)])
+    positional = set(pos_params[: len(args)])
     merged = dict(kwargs)
     for p, v in bound.items():
         if p in merged or p in positional:
@@ -155,9 +168,11 @@ def _materialize(value):
 
 
 def _wrap_function(fn: Callable, names: tuple[str, ...]) -> Callable:
+    pos_params = _positional_params(fn)
+
     @functools.wraps(fn)
     def wrapper(*args, **kwargs):
-        return fn(*args, **_merge_kwargs(names, fn, args, kwargs))
+        return fn(*args, **_merge_kwargs(names, pos_params, args, kwargs))
 
     wrapper.__gin_name__ = names[0]  # type: ignore[attr-defined]
     return wrapper
@@ -165,10 +180,11 @@ def _wrap_function(fn: Callable, names: tuple[str, ...]) -> Callable:
 
 def _wrap_class(cls: type, names: tuple[str, ...]) -> type:
     orig_init = cls.__init__
+    pos_params = _positional_params(orig_init)
 
     @functools.wraps(orig_init)
     def __init__(self, *args, **kwargs):
-        orig_init(self, *args, **_merge_kwargs(names, orig_init, args, kwargs))
+        orig_init(self, *args, **_merge_kwargs(names, pos_params, args, kwargs))
 
     cls.__init__ = __init__
     cls.__gin_name__ = names[0]  # type: ignore[attr-defined]
@@ -244,9 +260,14 @@ def _target_names(target: str) -> set[str]:
 def get_binding(target: str, param: str, default: Any = None) -> Any:
     names = _target_names(target)
     with _LOCK:
-        for n in names:
-            if (n, param) in _BINDINGS:
-                return _materialize(_BINDINGS[(n, param)])
+        # Scan in insertion order and keep the LAST match so get_binding
+        # agrees with call-time injection, where later bindings win.
+        found, value = False, None
+        for (k, p), v in _BINDINGS.items():
+            if p == param and k in names:
+                found, value = True, v
+        if found:
+            return _materialize(value)
     return default
 
 

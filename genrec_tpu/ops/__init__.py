@@ -1,0 +1,51 @@
+"""Pure-functional JAX ops: the compute vocabulary shared by all models.
+
+Counterpart of the reference's ``genrec/modules`` (SURVEY.md §2.2), but as
+stateless array functions (params passed explicitly) so they compose with
+jit/vmap/shard_map and can be swapped for Pallas kernels where profitable.
+"""
+
+from genrec_tpu.ops.normalize import l2norm, rms_norm
+from genrec_tpu.ops.losses import (
+    reconstruction_loss,
+    categorical_reconstruction_loss,
+    quantize_loss,
+    cross_entropy_with_ignore,
+    info_nce,
+)
+from genrec_tpu.ops.metrics import (
+    first_match_ranks,
+    recall_at_k,
+    ndcg_at_k,
+    TopKAccumulator,
+)
+from genrec_tpu.ops.gumbel import sample_gumbel, gumbel_softmax_sample
+from genrec_tpu.ops.kmeans import kmeans
+from genrec_tpu.ops.schedules import (
+    linear_schedule_with_warmup,
+    cosine_schedule_with_warmup,
+    inverse_sqrt_schedule,
+)
+from genrec_tpu.ops.buckets import t5_relative_position_bucket, hstu_log_bucket
+
+__all__ = [
+    "l2norm",
+    "rms_norm",
+    "reconstruction_loss",
+    "categorical_reconstruction_loss",
+    "quantize_loss",
+    "cross_entropy_with_ignore",
+    "info_nce",
+    "first_match_ranks",
+    "recall_at_k",
+    "ndcg_at_k",
+    "TopKAccumulator",
+    "sample_gumbel",
+    "gumbel_softmax_sample",
+    "kmeans",
+    "linear_schedule_with_warmup",
+    "cosine_schedule_with_warmup",
+    "inverse_sqrt_schedule",
+    "t5_relative_position_bucket",
+    "hstu_log_bucket",
+]

@@ -1,0 +1,23 @@
+"""Gumbel-softmax sampling with explicit PRNG keys.
+
+Parity target: reference genrec/modules/gumbel.py:11-47 (soft sample only —
+no straight-through hard path). RNG is threaded explicitly per JAX
+discipline instead of the reference's implicit global torch RNG.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def sample_gumbel(key: jax.Array, shape, eps: float = 1e-20, dtype=jnp.float32):
+    u = jax.random.uniform(key, shape, dtype=dtype)
+    return -jnp.log(-jnp.log(u + eps) + eps)
+
+
+def gumbel_softmax_sample(
+    key: jax.Array, logits: jax.Array, temperature: float
+) -> jax.Array:
+    y = logits + sample_gumbel(key, logits.shape, dtype=logits.dtype)
+    return jax.nn.softmax(y / temperature, axis=-1)

@@ -1,0 +1,68 @@
+"""K-means (Lloyd) for codebook initialization, fully jitted.
+
+Parity target: reference genrec/modules/kmeans.py:36-99 (random-choice init,
+full-batch Lloyd until max centroid shift < threshold, dead-cluster
+re-seeding). Two deliberate TPU-first changes (SURVEY.md §5.2):
+
+- deterministic: explicit PRNG key instead of np.random / rank-dependent
+  first-batch init — every data-parallel replica computes the same
+  codebook, designing away the reference's silent per-rank divergence.
+- bounded: ``lax.while_loop`` with a hard ``max_iters`` cap so the loop
+  compiles; distance matrix is one (B,K) matmul on the MXU rather than a
+  broadcast subtract.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class KmeansOutput(NamedTuple):
+    centroids: jax.Array  # (k, D)
+    assignment: jax.Array  # (B,)
+
+
+def _assign(x: jax.Array, centroids: jax.Array) -> jax.Array:
+    # ||x-c||^2 = ||x||^2 - 2 x.c + ||c||^2 ; ||x||^2 constant wrt argmin.
+    dots = x @ centroids.T
+    c2 = jnp.sum(jnp.square(centroids), axis=-1)
+    return jnp.argmin(c2[None, :] - 2.0 * dots, axis=-1)
+
+
+def kmeans(
+    key: jax.Array,
+    x: jax.Array,
+    k: int,
+    max_iters: int = 200,
+    stop_threshold: float = 1e-10,
+) -> KmeansOutput:
+    """Run Lloyd's algorithm on ``x`` (B, D) -> k centroids."""
+    B = x.shape[0]
+    init_key, reseed_key = jax.random.split(key)
+    init_idx = jax.random.choice(init_key, B, shape=(k,), replace=False)
+    centroids0 = x[init_idx]
+
+    def step(state):
+        centroids, it, _ = state
+        assignment = _assign(x, centroids)
+        onehot = jax.nn.one_hot(assignment, k, dtype=x.dtype)  # (B, k)
+        counts = jnp.sum(onehot, axis=0)  # (k,)
+        sums = onehot.T @ x  # (k, D)
+        means = sums / jnp.maximum(counts[:, None], 1.0)
+        # Dead clusters: reseed from a random data point (deterministic key).
+        rk = jax.random.fold_in(reseed_key, it)
+        rand_idx = jax.random.randint(rk, (k,), 0, B)
+        new_centroids = jnp.where(counts[:, None] > 0, means, x[rand_idx])
+        shift = jnp.max(jnp.linalg.norm(new_centroids - centroids, axis=-1))
+        return new_centroids, it + 1, shift
+
+    def cond(state):
+        _, it, shift = state
+        return jnp.logical_and(it < max_iters, shift >= stop_threshold)
+
+    state = (centroids0, jnp.int32(0), jnp.asarray(jnp.inf, x.dtype))
+    centroids, _, _ = jax.lax.while_loop(cond, step, state)
+    return KmeansOutput(centroids=centroids, assignment=_assign(x, centroids))

@@ -1,0 +1,98 @@
+"""Loss primitives.
+
+Parity targets: reference genrec/modules/loss.py (ReconstructionLoss :8-23,
+CategoricalReconstructionLoss :26-54, QuantizeLoss :57-77), the trainers'
+cross-entropy conventions (ignore_index=0 full-vocab CE sasrec.py:124-128;
+per-sequence token-sum CE tiger.py:232-240), and COBRA's in-batch InfoNCE
+(cobra.py:466-495).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def reconstruction_loss(x_hat: jax.Array, x: jax.Array) -> jax.Array:
+    """Per-row squared-error sum over the feature axis -> shape (...,)."""
+    return jnp.sum(jnp.square(x_hat - x), axis=-1)
+
+
+def categorical_reconstruction_loss(
+    x_hat: jax.Array, x: jax.Array, n_cat_feats: int
+) -> jax.Array:
+    """MSE on dense dims + summed BCE-with-logits on trailing categorical dims."""
+    if n_cat_feats <= 0:
+        return reconstruction_loss(x_hat, x)
+    dense = reconstruction_loss(x_hat[..., :-n_cat_feats], x[..., :-n_cat_feats])
+    logits = x_hat[..., -n_cat_feats:]
+    labels = x[..., -n_cat_feats:]
+    # binary_cross_entropy_with_logits, reduction='none', summed over feats.
+    bce = jnp.maximum(logits, 0) - logits * labels + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+    return dense + jnp.sum(bce, axis=-1)
+
+
+def quantize_loss(
+    query: jax.Array, value: jax.Array, commitment_weight: float = 1.0
+) -> jax.Array:
+    """VQ loss: codebook term + commitment term via stop_gradient.
+
+    emb_loss pulls the codeword toward the (frozen) encoder output;
+    commitment pulls the encoder toward the (frozen) codeword.
+    """
+    emb_loss = jnp.sum(jnp.square(jax.lax.stop_gradient(query) - value), axis=-1)
+    commit_loss = jnp.sum(jnp.square(query - jax.lax.stop_gradient(value)), axis=-1)
+    return emb_loss + commitment_weight * commit_loss
+
+
+def cross_entropy_with_ignore(
+    logits: jax.Array,
+    targets: jax.Array,
+    ignore_index: int = 0,
+) -> tuple[jax.Array, jax.Array]:
+    """Token-level CE with an ignored target id.
+
+    Returns ``(per_token_loss, valid_mask)`` with the loss already zeroed at
+    ignored positions, so callers choose the reduction (mean over valid
+    tokens for SASRec/HSTU; per-sequence sum then batch mean for TIGER).
+    """
+    logits = logits.astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    # Clip target for the gather; masked out below.
+    tgt = jnp.clip(targets, 0, logits.shape[-1] - 1)
+    gold = jnp.take_along_axis(logits, tgt[..., None], axis=-1)[..., 0]
+    valid = (targets != ignore_index).astype(jnp.float32)
+    return (logz - gold) * valid, valid
+
+
+def info_nce(
+    query: jax.Array,
+    keys: jax.Array,
+    temperature: float,
+    positive_idx: jax.Array,
+    neg_mask: jax.Array | None = None,
+) -> jax.Array:
+    """InfoNCE over a shared key pool.
+
+    Args:
+        query: (N, D) anchor vectors.
+        keys: (M, D) candidate vectors (positives included).
+        temperature: softmax temperature divisor.
+        positive_idx: (N,) index of each anchor's positive in ``keys``.
+        neg_mask: optional (N, M) bool, True where the candidate must be
+            EXCLUDED as a negative (e.g. same-sequence items,
+            cobra.py:478-489). Positives are never excluded.
+    Returns:
+        (N,) per-anchor loss.
+    """
+    logits = (query @ keys.T) / temperature  # (N, M)
+    if neg_mask is not None:
+        n = query.shape[0]
+        pos_onehot = jax.nn.one_hot(positive_idx, keys.shape[0], dtype=bool)
+        drop = jnp.logical_and(neg_mask, ~pos_onehot)
+        logits = jnp.where(drop, -1e9, logits)
+    logz = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
+    gold = jnp.take_along_axis(
+        logits.astype(jnp.float32), positive_idx[:, None], axis=-1
+    )[:, 0]
+    return logz - gold

@@ -1,0 +1,86 @@
+"""Recall@K / NDCG@K metrics, fully on device.
+
+Parity target: reference genrec/modules/metrics.py:10-74 (TopKAccumulator:
+exact-match of semantic-id tuples against top-K beams, rank of first match,
+NDCG = 1/log2(rank+2)) and the per-sample Python rank loops in
+sasrec_trainer.py:62-72 — the latter replaced by vectorized rank math so
+eval never syncs to the host.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def first_match_ranks(actual: jax.Array, top_k: jax.Array) -> jax.Array:
+    """Rank (0-indexed) of the first beam exactly matching ``actual``.
+
+    Args:
+        actual: (B, D) ground-truth id tuples (D=1 for plain item ids).
+        top_k: (B, K, D) ranked predictions.
+    Returns:
+        (B,) int32 rank in [0, K]; K means "not found".
+    """
+    matches = jnp.all(actual[:, None, :] == top_k, axis=-1)  # (B, K)
+    K = top_k.shape[1]
+    found = jnp.any(matches, axis=1)
+    rank = jnp.argmax(matches, axis=1)
+    return jnp.where(found, rank, K).astype(jnp.int32)
+
+
+def recall_at_k(ranks: jax.Array, k: int) -> jax.Array:
+    """Sum (not mean) of hits in top-k; divide by total at reduce time."""
+    return jnp.sum((ranks < k).astype(jnp.float32))
+
+
+def ndcg_at_k(ranks: jax.Array, k: int) -> jax.Array:
+    in_top = ranks < k
+    dcg = jnp.where(in_top, 1.0 / jnp.log2(ranks.astype(jnp.float32) + 2.0), 0.0)
+    return jnp.sum(dcg)
+
+
+def batch_metrics(actual: jax.Array, top_k: jax.Array, ks: tuple[int, ...]) -> dict:
+    """One jit-friendly call: sums for every K plus the batch count."""
+    ranks = first_match_ranks(actual, top_k)
+    out = {"total": jnp.asarray(ranks.shape[0], jnp.float32)}
+    for k in ks:
+        out[f"recall_sum@{k}"] = recall_at_k(ranks, k)
+        out[f"ndcg_sum@{k}"] = ndcg_at_k(ranks, k)
+    return out
+
+
+class TopKAccumulator:
+    """Host-side accumulator over device-computed batch sums.
+
+    ``accumulate`` adds a batch (device work only — one all-exact-match and
+    two reductions); ``reduce`` divides through and optionally sums across
+    data-parallel processes first.
+    """
+
+    def __init__(self, ks: tuple[int, ...] = (1, 5, 10)):
+        self.ks = tuple(ks)
+        self.reset()
+
+    def reset(self) -> None:
+        self._sums: dict[str, float] = {}
+
+    def accumulate(self, actual: jax.Array, top_k: jax.Array) -> None:
+        batch = batch_metrics(actual, top_k, self.ks)
+        for k, v in batch.items():
+            self._sums[k] = self._sums.get(k, 0.0) + float(v)
+
+    def reduce(self, cross_process: bool = False) -> dict[str, float]:
+        sums = dict(self._sums)
+        if cross_process and jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            stacked = jnp.asarray([sums[k] for k in sorted(sums)])
+            summed = multihost_utils.process_allgather(stacked).sum(axis=0)
+            sums = dict(zip(sorted(sums), [float(v) for v in summed]))
+        total = max(sums.get("total", 0.0), 1.0)
+        out = {}
+        for k in self.ks:
+            out[f"Recall@{k}"] = sums.get(f"recall_sum@{k}", 0.0) / total
+            out[f"NDCG@{k}"] = sums.get(f"ndcg_sum@{k}", 0.0) / total
+        return out

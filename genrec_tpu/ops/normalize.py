@@ -1,0 +1,36 @@
+"""Normalization primitives.
+
+Behavioral parity targets: reference genrec/modules/normalize.py
+(l2norm :11-35, RMSNorm :38-55, RootMeanSquareLayerNorm :73-95 — the
+T5-style fp32-variance norm). Here they are pure functions; Flax layer
+wrappers live in genrec_tpu.models.layers.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def l2norm(x: jax.Array, axis: int = -1, eps: float = 1e-12) -> jax.Array:
+    """L2-normalize along ``axis``.
+
+    Matches torch.nn.functional.normalize: divides by max(||x||, eps) so the
+    zero vector maps to zero rather than NaN.
+    """
+    n = jnp.linalg.norm(x, ord=2, axis=axis, keepdims=True)
+    return x / jnp.maximum(n, eps)
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float = 1e-6) -> jax.Array:
+    """T5-style RMS norm: variance in float32, no mean subtraction, no bias.
+
+    The fp32 variance accumulation is load-bearing for bf16 training
+    (reference normalize.py:87-90 does the same upcast); on TPU the
+    surrounding matmuls stay bf16 while this statistic stays exact.
+    """
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    variance = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    xf = xf * jax.lax.rsqrt(variance + eps)
+    return (xf * weight.astype(jnp.float32)).astype(dtype)

@@ -1,0 +1,56 @@
+"""Learning-rate schedules matching the reference trainers' choices.
+
+Parity targets: HF ``get_linear_schedule_with_warmup``
+(rqvae_trainer.py:167-171), ``get_cosine_schedule_with_warmup``
+(tiger_trainer.py:223-227, lcrec_trainer.py:349, cobra_trainer.py:257-261)
+and the in-repo InverseSquareRootScheduler (scheduler.py:8-27). Implemented
+as optax-compatible step->scale callables.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+
+def linear_schedule_with_warmup(
+    base_lr: float, warmup_steps: int, total_steps: int
+):
+    """Linear warmup 0->base, then linear decay base->0 at total_steps."""
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        warm = step / jnp.maximum(1.0, warmup_steps)
+        decay = (total_steps - step) / jnp.maximum(1.0, total_steps - warmup_steps)
+        return base_lr * jnp.clip(jnp.where(step < warmup_steps, warm, decay), 0.0, 1.0)
+
+    return schedule
+
+
+def cosine_schedule_with_warmup(
+    base_lr: float, warmup_steps: int, total_steps: int, num_cycles: float = 0.5
+):
+    """Linear warmup then cosine decay to 0 (HF semantics, num_cycles=0.5)."""
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        warm = step / jnp.maximum(1.0, warmup_steps)
+        progress = (step - warmup_steps) / jnp.maximum(1.0, total_steps - warmup_steps)
+        cos = 0.5 * (1.0 + jnp.cos(math.pi * num_cycles * 2.0 * progress))
+        return base_lr * jnp.where(
+            step < warmup_steps, jnp.clip(warm, 0.0, 1.0), jnp.maximum(0.0, cos)
+        )
+
+    return schedule
+
+
+def inverse_sqrt_schedule(base_lr: float, warmup_steps: int):
+    """Constant during warmup, then base * sqrt(warmup/step)."""
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32) + 1.0
+        scale = jnp.sqrt(warmup_steps / jnp.maximum(step, 1.0))
+        return base_lr * jnp.where(step <= warmup_steps, 1.0, scale)
+
+    return schedule

@@ -146,6 +146,31 @@ def test_class_signature_drops_self():
     assert "self" not in inspect.signature(_SampleDataset).parameters
 
 
+def test_forward_macro_reference_is_lazy():
+    configlib.parse_string("_sample_train.lr = %FWD\nFWD = 0.25")
+    assert _sample_train()["lr"] == 0.25
+
+
+def test_keyword_only_param_binding_with_varargs():
+    @configlib.configurable(name="_varargs_fn")
+    def f(a, *args, b=1):
+        return a, args, b
+
+    configlib.parse_string("_varargs_fn.b = 5")
+    assert f(1, 2, 3) == (1, (2, 3), 5)
+
+
+def test_parse_string_applies_substitutions():
+    cfg_parser.parse_string(
+        '_SampleDataset.split = "{split}"', substitutions={"split": "toys"}
+    )
+    assert _SampleDataset().split == "toys"
+
+
+def test_clear_macros_exported():
+    assert callable(configlib.clear_macros)
+
+
 def test_short_name_collision_becomes_ambiguous():
     @configlib.configurable(name="_collide_me")
     def a(x=1):
