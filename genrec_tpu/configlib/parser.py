@@ -92,7 +92,10 @@ def parse_value(expr: str) -> Any:
         "False": False,
         "None": None,
     }
-    return eval(_sub_refs(expr), env)  # noqa: S307 - trusted local config files
+    try:
+        return eval(_sub_refs(expr), env)  # noqa: S307 - trusted local config files
+    except (NameError, SyntaxError) as e:
+        raise ValueError(f"cannot parse config value {expr!r}: {e}") from e
 
 
 def parse_binding(line: str) -> None:

@@ -1,0 +1,6 @@
+"""Training core: train state, the jitted step factory, checkpointing, logging."""
+
+from genrec_tpu.core.state import TrainState
+from genrec_tpu.core.harness import make_train_step
+
+__all__ = ["TrainState", "make_train_step"]

@@ -1,0 +1,68 @@
+"""Checkpointing via orbax: ONE format for every model.
+
+Replaces the reference's three coexisting ad-hoc formats (torch.save dicts,
+bare state_dicts, HF save_pretrained dirs — SURVEY.md §5.4) with orbax
+PyTree checkpoints. Semantic-id artifacts (the RQ-VAE -> downstream-dataset
+interface, amazon.py:296-313) are a separate portable .npz — see
+genrec_tpu.data.sem_ids.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+
+def _abs(path: str) -> str:
+    return os.path.abspath(path)
+
+
+def save_params(path: str, params: Any) -> None:
+    """Save a params pytree (host-side, synchronous)."""
+    params = jax.tree_util.tree_map(np.asarray, params)
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(_abs(path), params, force=True)
+    ckptr.wait_until_finished()
+
+
+def load_params(path: str, like: Any | None = None) -> Any:
+    ckptr = ocp.StandardCheckpointer()
+    if like is not None:
+        like = jax.tree_util.tree_map(np.asarray, like)
+        return ckptr.restore(_abs(path), like)
+    return ckptr.restore(_abs(path))
+
+
+class CheckpointManager:
+    """Step-numbered training checkpoints with auto-resume.
+
+    Covers (and exceeds — the reference has no auto-resume discovery) the
+    `resume_from_checkpoint` flow of tiger_trainer.py:248-256.
+    """
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        self._mgr = ocp.CheckpointManager(
+            _abs(directory),
+            options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep),
+        )
+
+    def save(self, step: int, state: Any) -> None:
+        state = jax.tree_util.tree_map(np.asarray, state)
+        self._mgr.save(step, args=ocp.args.StandardSave(state))
+
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def restore(self, state_like: Any, step: int | None = None) -> Any:
+        step = step if step is not None else self._mgr.latest_step()
+        if step is None:
+            return None
+        state_like = jax.tree_util.tree_map(np.asarray, state_like)
+        return self._mgr.restore(step, args=ocp.args.StandardRestore(state_like))
+
+    def close(self) -> None:
+        self._mgr.close()

@@ -1,0 +1,80 @@
+"""Logging + experiment tracking plumbing shared by all trainers.
+
+Mirrors the reference's per-trainer `setup_logger` (sasrec_trainer.py:20-36)
+and wandb usage (define_metric namespacing, :105-107), with wandb made
+optional: if the package is missing or disabled, `Tracker` is a no-op, so
+trainers never branch on availability.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import time
+from typing import Any, Mapping
+
+
+def setup_logger(save_dir: str | None = None, name: str = "genrec_tpu") -> logging.Logger:
+    logger = logging.getLogger(name)
+    if logger.handlers:
+        return logger
+    logger.setLevel(logging.INFO)
+    fmt = logging.Formatter("%(asctime)s %(levelname)s %(message)s")
+    sh = logging.StreamHandler(sys.stdout)
+    sh.setFormatter(fmt)
+    logger.addHandler(sh)
+    if save_dir:
+        os.makedirs(save_dir, exist_ok=True)
+        fh = logging.FileHandler(os.path.join(save_dir, "train.log"))
+        fh.setFormatter(fmt)
+        logger.addHandler(fh)
+    return logger
+
+
+class Tracker:
+    """wandb-compatible metric tracker with a JSONL fallback.
+
+    Always writes metrics to ``<save_dir>/metrics.jsonl`` (greppable,
+    survives without any service); additionally forwards to wandb when
+    enabled and importable.
+    """
+
+    def __init__(
+        self,
+        enabled: bool = False,
+        project: str = "genrec_tpu",
+        config: Mapping[str, Any] | None = None,
+        save_dir: str | None = None,
+    ):
+        self._wandb = None
+        self._file = None
+        if save_dir:
+            os.makedirs(save_dir, exist_ok=True)
+            self._file = open(os.path.join(save_dir, "metrics.jsonl"), "a")
+        if enabled:
+            try:
+                import wandb
+
+                wandb.init(project=project, config=dict(config or {}))
+                wandb.define_metric("train/*", step_metric="global_step")
+                wandb.define_metric("eval/*", step_metric="epoch")
+                self._wandb = wandb
+            except Exception:
+                self._wandb = None
+
+    def log(self, metrics: Mapping[str, Any]) -> None:
+        payload = {k: (float(v) if hasattr(v, "__float__") else v) for k, v in metrics.items()}
+        if self._file:
+            self._file.write(json.dumps({"t": time.time(), **payload}) + "\n")
+            self._file.flush()
+        if self._wandb:
+            self._wandb.log(payload)
+
+    def finish(self) -> None:
+        if self._file:
+            self._file.close()
+            self._file = None
+        if self._wandb:
+            self._wandb.finish()

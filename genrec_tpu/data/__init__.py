@@ -1,0 +1,14 @@
+"""Data layer: NumPy/CPU pipelines feeding fixed-shape device batches.
+
+The reference's data layer (genrec/data/, SURVEY.md §2.3) downloads Amazon
+Reviews 2014, builds leave-one-out splits, and collates with per-batch
+dynamic padding. Here the host side stays NumPy but every batch has a
+STATIC shape (padded to max_seq_len) — per-batch max-length padding is
+recompilation poison for XLA (SURVEY.md §7 "static shapes everywhere").
+"""
+
+from genrec_tpu.data.schemas import SeqBatch
+from genrec_tpu.data.batching import batch_iterator, pad_to_batch
+from genrec_tpu.data.synthetic import SyntheticSeqDataset
+
+__all__ = ["SeqBatch", "batch_iterator", "pad_to_batch", "SyntheticSeqDataset"]

@@ -1,0 +1,57 @@
+"""Static-shape batching over in-memory numpy datasets.
+
+Replaces torch DataLoader + per-batch-max collate functions
+(amazon_sasrec.py:125-161 etc.). Every batch is exactly (batch_size, ...)
+— the final partial batch is padded with zero rows and reported through a
+``valid`` mask so eval never counts phantom samples and jit never sees a
+new shape.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Mapping
+
+import numpy as np
+
+
+def pad_to_batch(arrays: Mapping[str, np.ndarray], batch_size: int):
+    """Pad dict-of-arrays (same leading dim) up to batch_size; returns
+    (padded, valid_mask)."""
+    n = next(iter(arrays.values())).shape[0]
+    pad = batch_size - n
+    out = {}
+    for k, v in arrays.items():
+        if pad > 0:
+            padding = np.zeros((pad,) + v.shape[1:], v.dtype)
+            out[k] = np.concatenate([v, padding], axis=0)
+        else:
+            out[k] = v
+    valid = np.zeros((batch_size,), bool)
+    valid[:n] = True
+    return out, valid
+
+
+def batch_iterator(
+    arrays: Mapping[str, np.ndarray],
+    batch_size: int,
+    *,
+    shuffle: bool = False,
+    seed: int = 0,
+    drop_last: bool = False,
+    epoch: int = 0,
+) -> Iterator[tuple[dict, np.ndarray]]:
+    """Yield (batch_dict, valid_mask) of fixed shape (batch_size, ...).
+
+    Shuffling is deterministic in (seed, epoch) so every data-parallel
+    process draws the same permutation and shards it consistently.
+    """
+    n = next(iter(arrays.values())).shape[0]
+    idx = np.arange(n)
+    if shuffle:
+        idx = np.random.default_rng((seed, epoch)).permutation(n)
+    for start in range(0, n, batch_size):
+        sel = idx[start : start + batch_size]
+        if len(sel) < batch_size and drop_last:
+            return
+        chunk = {k: v[sel] for k, v in arrays.items()}
+        yield pad_to_batch(chunk, batch_size)

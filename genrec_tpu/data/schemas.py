@@ -1,0 +1,24 @@
+"""Batch schemas (reference genrec/data/schemas.py:7-36, as plain NamedTuples
+of numpy/jax arrays — pytree-compatible so they pass straight through jit)."""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+
+class SeqBatch(NamedTuple):
+    """A fixed-shape sequence batch.
+
+    input_ids: (B, L) int32, 0 = padding (left-padded)
+    targets:   (B, L) int32 shifted next-item targets for training,
+               or (B, 1) single held-out target for eval
+    timestamps: optional (B, L) int64 (HSTU)
+    user_ids:  optional (B,) int32
+    """
+
+    input_ids: np.ndarray
+    targets: np.ndarray
+    timestamps: Optional[np.ndarray] = None
+    user_ids: Optional[np.ndarray] = None

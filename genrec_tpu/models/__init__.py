@@ -1,0 +1,9 @@
+"""Model zoo: Flax re-designs of the reference's seven model families.
+
+Parity map (reference genrec/models/__init__.py:18-33):
+SASRec, HSTU, RqVae (+QuantizeForwardMode), Tiger, LCRec, Cobra, NoteLLM.
+"""
+
+from genrec_tpu.models.sasrec import SASRec
+
+__all__ = ["SASRec"]

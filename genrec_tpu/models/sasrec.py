@@ -1,0 +1,155 @@
+"""SASRec: self-attentive next-item baseline (arXiv:1808.09781).
+
+Behavioral parity with reference genrec/models/sasrec.py (itself faithful
+to the official TF implementation). The quirks that matter for metric
+parity, all reproduced here:
+
+1. item embedding scaled by sqrt(d); position embedding not scaled
+   (sasrec.py:100-106)
+2. padding (id 0) positions zeroed after embedding AND after every block
+   (sasrec.py:110-118)
+3. attention: Q from pre-normed x, K/V from raw x (sasrec.py:152-158);
+   key-mask with -1e9 before softmax; causal -1e9; query-mask applied
+   AFTER softmax (sasrec.py:218-237); residual adds the NORMED query
+   (sasrec.py:243-246)
+4. FFN: relu MLP, dropout after each linear, residual adds raw x
+   (sasrec.py:249-266)
+5. logits = x @ item_embedding.T over the full vocab (sasrec.py:121);
+   CE ignore_index=0, mean over valid tokens (sasrec.py:124-128)
+
+TPU notes: the whole forward is static-shape (fixed max_seq_len), bf16-safe
+(fp32 softmax/CE), and one jit unit; the full-vocab logits matmul is the
+dominant MXU op.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from genrec_tpu.ops.losses import cross_entropy_with_ignore
+
+_NEG = -1e9
+
+
+class _Attention(nn.Module):
+    embed_dim: int
+    num_heads: int
+    dropout: float
+
+    @nn.compact
+    def __call__(self, query, key_value, mask, deterministic: bool):
+        B, L, D = query.shape
+        H = self.num_heads
+        hd = D // H
+        dense = lambda name: nn.Dense(D, name=name)  # bias=True as reference
+        q = dense("q_proj")(query).reshape(B, L, H, hd).transpose(0, 2, 1, 3)
+        k = dense("k_proj")(key_value).reshape(B, L, H, hd).transpose(0, 2, 1, 3)
+        v = dense("v_proj")(key_value).reshape(B, L, H, hd).transpose(0, 2, 1, 3)
+
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * (hd**-0.5)
+        key_mask = mask[:, None, None, :, 0]  # (B,1,1,L)
+        scores = jnp.where(key_mask == 0, _NEG, scores)
+        causal = jnp.triu(jnp.ones((L, L), bool), k=1)
+        scores = jnp.where(causal[None, None], _NEG, scores)
+
+        attn = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(query.dtype)
+        # Query-side mask after softmax — official-impl quirk.
+        attn = attn * mask[:, None]  # (B,1,L,1) broadcast over heads/keys
+        attn = nn.Dropout(self.dropout)(attn, deterministic=deterministic)
+
+        out = jnp.einsum("bhqk,bhkd->bhqd", attn, v)
+        out = out.transpose(0, 2, 1, 3).reshape(B, L, D)
+        # Residual adds the normed query (not raw x).
+        return out + query
+
+
+class _FFN(nn.Module):
+    embed_dim: int
+    ffn_dim: int
+    dropout: float
+
+    @nn.compact
+    def __call__(self, x, residual, deterministic: bool):
+        h = nn.Dense(self.ffn_dim, name="fc1")(x)
+        h = nn.Dropout(self.dropout)(nn.relu(h), deterministic=deterministic)
+        h = nn.Dense(self.embed_dim, name="fc2")(h)
+        h = nn.Dropout(self.dropout)(h, deterministic=deterministic)
+        return h + residual
+
+
+class SASRecBlock(nn.Module):
+    embed_dim: int
+    num_heads: int
+    ffn_dim: int
+    dropout: float
+
+    @nn.compact
+    def __call__(self, x, mask, deterministic: bool):
+        normed = nn.LayerNorm(epsilon=1e-8, name="norm1")(x)
+        x = _Attention(self.embed_dim, self.num_heads, self.dropout, name="attention")(
+            normed, x, mask, deterministic
+        )
+        normed = nn.LayerNorm(epsilon=1e-8, name="norm2")(x)
+        x = _FFN(self.embed_dim, self.ffn_dim, self.dropout, name="ffn")(
+            normed, x, deterministic
+        )
+        return x
+
+
+class SASRec(nn.Module):
+    num_items: int
+    max_seq_len: int = 50
+    embed_dim: int = 64
+    num_heads: int = 2
+    num_blocks: int = 2
+    ffn_dim: int = 256
+    dropout: float = 0.2
+
+    def setup(self):
+        xavier = nn.initializers.xavier_uniform()
+        self.item_embedding = self.param(
+            "item_embedding", xavier, (self.num_items + 1, self.embed_dim)
+        )
+        self.position_embedding = self.param(
+            "position_embedding", xavier, (self.max_seq_len, self.embed_dim)
+        )
+        self.blocks = [
+            SASRecBlock(
+                self.embed_dim, self.num_heads, self.ffn_dim, self.dropout,
+                name=f"block_{i}",
+            )
+            for i in range(self.num_blocks)
+        ]
+        self.final_norm = nn.LayerNorm(epsilon=1e-8, name="final_norm")
+        self.emb_dropout = nn.Dropout(self.dropout)
+
+    def __call__(self, input_ids, targets=None, deterministic: bool = True):
+        B, L = input_ids.shape
+        mask = (input_ids != 0)[..., None].astype(self.item_embedding.dtype)
+
+        x = self.item_embedding[input_ids] * (self.embed_dim**0.5)
+        x = x + self.position_embedding[None, :L]
+        x = self.emb_dropout(x, deterministic=deterministic)
+        x = x * mask
+
+        for block in self.blocks:
+            x = block(x, mask, deterministic)
+            x = x * mask  # re-mask after every block (official-impl quirk)
+
+        x = self.final_norm(x)
+        logits = x @ self.item_embedding.T  # (B, L, V+1)
+
+        loss = None
+        if targets is not None:
+            per_tok, valid = cross_entropy_with_ignore(logits, targets, ignore_index=0)
+            loss = per_tok.sum() / jnp.maximum(valid.sum(), 1.0)
+        return logits, loss
+
+    def predict(self, input_ids, top_k: int = 10):
+        """Top-k next items from the last position; pad id excluded."""
+        logits, _ = self(input_ids, deterministic=True)
+        last = logits[:, -1, :].at[:, 0].set(-jnp.inf)
+        _, items = jax.lax.top_k(last, top_k)
+        return items

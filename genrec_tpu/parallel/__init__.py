@@ -1,0 +1,27 @@
+"""SPMD runtime: mesh construction, sharding helpers, collectives.
+
+TPU-native replacement for the reference's HF Accelerate / torch.distributed
+stack (SURVEY.md §2.5, §5.8). The three collective patterns the reference
+uses — gradient all-reduce, metric reduction, barrier — map to: XLA-inserted
+psum from sharded jit, `metric_allreduce`, and `barrier`.
+"""
+
+from genrec_tpu.parallel.mesh import (
+    distributed_init,
+    get_mesh,
+    make_mesh,
+    shard_batch,
+    replicate,
+    metric_allreduce,
+    barrier,
+)
+
+__all__ = [
+    "distributed_init",
+    "get_mesh",
+    "make_mesh",
+    "shard_batch",
+    "replicate",
+    "metric_allreduce",
+    "barrier",
+]

@@ -1,0 +1,91 @@
+"""Device mesh + sharding utilities.
+
+The design (SURVEY.md §2.5): a named `jax.sharding.Mesh` whose axes carry the
+parallelism strategy — "data" for DP (the only strategy the reference has),
+with room for "model" (TP), "pipe" (PP) and "seq" (SP) axes that the
+reference lacks entirely. Params are replicated (or sharded on "model"),
+batches sharded on "data"; XLA emits the gradient psum over ICI from the
+sharded jit — no NCCL/MPI analog exists anywhere in this stack.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Mapping
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def distributed_init() -> None:
+    """Initialize multi-host JAX if launched in a multi-process environment.
+
+    Replaces `Accelerator(...)` process-group setup (reference
+    tiger_trainer.py:124-128). Single-process runs are a no-op, so trainers
+    call this unconditionally.
+    """
+    if int(os.environ.get("JAX_PROCESS_COUNT", "1")) > 1 or "JAX_COORDINATOR_ADDRESS" in os.environ:
+        jax.distributed.initialize()
+
+
+def make_mesh(shape: Mapping[str, int] | None = None, devices=None) -> Mesh:
+    """Build a named mesh. ``shape`` maps axis name -> size; one axis may be
+    -1 (inferred). Default: all devices on a single "data" axis."""
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    if not shape:
+        shape = {"data": n}
+    names = list(shape.keys())
+    sizes = list(shape.values())
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        sizes[sizes.index(-1)] = n // known
+    total = int(np.prod(sizes))
+    if total != n:
+        raise ValueError(f"mesh shape {dict(zip(names, sizes))} != {n} devices")
+    dev_array = np.asarray(devices).reshape(sizes)
+    return Mesh(dev_array, tuple(names))
+
+
+def get_mesh(data_axis: str = "data") -> Mesh:
+    """The default 1-axis data-parallel mesh over every local device."""
+    return make_mesh({data_axis: len(jax.devices())})
+
+
+def shard_batch(mesh: Mesh, batch: Any, axis: str = "data") -> Any:
+    """Place a host batch pytree with its leading dim sharded over ``axis``."""
+    def place(x):
+        x = np.asarray(x)
+        spec = P(axis, *([None] * (x.ndim - 1)))
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map(place, batch)
+
+
+def replicate(mesh: Mesh, tree: Any) -> Any:
+    """Fully replicate a pytree (params/opt state) across the mesh."""
+    sharding = NamedSharding(mesh, P())
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), tree)
+
+
+def metric_allreduce(tree: Any) -> Any:
+    """Sum metric scalars across processes (reference `accelerator.reduce`
+    sum-gather, sasrec_trainer.py:75-82). Within one process the devices
+    already reduced via the sharded jit; this covers multi-host."""
+    if jax.process_count() == 1:
+        return tree
+    from jax.experimental import multihost_utils
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    stacked = np.asarray([float(v) for v in leaves], np.float64)
+    summed = multihost_utils.process_allgather(stacked).sum(axis=0)
+    return jax.tree_util.tree_unflatten(treedef, [float(v) for v in summed])
+
+
+def barrier(name: str = "barrier") -> None:
+    """Cross-host sync point (reference `accelerator.wait_for_everyone`)."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(name)

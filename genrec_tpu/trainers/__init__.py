@@ -1,0 +1,8 @@
+"""Trainers: one gin-configurable `train()` per model family.
+
+Layout mirrors the reference (genrec/trainers/__init__.py:1-25): each
+trainer is a self-contained script invoked as
+``python -m genrec_tpu.trainers.<x>_trainer <config.gin> [--split ...]``,
+but the loop body is a single jitted SPMD step from core.harness instead
+of an Accelerate-wrapped torch loop.
+"""
