@@ -18,6 +18,7 @@ from typing import Any, Mapping
 
 def setup_logger(save_dir: str | None = None, name: str = "genrec_tpu") -> logging.Logger:
     logger = logging.getLogger(name)
+    logger.propagate = False  # avoid duplicate lines via the root logger
     if logger.handlers:
         return logger
     logger.setLevel(logging.INFO)

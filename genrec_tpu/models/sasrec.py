@@ -37,13 +37,14 @@ class _Attention(nn.Module):
     embed_dim: int
     num_heads: int
     dropout: float
+    dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, query, key_value, mask, deterministic: bool):
         B, L, D = query.shape
         H = self.num_heads
         hd = D // H
-        dense = lambda name: nn.Dense(D, name=name)  # bias=True as reference
+        dense = lambda name: nn.Dense(D, name=name, dtype=self.dtype)  # bias=True as reference
         q = dense("q_proj")(query).reshape(B, L, H, hd).transpose(0, 2, 1, 3)
         k = dense("k_proj")(key_value).reshape(B, L, H, hd).transpose(0, 2, 1, 3)
         v = dense("v_proj")(key_value).reshape(B, L, H, hd).transpose(0, 2, 1, 3)
@@ -69,12 +70,13 @@ class _FFN(nn.Module):
     embed_dim: int
     ffn_dim: int
     dropout: float
+    dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x, residual, deterministic: bool):
-        h = nn.Dense(self.ffn_dim, name="fc1")(x)
+        h = nn.Dense(self.ffn_dim, name="fc1", dtype=self.dtype)(x)
         h = nn.Dropout(self.dropout)(nn.relu(h), deterministic=deterministic)
-        h = nn.Dense(self.embed_dim, name="fc2")(h)
+        h = nn.Dense(self.embed_dim, name="fc2", dtype=self.dtype)(h)
         h = nn.Dropout(self.dropout)(h, deterministic=deterministic)
         return h + residual
 
@@ -84,16 +86,18 @@ class SASRecBlock(nn.Module):
     num_heads: int
     ffn_dim: int
     dropout: float
+    dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x, mask, deterministic: bool):
-        normed = nn.LayerNorm(epsilon=1e-8, name="norm1")(x)
-        x = _Attention(self.embed_dim, self.num_heads, self.dropout, name="attention")(
-            normed, x, mask, deterministic
-        )
-        normed = nn.LayerNorm(epsilon=1e-8, name="norm2")(x)
-        x = _FFN(self.embed_dim, self.ffn_dim, self.dropout, name="ffn")(
-            normed, x, deterministic
+        # LayerNorm statistics stay fp32 (autocast-equivalent).
+        normed = nn.LayerNorm(epsilon=1e-8, name="norm1", dtype=jnp.float32)(x)
+        x = _Attention(
+            self.embed_dim, self.num_heads, self.dropout, self.dtype, name="attention"
+        )(normed.astype(self.dtype), x.astype(self.dtype), mask, deterministic)
+        normed = nn.LayerNorm(epsilon=1e-8, name="norm2", dtype=jnp.float32)(x)
+        x = _FFN(self.embed_dim, self.ffn_dim, self.dropout, self.dtype, name="ffn")(
+            normed.astype(self.dtype), x, deterministic
         )
         return x
 
@@ -106,6 +110,9 @@ class SASRec(nn.Module):
     num_blocks: int = 2
     ffn_dim: int = 256
     dropout: float = 0.2
+    # Compute dtype (bf16 for TPU mixed precision); params stay fp32 and
+    # softmax/CE/LayerNorm statistics are always fp32.
+    dtype: jnp.dtype = jnp.float32
 
     def setup(self):
         xavier = nn.initializers.xavier_uniform()
@@ -118,19 +125,19 @@ class SASRec(nn.Module):
         self.blocks = [
             SASRecBlock(
                 self.embed_dim, self.num_heads, self.ffn_dim, self.dropout,
-                name=f"block_{i}",
+                self.dtype, name=f"block_{i}",
             )
             for i in range(self.num_blocks)
         ]
-        self.final_norm = nn.LayerNorm(epsilon=1e-8, name="final_norm")
+        self.final_norm = nn.LayerNorm(epsilon=1e-8, name="final_norm", dtype=jnp.float32)
         self.emb_dropout = nn.Dropout(self.dropout)
 
     def __call__(self, input_ids, targets=None, deterministic: bool = True):
         B, L = input_ids.shape
-        mask = (input_ids != 0)[..., None].astype(self.item_embedding.dtype)
+        mask = (input_ids != 0)[..., None].astype(self.dtype)
 
-        x = self.item_embedding[input_ids] * (self.embed_dim**0.5)
-        x = x + self.position_embedding[None, :L]
+        x = self.item_embedding[input_ids].astype(self.dtype) * (self.embed_dim**0.5)
+        x = x + self.position_embedding[None, :L].astype(self.dtype)
         x = self.emb_dropout(x, deterministic=deterministic)
         x = x * mask
 
@@ -139,7 +146,7 @@ class SASRec(nn.Module):
             x = x * mask  # re-mask after every block (official-impl quirk)
 
         x = self.final_norm(x)
-        logits = x @ self.item_embedding.T  # (B, L, V+1)
+        logits = x.astype(self.dtype) @ self.item_embedding.T.astype(self.dtype)  # (B, L, V+1)
 
         loss = None
         if targets is not None:
@@ -150,6 +157,6 @@ class SASRec(nn.Module):
     def predict(self, input_ids, top_k: int = 10):
         """Top-k next items from the last position; pad id excluded."""
         logits, _ = self(input_ids, deterministic=True)
-        last = logits[:, -1, :].at[:, 0].set(-jnp.inf)
+        last = logits[:, -1, :].astype(jnp.float32).at[:, 0].set(-jnp.inf)
         _, items = jax.lax.top_k(last, top_k)
         return items

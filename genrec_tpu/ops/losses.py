@@ -87,7 +87,6 @@ def info_nce(
     """
     logits = (query @ keys.T) / temperature  # (N, M)
     if neg_mask is not None:
-        n = query.shape[0]
         pos_onehot = jax.nn.one_hot(positive_idx, keys.shape[0], dtype=bool)
         drop = jnp.logical_and(neg_mask, ~pos_onehot)
         logits = jnp.where(drop, -1e9, logits)

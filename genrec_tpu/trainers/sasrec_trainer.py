@@ -49,8 +49,7 @@ def make_eval_step(model):
     return eval_step
 
 
-def evaluate(model, params, arrays, batch_size, mesh) -> dict[str, float]:
-    eval_step = make_eval_step(model)
+def evaluate(eval_step, params, arrays, batch_size, mesh) -> dict[str, float]:
     sums: dict[str, float] = {}
     for batch, valid in batch_iterator(arrays, batch_size):
         sharded = shard_batch(mesh, {**batch, "valid": valid.astype(np.int32)})
@@ -115,6 +114,9 @@ def train(
         valid_arrays = ds.eval_arrays("valid")
         test_arrays = ds.eval_arrays("test")
 
+    compute_dtype = (
+        jnp.bfloat16 if (amp and mixed_precision_type == "bf16") else jnp.float32
+    )
     model = SASRec(
         num_items=n_items,
         max_seq_len=max_seq_len,
@@ -123,6 +125,7 @@ def train(
         num_blocks=num_blocks,
         ffn_dim=ffn_dim,
         dropout=dropout,
+        dtype=compute_dtype,
     )
     rng = jax.random.key(seed)
     init_rng, state_rng = jax.random.split(rng)
@@ -149,6 +152,11 @@ def train(
 
     step_fn = jax.jit(make_train_step(loss_fn, optimizer, clip_norm=None), donate_argnums=0)
     state = replicate(mesh, TrainState.create(params, optimizer, state_rng))
+    eval_step = make_eval_step(model)  # one jit cache for every eval call
+
+    from genrec_tpu.core.checkpoint import CheckpointManager, save_params
+
+    ckpt_mgr = CheckpointManager(os.path.join(save_dir_root, "checkpoints")) if save_dir_root else None
 
     global_step = 0
     best_recall = -1.0
@@ -168,8 +176,11 @@ def train(
                 )
         logger.info(f"epoch {epoch} loss {epoch_loss / max(n_batches,1):.4f}")
 
+        if ckpt_mgr is not None and (epoch + 1) % save_every_epoch == 0:
+            ckpt_mgr.save(epoch, jax.tree_util.tree_map(np.asarray, state.params))
+
         if do_eval and (epoch + 1) % eval_every_epoch == 0:
-            m = evaluate(model, state.params, valid_arrays, eval_batch_size, mesh)
+            m = evaluate(eval_step, state.params, valid_arrays, eval_batch_size, mesh)
             logger.info(
                 f"epoch {epoch} valid " + ", ".join(f"{k}={v:.4f}" for k, v in m.items())
             )
@@ -179,15 +190,15 @@ def train(
                 best_params = jax.tree_util.tree_map(np.asarray, state.params)
 
     final_params = state.params if best_params is None else best_params
-    valid_metrics = evaluate(model, final_params, valid_arrays, eval_batch_size, mesh)
-    test_metrics = evaluate(model, final_params, test_arrays, eval_batch_size, mesh)
+    valid_metrics = evaluate(eval_step, final_params, valid_arrays, eval_batch_size, mesh)
+    test_metrics = evaluate(eval_step, final_params, test_arrays, eval_batch_size, mesh)
     logger.info("test " + ", ".join(f"{k}={v:.4f}" for k, v in test_metrics.items()))
     tracker.log({f"test/{k}": v for k, v in test_metrics.items()})
 
     if save_dir_root:
-        from genrec_tpu.core.checkpoint import save_params
-
         save_params(os.path.join(save_dir_root, "best_model"), final_params)
+    if ckpt_mgr is not None:
+        ckpt_mgr.close()
     tracker.finish()
     return valid_metrics, test_metrics
 

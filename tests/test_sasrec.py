@@ -162,6 +162,21 @@ def test_grad_clip_caps_update_norm():
     assert float(m["grad_norm"]) > 0.5  # pre-clip norm reported
 
 
+def test_bf16_forward_close_to_fp32():
+    kw = dict(num_items=30, max_seq_len=8, embed_dim=16, num_heads=2,
+              num_blocks=1, ffn_dim=32, dropout=0.0)
+    m32 = SASRec(**kw)
+    m16 = SASRec(**kw, dtype=jnp.bfloat16)
+    params = m32.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    ids = np.random.default_rng(0).integers(1, 31, (4, 8)).astype(np.int32)
+    l32, _ = m32.apply({"params": params}, jnp.asarray(ids))
+    l16, _ = m16.apply({"params": params}, jnp.asarray(ids))
+    assert l16.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(l16, np.float32), np.asarray(l32), atol=0.15
+    )
+
+
 def test_checkpoint_roundtrip(tmp_path):
     from genrec_tpu.core.checkpoint import save_params, load_params
 
