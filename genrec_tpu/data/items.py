@@ -1,0 +1,136 @@
+"""Item-embedding datasets for RQ-VAE training.
+
+Parity target: reference genrec/data/amazon.py:84-239 (AmazonItemDataset —
+item text formatted as 'title':.. 'price':.. etc., encoded with a
+SentenceTransformer, cached to parquet, deterministic 95/5 train/eval
+split with a seed-42 generator).
+
+Here the text->embedding step is a separate one-time preprocessing
+(`encode_item_texts`, runs wherever a sentence-T5 model is available) and
+training consumes a cached .npy, so the trainer itself has no torch/HF
+dependency. A synthetic clustered generator stands in when no real
+embeddings exist (zero-egress CI).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+
+def train_eval_split(n: int, eval_frac: float = 0.05, seed: int = 42):
+    """Deterministic 95/5 split (same protocol as amazon.py:221-233)."""
+    rng = np.random.default_rng(seed)
+    perm = rng.permutation(n)
+    n_eval = int(n * eval_frac)
+    return perm[n_eval:], perm[:n_eval]
+
+
+class SyntheticItemEmbeddings:
+    """Clustered unit-norm embeddings: k-means-friendly structure so
+    RQ-VAE training/collision metrics behave like real data."""
+
+    def __init__(
+        self,
+        num_items: int = 2000,
+        dim: int = 768,
+        n_clusters: int = 32,
+        noise: float = 0.2,
+        seed: int = 0,
+    ):
+        rng = np.random.default_rng(seed)
+        centers = rng.normal(size=(n_clusters, dim))
+        centers /= np.linalg.norm(centers, axis=-1, keepdims=True)
+        assign = rng.integers(0, n_clusters, num_items)
+        x = centers[assign] + noise * rng.normal(size=(num_items, dim))
+        x /= np.linalg.norm(x, axis=-1, keepdims=True)
+        self.embeddings = x.astype(np.float32)
+
+    def arrays(self):
+        tr, ev = train_eval_split(len(self.embeddings))
+        return self.embeddings[tr], self.embeddings[ev]
+
+
+class ItemEmbeddingData:
+    """Cached item embeddings from ``<root>/processed/<split>_item_emb.npy``."""
+
+    def __init__(self, root: str, split: str):
+        path = os.path.join(root, "processed", f"{split}_item_emb.npy")
+        if not os.path.exists(path):
+            raise FileNotFoundError(
+                f"item embeddings not found at {path}; run "
+                f"genrec_tpu.data.items.encode_item_texts first (requires a "
+                f"local sentence-T5 model) or provide the file."
+            )
+        self.embeddings = np.load(path).astype(np.float32)
+
+    def arrays(self):
+        tr, ev = train_eval_split(len(self.embeddings))
+        return self.embeddings[tr], self.embeddings[ev]
+
+
+def format_item_text(meta: dict) -> str:
+    """Item text template — byte-for-byte the reference's layout
+    (amazon.py:198-204): newline-joined, all five keys always present,
+    missing values rendered as empty strings."""
+    return (
+        f"'title':{meta.get('title', '')}\n"
+        f" 'price':{meta.get('price', '')}\n"
+        f" 'salesRank':{meta.get('salesRank', '')}\n"
+        f" 'brand':{meta.get('brand', '')}\n"
+        f" 'categories':{meta.get('categories', '')}"
+    )
+
+
+def encode_item_texts(
+    root: str,
+    split: str,
+    model_name: str = "sentence-transformers/sentence-t5-xl",
+    batch_size: int = 64,
+) -> str:
+    """One-time preprocessing: meta gz -> formatted text -> embeddings .npy.
+
+    Requires `transformers` + a locally available T5 encoder. Kept out of
+    the training path so trainers never import torch.
+    """
+    from genrec_tpu.data.amazon import DATASET_FILES, parse_gzip_json
+
+    meta_path = os.path.join(root, "raw", split, DATASET_FILES[split]["meta"])
+    reviews_path = os.path.join(root, "raw", split, DATASET_FILES[split]["reviews"])
+
+    # Rebuild the asin->id map exactly as load_sequences does so row i of
+    # the output matches item id i+1.
+    item_ids: dict[str, int] = {}
+    for r in parse_gzip_json(reviews_path):
+        asin, uid = r.get("asin"), r.get("reviewerID")
+        if asin and uid and asin not in item_ids:
+            item_ids[asin] = len(item_ids) + 1
+
+    metas = {r.get("asin"): r for r in parse_gzip_json(meta_path) if r.get("asin")}
+    texts = [""] * len(item_ids)
+    for asin, iid in item_ids.items():
+        texts[iid - 1] = format_item_text(metas.get(asin, {}))
+
+    # The reference uses SentenceTransformer.encode (amazon.py:192-205),
+    # whose sentence-t5 pipeline is encoder -> mean-pool -> Dense(d->768)
+    # -> L2-normalize. Raw T5EncoderModel pooling would give the wrong
+    # dimension (1024 for -xl) and unnormalized vectors, so the full
+    # pipeline is required here.
+    try:
+        from sentence_transformers import SentenceTransformer
+    except ImportError as e:
+        raise ImportError(
+            "encode_item_texts requires sentence-transformers (for the "
+            "pooling+Dense+normalize head of sentence-t5); alternatively "
+            f"precompute embeddings elsewhere and save them to "
+            f"{os.path.join(root, 'processed', f'{split}_item_emb.npy')}"
+        ) from e
+
+    st = SentenceTransformer(model_name)
+    emb = st.encode(texts, batch_size=batch_size, show_progress_bar=False)
+    emb = np.asarray(emb, np.float32)
+    out_path = os.path.join(root, "processed", f"{split}_item_emb.npy")
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    np.save(out_path, emb)
+    return out_path

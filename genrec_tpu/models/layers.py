@@ -1,0 +1,51 @@
+"""Shared Flax layers."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from genrec_tpu.ops.normalize import l2norm
+
+
+class MLP(nn.Module):
+    """Bias-free SiLU MLP with optional L2-normalized output.
+
+    Parity: reference genrec/modules/encoder.py:380-420 (RQ-VAE's
+    encoder/decoder stack).
+    """
+
+    hidden_dims: Sequence[int]
+    out_dim: int
+    dropout: float = 0.0
+    normalize: bool = False
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        dims = list(self.hidden_dims) + [self.out_dim]
+        for i, d in enumerate(dims):
+            x = nn.Dense(d, use_bias=False, dtype=self.dtype, name=f"dense_{i}")(x)
+            if i != len(dims) - 1:
+                x = nn.silu(x)
+                if self.dropout:
+                    x = nn.Dropout(self.dropout)(x, deterministic=deterministic)
+        if self.normalize:
+            x = l2norm(x)
+        return x
+
+
+class RMSNorm(nn.Module):
+    """T5-style RMS norm layer (fp32 statistics) over the last axis."""
+
+    dim: int
+    eps: float = 1e-6
+
+    @nn.compact
+    def __call__(self, x):
+        from genrec_tpu.ops.normalize import rms_norm
+
+        weight = self.param("weight", nn.initializers.ones, (self.dim,))
+        return rms_norm(x, weight, self.eps)

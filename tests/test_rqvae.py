@@ -1,0 +1,208 @@
+"""RQ-VAE parity + behavior tests (goldens from the reference torch impl)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from genrec_tpu.models.rqvae import (
+    QuantizeForwardMode,
+    RqVae,
+    count_distinct_fraction,
+    kmeans_init_params,
+    sinkhorn_knopp,
+)
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "data", "rqvae_golden.npz")
+
+
+def _build(last_mode=QuantizeForwardMode.SINKHORN, mode=QuantizeForwardMode.STE):
+    return RqVae(
+        input_dim=16, embed_dim=8, hidden_dims=(12,), codebook_size=16,
+        codebook_mode=mode, codebook_last_layer_mode=last_mode,
+        n_layers=3, commitment_weight=0.25, n_cat_features=0,
+    )
+
+
+def _params_from_golden(g):
+    w = {k[2:]: g[k] for k in g.files if k.startswith("w.")}
+    return {
+        "encoder": {
+            "dense_0": {"kernel": w["encoder.mlp.0.weight"].T},
+            "dense_1": {"kernel": w["encoder.mlp.2.weight"].T},
+        },
+        "decoder": {
+            "dense_0": {"kernel": w["decoder.mlp.0.weight"].T},
+            "dense_1": {"kernel": w["decoder.mlp.2.weight"].T},
+        },
+        **{
+            f"quantize_{i}": {"codebook": w[f"layers.{i}.embedding.weight"]}
+            for i in range(3)
+        },
+    }
+
+
+@pytest.fixture(scope="module")
+def golden():
+    return np.load(GOLDEN)
+
+
+def test_eval_forward_matches_reference(golden):
+    model = _build()
+    params = jax.tree_util.tree_map(jnp.asarray, _params_from_golden(golden))
+    out = model.apply({"params": params}, jnp.asarray(golden["x"]), 0.2, training=False)
+    assert float(out.loss) == pytest.approx(float(golden["eval_loss"]), rel=1e-5)
+    assert float(out.reconstruction_loss) == pytest.approx(float(golden["eval_rec"]), rel=1e-5)
+    assert float(out.rqvae_loss) == pytest.approx(float(golden["eval_vq"]), rel=1e-5)
+
+
+def test_eval_sem_ids_match_reference(golden):
+    model = _build()
+    params = jax.tree_util.tree_map(jnp.asarray, _params_from_golden(golden))
+    out = model.apply(
+        {"params": params}, jnp.asarray(golden["x"]), 0.001,
+        method=RqVae.get_semantic_ids,
+    )
+    np.testing.assert_array_equal(np.asarray(out.sem_ids), golden["sem_ids_eval"])
+
+
+def test_train_sinkhorn_mode_balances_assignments(golden):
+    """Train mode, STE+STE+SINKHORN. No golden comparison here: the
+    reference's f64 linear-space Sinkhorn does not converge (see
+    sinkhorn_knopp docstring), so we assert the property the mode exists
+    for — near-uniform codeword usage — instead of its artifact values."""
+    model = _build()
+    params = jax.tree_util.tree_map(jnp.asarray, _params_from_golden(golden))
+    out = model.apply(
+        {"params": params}, jnp.asarray(golden["x"]), 0.2,
+        method=RqVae.get_semantic_ids, training=True,
+        rngs={"gumbel": jax.random.key(0)},
+    )
+    last_ids = np.asarray(out.sem_ids[:, 2])
+    counts = np.bincount(last_ids, minlength=16)
+    # 32 samples over 16 codes, balanced plan -> exactly 2 each.
+    assert counts.max() <= 3 and (counts > 0).sum() >= 14, counts
+    # And the plain argmin assignment (eval mode) is heavily collapsed,
+    # which is exactly why SINKHORN mode exists.
+    eval_out = model.apply(
+        {"params": params}, jnp.asarray(golden["x"]), 0.001,
+        method=RqVae.get_semantic_ids,
+    )
+    eval_counts = np.bincount(np.asarray(eval_out.sem_ids[:, 2]), minlength=16)
+    assert eval_counts.max() > counts.max()
+
+
+def test_train_ste_and_rotation_losses_match_reference(golden):
+    x = jnp.asarray(golden["x"])
+    params = jax.tree_util.tree_map(jnp.asarray, _params_from_golden(golden))
+    ste = _build(last_mode=QuantizeForwardMode.STE)
+    out = ste.apply({"params": params}, x, 0.2, training=True,
+                    rngs={"gumbel": jax.random.key(0)})
+    assert float(out.loss) == pytest.approx(float(golden["ste_loss"]), rel=1e-5)
+
+    rot = _build(last_mode=QuantizeForwardMode.ROTATION_TRICK)
+    out = rot.apply({"params": params}, x, 0.2, training=True,
+                    rngs={"gumbel": jax.random.key(0)})
+    assert float(out.loss) == pytest.approx(float(golden["rot_loss"]), rel=1e-4)
+
+
+def test_ste_gradient_flows_to_encoder_and_codebook():
+    model = _build(last_mode=QuantizeForwardMode.STE)
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(16, 16)), jnp.float32)
+    params = model.init({"params": jax.random.key(0), "gumbel": jax.random.key(1)}, x, 0.2)["params"]
+
+    def loss(p):
+        out = model.apply({"params": p}, x, 0.2, training=True,
+                          rngs={"gumbel": jax.random.key(2)})
+        return out.loss
+
+    g = jax.grad(loss)(params)
+    enc_g = float(jnp.abs(g["encoder"]["dense_0"]["kernel"]).sum())
+    cb_g = float(jnp.abs(g["quantize_0"]["codebook"]).sum())
+    assert enc_g > 0 and cb_g > 0
+
+
+def test_gumbel_mode_runs_and_differs_by_rng():
+    model = _build(mode=QuantizeForwardMode.GUMBEL_SOFTMAX,
+                   last_mode=QuantizeForwardMode.GUMBEL_SOFTMAX)
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(8, 16)), jnp.float32)
+    params = model.init({"params": jax.random.key(0), "gumbel": jax.random.key(1)}, x, 0.2)["params"]
+    o1 = model.apply({"params": params}, x, 0.5, training=True, rngs={"gumbel": jax.random.key(1)})
+    o2 = model.apply({"params": params}, x, 0.5, training=True, rngs={"gumbel": jax.random.key(2)})
+    assert float(o1.loss) != float(o2.loss)
+
+
+def test_sinkhorn_marginals():
+    rng = np.random.default_rng(0)
+    cost = jnp.asarray(rng.normal(size=(64, 16)), jnp.float32)
+    P = sinkhorn_knopp(cost, eps=0.05, max_iter=200)
+    np.testing.assert_allclose(np.asarray(P.sum(axis=1)), np.full(64, 1 / 64), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(P.sum(axis=0)), np.full(16, 1 / 16), atol=1e-4)
+
+
+def test_sinkhorn_log_domain_f32_no_starvation():
+    """At eps=0.003 a linear-space iteration underflows f32 entirely
+    (exp(±333)); the log-domain plan stays finite with exact column
+    marginals and bounded rows."""
+    rng = np.random.default_rng(1)
+    cost = rng.normal(size=(128, 32))
+    cost = (cost - cost.mean()) / (np.abs(cost).max())
+    p_log = np.asarray(sinkhorn_knopp(jnp.asarray(cost, jnp.float32)))
+    assert np.isfinite(p_log).all()
+    np.testing.assert_allclose(p_log.sum(0), np.full(32, 1 / 32), atol=1e-5)
+    # Rows bounded within a small factor of uniform — at eps=0.003 full row
+    # convergence needs >>100 iters, but no row starves.
+    assert p_log.sum(1).min() > 0.25 / 128 and p_log.sum(1).max() < 4 / 128
+
+
+def test_kmeans_init_reduces_quantize_loss():
+    from genrec_tpu.data.items import SyntheticItemEmbeddings
+
+    x = jnp.asarray(SyntheticItemEmbeddings(num_items=512, dim=16, n_clusters=8, seed=0).embeddings)
+    model = RqVae(input_dim=16, embed_dim=8, hidden_dims=(12,), codebook_size=8,
+                  codebook_mode=QuantizeForwardMode.STE,
+                  codebook_last_layer_mode=QuantizeForwardMode.STE,
+                  n_layers=2, n_cat_features=0)
+    params = model.init({"params": jax.random.key(0), "gumbel": jax.random.key(1)}, x[:2], 0.2)["params"]
+    before = model.apply({"params": params}, x, 0.2, training=False)
+    p2 = kmeans_init_params(model, params, x, jax.random.key(3))
+    after = model.apply({"params": p2}, x, 0.2, training=False)
+    assert float(after.rqvae_loss) < float(before.rqvae_loss)
+    # Determinism across "replicas".
+    p3 = kmeans_init_params(model, params, x, jax.random.key(3))
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)), p2, p3
+    )
+
+
+def test_kmeans_init_with_sim_vq_uses_projected_residuals():
+    """With sim_vq the residual for layer i+1 must go through out_proj —
+    installing raw centroids alone would fit layer 1 to wrong residuals."""
+    from genrec_tpu.data.items import SyntheticItemEmbeddings
+
+    x = jnp.asarray(SyntheticItemEmbeddings(num_items=256, dim=16, n_clusters=8, seed=0).embeddings)
+    model = RqVae(input_dim=16, embed_dim=8, hidden_dims=(12,), codebook_size=8,
+                  codebook_sim_vq=True,
+                  codebook_mode=QuantizeForwardMode.STE,
+                  codebook_last_layer_mode=QuantizeForwardMode.STE,
+                  n_layers=2, n_cat_features=0)
+    params = model.init({"params": jax.random.key(0), "gumbel": jax.random.key(1)}, x[:2], 0.2)["params"]
+    p2 = kmeans_init_params(model, params, x, jax.random.key(3))
+    out = model.apply({"params": p2}, x, 0.2, training=False)
+    assert np.isfinite(float(out.loss))
+    # Layer-0 codebook must hold the raw centroids of the encoded input.
+    enc = model.apply({"params": p2}, x, method=RqVae.encode)
+    from genrec_tpu.ops.kmeans import kmeans as ops_kmeans
+
+    key0 = jax.random.split(jax.random.key(3))[1]
+    ref = ops_kmeans(key0, enc, k=8)
+    np.testing.assert_allclose(
+        np.asarray(p2["quantize_0"]["codebook"]), np.asarray(ref.centroids), atol=1e-5
+    )
+
+
+def test_count_distinct_fraction():
+    ids = jnp.asarray([[1, 2], [1, 2], [3, 4], [5, 6]])
+    assert float(count_distinct_fraction(ids)) == pytest.approx(0.75)
