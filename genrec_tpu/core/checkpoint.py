@@ -13,6 +13,7 @@ import os
 from typing import Any
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import orbax.checkpoint as ocp
 
@@ -21,19 +22,44 @@ def _abs(path: str) -> str:
     return os.path.abspath(path)
 
 
+def _is_prng_key(x) -> bool:
+    return isinstance(x, jax.Array) and jnp.issubdtype(x.dtype, jax.dtypes.prng_key)
+
+
+def to_savable(tree: Any) -> Any:
+    """Host numpy copy of a pytree; typed PRNG keys become their uint32 data."""
+
+    def conv(x):
+        if _is_prng_key(x):
+            return np.asarray(jax.random.key_data(x))
+        return np.asarray(x)
+
+    return jax.tree_util.tree_map(conv, tree)
+
+
+def from_savable(saved: Any, like: Any) -> Any:
+    """Re-wrap leaves that were PRNG keys in ``like``."""
+
+    def conv(s, l):
+        if _is_prng_key(l):
+            return jax.random.wrap_key_data(jnp.asarray(s))
+        return s
+
+    return jax.tree_util.tree_map(conv, saved, like)
+
+
 def save_params(path: str, params: Any) -> None:
     """Save a params pytree (host-side, synchronous)."""
-    params = jax.tree_util.tree_map(np.asarray, params)
     ckptr = ocp.StandardCheckpointer()
-    ckptr.save(_abs(path), params, force=True)
+    ckptr.save(_abs(path), to_savable(params), force=True)
     ckptr.wait_until_finished()
 
 
 def load_params(path: str, like: Any | None = None) -> Any:
     ckptr = ocp.StandardCheckpointer()
     if like is not None:
-        like = jax.tree_util.tree_map(np.asarray, like)
-        return ckptr.restore(_abs(path), like)
+        restored = ckptr.restore(_abs(path), to_savable(like))
+        return from_savable(restored, like)
     return ckptr.restore(_abs(path))
 
 
@@ -51,8 +77,7 @@ class CheckpointManager:
         )
 
     def save(self, step: int, state: Any) -> None:
-        state = jax.tree_util.tree_map(np.asarray, state)
-        self._mgr.save(step, args=ocp.args.StandardSave(state))
+        self._mgr.save(step, args=ocp.args.StandardSave(to_savable(state)))
 
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()
@@ -61,8 +86,10 @@ class CheckpointManager:
         step = step if step is not None else self._mgr.latest_step()
         if step is None:
             return None
-        state_like = jax.tree_util.tree_map(np.asarray, state_like)
-        return self._mgr.restore(step, args=ocp.args.StandardRestore(state_like))
+        restored = self._mgr.restore(
+            step, args=ocp.args.StandardRestore(to_savable(state_like))
+        )
+        return from_savable(restored, state_like)
 
     def close(self) -> None:
         self._mgr.close()

@@ -1,0 +1,122 @@
+"""Sequence-of-semantic-ids datasets for TIGER.
+
+Parity target: reference genrec/data/amazon.py:242-479 (AmazonSeqDataset):
+user sequences sorted by timestamp, min length 5; train = sliding window
+over seq[:-2], valid target = seq[-2], test target = seq[-1] (:409-442);
+each history item flattened into its sem-id tuple with token_type = pos %
+sem_id_dim (:459-479). Decoupling change: items are tokenized from the
+portable sem-id artifact (data/sem_ids.py) instead of loading an RQ-VAE
+torch checkpoint inside the dataset constructor (amazon.py:296-313).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class TigerSeqData:
+    """Builds fixed-shape arrays from raw item-id sequences + sem-id table.
+
+    sem_ids: (num_items, D) — row i is the tuple for item id i+1.
+    """
+
+    def __init__(
+        self,
+        sequences: list[np.ndarray],
+        sem_ids: np.ndarray,
+        max_items: int = 20,
+        user_hash_size: int = 10_000,
+    ):
+        self.sequences = sequences
+        self.sem_ids = np.asarray(sem_ids, np.int32)
+        self.max_items = max_items
+        self.D = self.sem_ids.shape[1]
+        self.user_hash_size = user_hash_size
+
+    def _flatten_history(self, items: np.ndarray):
+        """items (<=max_items,) item ids -> left-padded flattened sem ids.
+
+        Returns (input_ids, token_type_ids, seq_mask) of length max_items*D.
+        Padding positions carry id 0 / type 0 / mask 0 (embedding reads the
+        pad row via seq_mask, mirroring the reference's left-pad collate
+        tiger_trainer.py:27-80).
+        """
+        L = self.max_items * self.D
+        ids = np.zeros(L, np.int32)
+        types = np.zeros(L, np.int32)
+        mask = np.zeros(L, np.int32)
+        items = items[-self.max_items :]
+        n = len(items) * self.D
+        flat = self.sem_ids[items - 1].reshape(-1)
+        ids[L - n :] = flat
+        types[L - n :] = np.tile(np.arange(self.D), len(items))
+        mask[L - n :] = 1
+        return ids, types, mask
+
+    def _samples(self, split: str):
+        out_ids, out_types, out_mask, out_user, out_tgt = [], [], [], [], []
+        for u, seq in enumerate(self.sequences):
+            if split == "train":
+                body = seq[:-2]
+                if len(body) < 2:
+                    continue
+                positions = range(1, len(body))
+            elif split == "valid":
+                if len(seq) < 3:
+                    continue
+                body = seq[:-1]
+                positions = [len(body) - 1]
+            else:  # test
+                if len(seq) < 3:
+                    continue
+                body = seq
+                positions = [len(body) - 1]
+            for i in positions:
+                ids, types, mask = self._flatten_history(np.asarray(body[:i]))
+                out_ids.append(ids)
+                out_types.append(types)
+                out_mask.append(mask)
+                out_user.append(u % self.user_hash_size)
+                out_tgt.append(self.sem_ids[body[i] - 1])
+        return {
+            "item_input_ids": np.stack(out_ids),
+            "token_type_ids": np.stack(out_types),
+            "seq_mask": np.stack(out_mask),
+            "user_ids": np.asarray(out_user, np.int32),
+            "target_ids": np.stack(out_tgt),
+        }
+
+    def train_arrays(self):
+        return self._samples("train")
+
+    def eval_arrays(self, split: str = "valid"):
+        return self._samples(split)
+
+    def valid_item_sem_ids(self) -> np.ndarray:
+        """All items' sem-id tuples — the trie's legality source."""
+        return self.sem_ids
+
+
+def synthetic_tiger_data(
+    num_items: int = 200,
+    codebook_size: int = 32,
+    sem_id_dim: int = 3,
+    max_items: int = 10,
+    seed: int = 0,
+    **seq_kwargs,
+):
+    """Synthetic sequences + distinct random sem-id tuples (CI path)."""
+    from genrec_tpu.data.synthetic import SyntheticSeqDataset
+
+    ds = SyntheticSeqDataset(num_items=num_items, seed=seed, **seq_kwargs)
+    rng = np.random.default_rng(seed + 1)
+    seen = set()
+    sem_ids = np.zeros((num_items, sem_id_dim), np.int32)
+    for i in range(num_items):
+        while True:
+            t = tuple(rng.integers(0, codebook_size, sem_id_dim))
+            if t not in seen:
+                seen.add(t)
+                sem_ids[i] = t
+                break
+    return TigerSeqData(ds.sequences, sem_ids, max_items=max_items)

@@ -1,0 +1,51 @@
+"""Semantic-ID and user-ID embedding layers.
+
+Parity target: reference genrec/modules/embedding.py — SemIdEmbedding's
+single table of num_emb*sem_id_dim+1 rows, index = token_type*num_emb + id,
+last slot reserved for padding and pinned to zero (:7-43); UserIdEmbedding
+hashes by modulo then embeds (:46-74).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class SemIdEmbedding(nn.Module):
+    num_embeddings: int
+    sem_ids_dim: int
+    embeddings_dim: int
+    dtype: jnp.dtype = jnp.float32
+
+    @property
+    def padding_idx(self) -> int:
+        return self.num_embeddings * self.sem_ids_dim
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids):
+        table = self.param(
+            "embedding",
+            nn.initializers.normal(stddev=1.0),
+            (self.num_embeddings * self.sem_ids_dim + 1, self.embeddings_dim),
+        )
+        idx = token_type_ids * self.num_embeddings + input_ids
+        emb = table[idx].astype(self.dtype)
+        # torch padding_idx semantics: the pad row reads as zero and
+        # receives no gradient from lookups.
+        return jnp.where((idx == self.padding_idx)[..., None], 0.0, emb)
+
+
+class UserIdEmbedding(nn.Module):
+    num_embeddings: int
+    embeddings_dim: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids):
+        table = self.param(
+            "embedding",
+            nn.initializers.normal(stddev=1.0),
+            (self.num_embeddings, self.embeddings_dim),
+        )
+        return table[input_ids % self.num_embeddings].astype(self.dtype)

@@ -1,0 +1,279 @@
+"""T5-style transformer encoder-decoder (TIGER's backbone).
+
+Parity target: reference genrec/modules/transformer.py — per-layer T5
+relative-bias self-attention (bidirectional log buckets, stored as an
+(n_heads*num_buckets, 1) embedding :77-104), bias-free projections, fused
+kv for self-attention (:72, 122-124), pre-norm blocks with optional
+cross-attention (:256-324), T5 relu FFN, RMS norms with fp32 statistics,
+additive attn-mask + boolean key-padding mask (-1e9 fill :143-151).
+
+TPU notes: all shapes static; softmax in fp32; the (H, Lq, Lk) bias grid is
+computed once per layer from integer buckets — for TIGER's tiny sequences
+XLA fuses it into the attention; longer-sequence models use the Pallas
+fused-bias attention kernel in genrec_tpu.kernels instead.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from genrec_tpu.models.layers import RMSNorm
+from genrec_tpu.ops.buckets import t5_relative_position_bucket
+
+_NEG = -1e9
+
+
+class T5Attention(nn.Module):
+    d_model: int
+    n_heads: int
+    dropout: float = 0.0
+    is_cross_attention: bool = False
+    has_relative_bias: bool = True
+    num_relative_buckets: int = 32
+    max_distance: int = 128
+    dtype: jnp.dtype = jnp.float32
+
+    def setup(self):
+        dense = lambda d, name: nn.Dense(d, use_bias=False, dtype=self.dtype, name=name)
+        self.q = dense(self.d_model, "q")
+        if self.is_cross_attention:
+            self.k = dense(self.d_model, "k")
+            self.v = dense(self.d_model, "v")
+        else:
+            self.kv = dense(2 * self.d_model, "kv")
+        self.o = dense(self.d_model, "o")
+        if self.has_relative_bias and not self.is_cross_attention:
+            # Same storage quirk as the reference: one scalar per
+            # (head, bucket), flattened.
+            self.rel_bias = self.param(
+                "rel_bias",
+                nn.initializers.normal(stddev=0.02),
+                (self.n_heads * self.num_relative_buckets, 1),
+            )
+        self.attn_drop = nn.Dropout(self.dropout)
+
+    def _position_bias(self, q_len: int, k_len: int):
+        ctx = jnp.arange(q_len)[:, None]
+        mem = jnp.arange(k_len)[None, :]
+        buckets = t5_relative_position_bucket(
+            mem - ctx, self.num_relative_buckets, self.max_distance, bidirectional=True
+        )  # (q, k)
+        head_offset = jnp.arange(self.n_heads)[:, None, None] * self.num_relative_buckets
+        idx = buckets[None] + head_offset  # (H, q, k)
+        return self.rel_bias[idx, 0][None]  # (1, H, q, k)
+
+    def __call__(
+        self,
+        query,
+        key=None,
+        value=None,
+        attn_mask=None,
+        key_padding_mask=None,
+        deterministic: bool = True,
+    ):
+        B, Lq, _ = query.shape
+        H, hd = self.n_heads, self.d_model // self.n_heads
+        if self.is_cross_attention:
+            k = self.k(key)
+            v = self.v(value)
+        else:
+            k, v = jnp.split(self.kv(query), 2, axis=-1)
+        q = self.q(query)
+
+        split = lambda x: x.reshape(B, -1, H, hd).transpose(0, 2, 1, 3)
+        q, k, v = split(q), split(k), split(v)
+        Lk = k.shape[2]
+
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * (hd**-0.5)
+        scores = scores.astype(jnp.float32)
+        if self.has_relative_bias and not self.is_cross_attention:
+            scores = scores + self._position_bias(Lq, Lk)
+        if key_padding_mask is not None:  # True = padding
+            scores = jnp.where(key_padding_mask[:, None, None, :], _NEG, scores)
+        if attn_mask is not None:  # additive, (Lq, Lk) or broadcastable
+            scores = scores + attn_mask
+
+        attn = jax.nn.softmax(scores, axis=-1).astype(query.dtype)
+        attn = self.attn_drop(attn, deterministic=deterministic)
+        out = jnp.einsum("bhqk,bhkd->bhqd", attn, v)
+        out = out.transpose(0, 2, 1, 3).reshape(B, Lq, self.d_model)
+        return self.o(out)
+
+
+class T5FeedForward(nn.Module):
+    dim: int
+    hidden_dim: int
+    dropout: float = 0.1
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        x = nn.Dense(self.hidden_dim, use_bias=False, dtype=self.dtype, name="wi")(x)
+        x = nn.relu(x)
+        x = nn.Dropout(self.dropout)(x, deterministic=deterministic)
+        return nn.Dense(self.dim, use_bias=False, dtype=self.dtype, name="wo")(x)
+
+
+class TransformerBlock(nn.Module):
+    dim: int
+    num_heads: int
+    dropout: float = 0.1
+    ff_hidden_dim: int = 2048
+    cross_attn: bool = False
+    dtype: jnp.dtype = jnp.float32
+
+    def setup(self):
+        self.self_attn = T5Attention(
+            self.dim, self.num_heads, self.dropout, dtype=self.dtype, name="self_attn"
+        )
+        self.norm1 = RMSNorm(self.dim, name="norm1")
+        self.drop1 = nn.Dropout(self.dropout)
+        if self.cross_attn:
+            self.cross = T5Attention(
+                self.dim, self.num_heads, self.dropout,
+                is_cross_attention=True, has_relative_bias=False,
+                dtype=self.dtype, name="cross_attn",
+            )
+            self.norm_cross = RMSNorm(self.dim, name="norm_cross")
+            self.drop_cross = nn.Dropout(self.dropout)
+        self.ff = T5FeedForward(self.dim, self.ff_hidden_dim, self.dropout,
+                                dtype=self.dtype, name="ff")
+        self.norm2 = RMSNorm(self.dim, name="norm2")
+        self.drop2 = nn.Dropout(self.dropout)
+
+    def __call__(
+        self,
+        x,
+        context=None,
+        attn_mask=None,
+        key_padding_mask=None,
+        memory_key_padding_mask=None,
+        deterministic: bool = True,
+    ):
+        h = self.self_attn(
+            self.norm1(x),
+            attn_mask=attn_mask,
+            key_padding_mask=key_padding_mask,
+            deterministic=deterministic,
+        )
+        x = x + self.drop1(h, deterministic=deterministic)
+        if self.cross_attn and context is not None:
+            h = self.cross(
+                self.norm_cross(x), key=context, value=context,
+                key_padding_mask=memory_key_padding_mask,
+                deterministic=deterministic,
+            )
+            x = x + self.drop_cross(h, deterministic=deterministic)
+        h = self.ff(self.norm2(x), deterministic=deterministic)
+        return x + self.drop2(h, deterministic=deterministic)
+
+
+class TransformerEncoder(nn.Module):
+    dim: int
+    depth: int
+    num_heads: int
+    dropout: float = 0.1
+    ff_hidden_dim: int = 2048
+    dtype: jnp.dtype = jnp.float32
+
+    def setup(self):
+        self.layers = [
+            TransformerBlock(
+                self.dim, self.num_heads, self.dropout,
+                ff_hidden_dim=self.ff_hidden_dim, cross_attn=False,
+                dtype=self.dtype, name=f"layer_{i}",
+            )
+            for i in range(self.depth)
+        ]
+
+    def __call__(self, src, attn_mask=None, key_padding_mask=None, deterministic=True):
+        for layer in self.layers:
+            src = layer(
+                src, attn_mask=attn_mask, key_padding_mask=key_padding_mask,
+                deterministic=deterministic,
+            )
+        return src
+
+
+class TransformerDecoder(nn.Module):
+    dim: int
+    depth: int
+    num_heads: int
+    dropout: float = 0.1
+    ff_hidden_dim: int = 2048
+    dtype: jnp.dtype = jnp.float32
+
+    def setup(self):
+        self.layers = [
+            TransformerBlock(
+                self.dim, self.num_heads, self.dropout,
+                ff_hidden_dim=self.ff_hidden_dim, cross_attn=True,
+                dtype=self.dtype, name=f"layer_{i}",
+            )
+            for i in range(self.depth)
+        ]
+
+    def __call__(
+        self,
+        tgt,
+        memory,
+        attn_mask=None,
+        key_padding_mask=None,
+        memory_key_padding_mask=None,
+        deterministic=True,
+    ):
+        for layer in self.layers:
+            tgt = layer(
+                tgt, context=memory, attn_mask=attn_mask,
+                key_padding_mask=key_padding_mask,
+                memory_key_padding_mask=memory_key_padding_mask,
+                deterministic=deterministic,
+            )
+        return tgt
+
+
+def causal_mask(T: int) -> jax.Array:
+    """Additive (T, T) mask: -inf above the diagonal."""
+    return jnp.where(jnp.triu(jnp.ones((T, T), bool), k=1), _NEG, 0.0)
+
+
+class TransformerEncoderDecoder(nn.Module):
+    d_model: int
+    nhead: int
+    num_encoder_layers: int
+    num_decoder_layers: int
+    dim_feedforward: int = 2048
+    dropout: float = 0.1
+    dtype: jnp.dtype = jnp.float32
+
+    def setup(self):
+        self.encoder = TransformerEncoder(
+            self.d_model, self.num_encoder_layers, self.nhead, self.dropout,
+            self.dim_feedforward, dtype=self.dtype, name="encoder",
+        )
+        self.decoder = TransformerDecoder(
+            self.d_model, self.num_decoder_layers, self.nhead, self.dropout,
+            self.dim_feedforward, dtype=self.dtype, name="decoder",
+        )
+
+    def __call__(
+        self,
+        src,
+        tgt,
+        src_key_padding_mask=None,
+        memory_key_padding_mask=None,
+        tgt_mask=None,
+        deterministic=True,
+    ):
+        if tgt_mask is None:
+            tgt_mask = causal_mask(tgt.shape[1])
+        memory = self.encoder(
+            src, key_padding_mask=src_key_padding_mask, deterministic=deterministic
+        )
+        return self.decoder(
+            tgt, memory, attn_mask=tgt_mask,
+            memory_key_padding_mask=memory_key_padding_mask,
+            deterministic=deterministic,
+        )

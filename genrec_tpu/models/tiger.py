@@ -1,0 +1,280 @@
+"""TIGER: generative retrieval over semantic IDs (arXiv:2305.05065).
+
+Parity target: reference genrec/models/tiger.py — encoder-decoder over the
+flattened (item, codebook) token stream with a prepended hashed user token
+(:166-173), SemIdEmbedding offset by token type, BOS-started decoder, flat
+vocab = num_item_embeddings*sem_id_dim + 1 with a single output head
+(:146-147), loss = per-sequence SUM of token CE then batch mean (:232-240).
+The unused-but-present parameters of the reference (pos_embedding,
+decoder_pos_embedding, out_proj — their additions are commented out in the
+reference forward :173-176, 181-183) are kept for a matching param surface.
+
+Generation — the north-star redesign (SURVEY.md §7 hard part #1): the
+reference's CPU defaultdict trie + per-(batch, beam) Python masking/rerank
+loops (tiger.py:341-447) become ONE jitted program: dense prefix-legality
+gathers (ops/trie.py), Gumbel-top-k sampling without replacement (exactly
+`torch.multinomial(probs, KK)`'s distribution), and vectorized
+sort-based beam dedup. No host sync inside the decode loop.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from genrec_tpu.models.embeddings import SemIdEmbedding, UserIdEmbedding
+from genrec_tpu.ops.losses import cross_entropy_with_ignore
+from genrec_tpu.models.layers import RMSNorm
+from genrec_tpu.models.t5transformer import TransformerEncoderDecoder, causal_mask
+
+
+class TigerOutput(NamedTuple):
+    logits: jax.Array
+    loss: Optional[jax.Array]
+
+
+class TigerGenerationOutput(NamedTuple):
+    sem_ids: jax.Array  # (B, K, D)
+    log_probas: jax.Array  # (B, K)
+
+
+class Tiger(nn.Module):
+    embedding_dim: int
+    attn_dim: int
+    dropout: float
+    num_heads: int
+    n_layers: int
+    num_item_embeddings: int
+    num_user_embeddings: int
+    sem_id_dim: int
+    max_pos: int = 2048
+    dtype: jnp.dtype = jnp.float32
+
+    @property
+    def vocab_size(self) -> int:
+        return self.num_item_embeddings * self.sem_id_dim + 1
+
+    def setup(self):
+        normal = nn.initializers.normal(stddev=1.0)
+        self.bos_embedding = self.param("bos_embedding", normal, (self.embedding_dim,))
+        self.norm = RMSNorm(self.embedding_dim, name="norm")
+        self.norm_context = RMSNorm(self.embedding_dim, name="norm_context")
+        self.drop = nn.Dropout(self.dropout)
+        self.sem_id_embedding = SemIdEmbedding(
+            self.num_item_embeddings, self.sem_id_dim, self.embedding_dim,
+            dtype=self.dtype, name="sem_id_embedding",
+        )
+        self.user_id_embedding = UserIdEmbedding(
+            self.num_user_embeddings, self.embedding_dim,
+            dtype=self.dtype, name="user_id_embedding",
+        )
+        # Present in the reference but unused by its forward (additions
+        # commented out); kept for parameter-surface parity.
+        self.pos_embedding = self.param("pos_embedding", normal, (self.max_pos, self.embedding_dim))
+        self.decoder_pos_embedding = self.param(
+            "decoder_pos_embedding", normal, (self.sem_id_dim, self.embedding_dim)
+        )
+        dense = lambda d, name: nn.Dense(d, use_bias=False, dtype=self.dtype, name=name)
+        self.in_proj = dense(self.attn_dim, "in_proj")
+        self.in_proj_context = dense(self.attn_dim, "in_proj_context")
+        self.out_proj = dense(self.embedding_dim, "out_proj")  # unused, parity
+        self.transformer = TransformerEncoderDecoder(
+            d_model=self.attn_dim,
+            nhead=self.num_heads,
+            num_encoder_layers=self.n_layers // 2,
+            num_decoder_layers=self.n_layers // 2,
+            dim_feedforward=1024,
+            dropout=self.dropout,
+            dtype=self.dtype,
+            name="transformer",
+        )
+        self.output_head = dense(self.vocab_size, "output_head")
+
+    # ---- shared pieces -----------------------------------------------------
+
+    def _encoder_input(self, user_input_ids, item_input_ids, token_type_ids, seq_mask):
+        if user_input_ids.ndim == 1:
+            user_input_ids = user_input_ids[:, None]
+        user_emb = self.user_id_embedding(user_input_ids)  # (B, 1, D)
+        item_emb = self.sem_id_embedding(item_input_ids, token_type_ids)
+        enc = jnp.concatenate([user_emb, item_emb], axis=1)
+        pad = jnp.concatenate(
+            [jnp.zeros((seq_mask.shape[0], 1), bool), seq_mask == 0], axis=1
+        )  # True = padding; user token always valid
+        return enc, pad
+
+    def _decoder_input(self, B, target_input_ids, target_token_type_ids):
+        bos = jnp.broadcast_to(
+            self.bos_embedding.astype(self.dtype), (B, 1, self.embedding_dim)
+        )
+        if target_input_ids is None or target_input_ids.shape[1] == 0:
+            return bos
+        tgt = self.sem_id_embedding(target_input_ids, target_token_type_ids)
+        return jnp.concatenate([bos, tgt], axis=1)
+
+    # ---- training forward --------------------------------------------------
+
+    def __call__(
+        self,
+        user_input_ids,
+        item_input_ids,
+        token_type_ids,
+        target_input_ids,
+        target_token_type_ids,
+        seq_mask,
+        deterministic: bool = True,
+    ) -> TigerOutput:
+        if seq_mask is None:
+            seq_mask = jnp.ones_like(item_input_ids)
+        B = item_input_ids.shape[0]
+        enc, pad = self._encoder_input(user_input_ids, item_input_ids, token_type_ids, seq_mask)
+        dec = self._decoder_input(B, target_input_ids, target_token_type_ids)
+        enc = self.in_proj_context(self.drop(self.norm_context(enc), deterministic=deterministic))
+        dec = self.in_proj(self.drop(self.norm(dec), deterministic=deterministic))
+
+        out = self.transformer(
+            enc, dec,
+            src_key_padding_mask=pad,
+            memory_key_padding_mask=pad,
+            deterministic=deterministic,
+        )
+        logits = self.output_head(out)  # (B, T+1, V)
+        loss = None
+        if target_input_ids is not None and target_input_ids.shape[1] == self.sem_id_dim:
+            target_vocab = target_token_type_ids * self.num_item_embeddings + target_input_ids
+            # ignore_index=-1: vocab id 0 is a real token here, nothing is masked.
+            per_tok, _ = cross_entropy_with_ignore(
+                logits[:, :-1, :], target_vocab, ignore_index=-1
+            )
+            # Per-sequence SUM over tokens, then batch mean (tiger.py:232-240).
+            loss = jnp.mean(jnp.sum(per_tok, axis=1))
+        return TigerOutput(logits=logits, loss=loss)
+
+    # ---- generation --------------------------------------------------------
+
+    def encode_context(self, user_input_ids, item_input_ids, token_type_ids, seq_mask):
+        enc, pad = self._encoder_input(user_input_ids, item_input_ids, token_type_ids, seq_mask)
+        enc = self.in_proj_context(self.norm_context(enc))
+        memory = self.transformer.encoder(enc, key_padding_mask=pad, deterministic=True)
+        return memory, pad
+
+    def decode_step(self, memory, memory_pad, tgt_ids, tgt_type):
+        """Logits at the last position given the (possibly empty) prefix."""
+        B = memory.shape[0]
+        dec = self._decoder_input(B, tgt_ids, tgt_type)
+        dec = self.in_proj(self.norm(dec))
+        out = self.transformer.decoder(
+            dec, memory,
+            attn_mask=causal_mask(dec.shape[1]),
+            memory_key_padding_mask=memory_pad,
+            deterministic=True,
+        )
+        return self.output_head(out)[:, -1, :].astype(jnp.float32)
+
+
+def _dedup_top_k(scores, keys, k):
+    """Per-row: keep the best-scoring instance of each key, return top-k.
+
+    scores, keys: (M,). Returns (top_scores, top_idx) with duplicates of a
+    key reduced to its best instance (vectorized replacement for the
+    reference's per-batch Python dedup loop, tiger.py:396-447).
+    """
+    order = jnp.lexsort((-scores, keys))  # sort by key, best score first
+    ks = keys[order]
+    first = jnp.concatenate([jnp.ones((1,), bool), ks[1:] != ks[:-1]])
+    keep = jnp.zeros_like(first).at[order].set(first)
+    masked = jnp.where(keep, scores, -jnp.inf)
+    top_scores, top_idx = jax.lax.top_k(masked, k)
+    return top_scores, top_idx
+
+
+def tiger_generate(
+    model: Tiger,
+    params,
+    trie,
+    user_input_ids,
+    item_input_ids,
+    token_type_ids,
+    seq_mask,
+    rng: jax.Array,
+    temperature: float = 0.2,
+    n_top_k_candidates: int = 10,
+    sample_factor: int = 6,
+    deterministic: bool = False,
+) -> TigerGenerationOutput:
+    """Trie-constrained beam search, fully on device and jit-friendly.
+
+    Matches the reference's procedure (tiger.py:312-452): encoder cached
+    once and expanded to B*K beams; at each of sem_id_dim steps sample
+    KK = K*sample_factor candidates WITHOUT replacement from
+    softmax(masked_logits / temperature) (Gumbel-top-k == multinomial
+    without replacement), accumulate log-probs, dedup by full sequence,
+    keep top K. With deterministic=True the sampling noise is dropped
+    (pure beam search).
+    """
+    B = item_input_ids.shape[0]
+    K = n_top_k_candidates
+    Kcb = model.num_item_embeddings
+    D = model.sem_id_dim
+    KK = min(K * sample_factor, Kcb)
+
+    memory, pad = model.apply(
+        {"params": params}, user_input_ids, item_input_ids, token_type_ids,
+        seq_mask, method=Tiger.encode_context,
+    )
+    Lm = memory.shape[1]
+    memory = jnp.broadcast_to(memory[:, None], (B, K, Lm, memory.shape[-1])).reshape(B * K, Lm, -1)
+    pad = jnp.broadcast_to(pad[:, None], (B, K, Lm)).reshape(B * K, Lm)
+
+    beam_seqs = jnp.zeros((B, K, D), jnp.int32)
+    beam_logps = jnp.zeros((B, K), jnp.float32)
+    prefix_idx = jnp.zeros((B, K), jnp.int32)
+
+    for step in range(D):
+        if step == 0:
+            tgt_ids, tgt_type = None, None
+        else:
+            tgt_ids = beam_seqs[:, :, :step].reshape(B * K, step)
+            tgt_type = jnp.broadcast_to(jnp.arange(step), (B * K, step))
+        logits = model.apply(
+            {"params": params}, memory, pad, tgt_ids, tgt_type,
+            method=Tiger.decode_step,
+        )  # (B*K, V)
+        window = jax.lax.dynamic_slice_in_dim(logits, step * Kcb, Kcb, axis=1)
+        legal = trie.legal_mask(prefix_idx.reshape(B * K), step)  # (B*K, Kcb)
+        masked = jnp.where(legal, window, -1e32)
+        logp = jax.nn.log_softmax(masked / temperature, axis=-1)
+
+        if deterministic:
+            perturbed = logp
+        else:
+            rng, sub = jax.random.split(rng)
+            perturbed = logp + jax.random.gumbel(sub, logp.shape)
+        _, cand_tok = jax.lax.top_k(perturbed, KK)  # (B*K, KK)
+        cand_logp = jnp.take_along_axis(logp, cand_tok, axis=1)
+        # Candidates drawn from dead/illegal slots must never win.
+        cand_legal = jnp.take_along_axis(legal, cand_tok, axis=1)
+        cand_logp = jnp.where(cand_legal, cand_logp, -1e32)
+
+        total = (beam_logps.reshape(B * K, 1) + cand_logp).reshape(B, K * KK)
+        toks = cand_tok.reshape(B, K * KK)
+        parents = jnp.broadcast_to(jnp.arange(K)[:, None], (K, KK)).reshape(1, K * KK)
+        parents = jnp.broadcast_to(parents, (B, K * KK))
+
+        # Dedup key = packed candidate sequence (parent prefix advanced).
+        parent_prefix = jnp.take_along_axis(prefix_idx, parents, axis=1)
+        keys = parent_prefix * Kcb + toks
+        top_scores, top_idx = jax.vmap(lambda s, c: _dedup_top_k(s, c, K))(total, keys)
+
+        sel_parent = jnp.take_along_axis(parents, top_idx, axis=1)  # (B, K)
+        sel_tok = jnp.take_along_axis(toks, top_idx, axis=1)
+        beam_seqs = jnp.take_along_axis(beam_seqs, sel_parent[..., None], axis=1)
+        beam_seqs = beam_seqs.at[:, :, step].set(sel_tok)
+        sel_prefix = jnp.take_along_axis(prefix_idx, sel_parent, axis=1)
+        prefix_idx = trie.advance(sel_prefix, sel_tok, step)
+        beam_logps = top_scores
+
+    return TigerGenerationOutput(sem_ids=beam_seqs, log_probas=beam_logps)

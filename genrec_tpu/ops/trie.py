@@ -1,0 +1,120 @@
+"""Dense prefix-legality tables: the jit-able replacement for TIGER's trie.
+
+The reference constrains beam decoding with a CPU ``defaultdict`` trie and
+per-(batch, beam) Python loops (tiger.py:41-69, 366-376) — a device->host
+sync every decode step. Here the trie is flattened ONCE into dense boolean
+tables: ``table[t]`` has shape (K^t, K) where entry [p, c] says "codeword c
+may follow prefix p" (p is the base-K packed prefix). The per-step legal
+mask for a whole (B*K) beam batch is then a single vmapped gather on
+device — no host round-trips, no Python loops (SURVEY.md §7 hard part #1).
+
+Memory: K=256, D=3 -> tables of 256B + 64KB + 16MB of bool — fine in HBM.
+For D=4 (the reference's optional collision-disambiguation code,
+amazon.py:323-353) the dense table would be 4GB, so depth>3 uses a
+sorted-prefix binary-search fallback (`PackedTrie`), still fully on device.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class DenseTrie:
+    """Legality tables for sem-id tuples of depth D over codebook size K."""
+
+    def __init__(self, tables: Sequence[jax.Array], codebook_size: int):
+        self.tables = list(tables)  # tables[t]: (K^t, K) bool
+        self.codebook_size = codebook_size
+        self.depth = len(self.tables)
+
+    @classmethod
+    def build(cls, valid_ids: np.ndarray, codebook_size: int) -> "DenseTrie":
+        """valid_ids: (N, D) int array of legal tuples."""
+        valid_ids = np.asarray(valid_ids)
+        N, D = valid_ids.shape
+        K = codebook_size
+        if K**(D - 1) * K > 2**32:
+            raise ValueError(
+                f"dense trie of depth {D} over {K} codes needs {K**D} bits; "
+                "use PackedTrie for deep/wide id spaces"
+            )
+        tables = []
+        prefix = np.zeros(N, np.int64)
+        for t in range(D):
+            tab = np.zeros((K**t, K), bool)
+            tab[prefix, valid_ids[:, t]] = True
+            tables.append(jnp.asarray(tab))
+            prefix = prefix * K + valid_ids[:, t]
+        return cls(tables, K)
+
+    def legal_mask(self, prefix_idx: jax.Array, step: int) -> jax.Array:
+        """prefix_idx: (...,) packed base-K prefixes -> (..., K) bool."""
+        return self.tables[step][prefix_idx]
+
+    def advance(self, prefix_idx: jax.Array, token: jax.Array, step: int) -> jax.Array:
+        """Prefix id after consuming ``token`` at ``step`` (base-K packing;
+        illegal tokens land on all-False table rows, i.e. dead prefixes)."""
+        del step
+        return prefix_idx * self.codebook_size + token
+
+
+class PackedTrie:
+    """Rank-based legality via binary search — O(N) memory at any depth.
+
+    A prefix is represented by its RANK among the sorted unique valid
+    prefixes of that length (not by a packed integer), so indices stay
+    < N*K at every depth — int32-safe even for the 4-code disambiguation
+    space where base-K packing overflows (256^4 > 2^31) and a dense table
+    would need K^4 bits. Step t stores the sorted unique keys
+    ``parent_rank * K + next_code``; membership = `jnp.searchsorted`,
+    vectorized over the beam batch. Dead prefixes map to the sentinel rank
+    len(keys[t]) whose candidate keys exceed every stored key.
+    """
+
+    def __init__(self, step_keys: Sequence[jax.Array], codebook_size: int):
+        self.step_keys = list(step_keys)  # step t: sorted unique rank*K+code
+        self.codebook_size = codebook_size
+        self.depth = len(self.step_keys)
+
+    @classmethod
+    def build(cls, valid_ids: np.ndarray, codebook_size: int) -> "PackedTrie":
+        valid_ids = np.asarray(valid_ids, np.int64)
+        N, D = valid_ids.shape
+        K = codebook_size
+        if N * K > 2**31 - 1:
+            raise ValueError(f"{N} prefixes x {K} codes overflows int32 keys")
+        keys = []
+        rank = np.zeros(N, np.int64)
+        for t in range(D):
+            k = rank * K + valid_ids[:, t]
+            uniq = np.unique(k)
+            keys.append(jnp.asarray(uniq, jnp.int32))
+            rank = np.searchsorted(uniq, k)
+        return cls(keys, K)
+
+    def legal_mask(self, prefix_idx: jax.Array, step: int) -> jax.Array:
+        K = self.codebook_size
+        cand = prefix_idx[..., None] * K + jnp.arange(K)  # (..., K)
+        keys = self.step_keys[step]
+        pos = jnp.clip(jnp.searchsorted(keys, cand), 0, keys.shape[0] - 1)
+        return keys[pos] == cand
+
+    def advance(self, prefix_idx: jax.Array, token: jax.Array, step: int) -> jax.Array:
+        """Rank of the extended prefix among step ``step``'s valid prefixes;
+        illegal/dead extensions get the sentinel rank len(keys[step])."""
+        keys = self.step_keys[step]
+        key = prefix_idx * self.codebook_size + token
+        pos = jnp.clip(jnp.searchsorted(keys, key), 0, keys.shape[0] - 1)
+        return jnp.where(keys[pos] == key, pos, keys.shape[0]).astype(jnp.int32)
+
+
+def build_trie(valid_ids: np.ndarray, codebook_size: int, dense_max_bits: int = 2**28):
+    """Pick DenseTrie when the deepest table fits in dense_max_bits bools."""
+    D = np.asarray(valid_ids).shape[1]
+    if codebook_size**D <= dense_max_bits:
+        return DenseTrie.build(valid_ids, codebook_size)
+    return PackedTrie.build(valid_ids, codebook_size)

@@ -189,3 +189,29 @@ def test_checkpoint_roundtrip(tmp_path):
         lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
         params, restored,
     )
+
+
+def test_checkpoint_manager_full_trainstate_with_prng_key(tmp_path):
+    """TrainState holds a typed PRNG key — the manager must round-trip it."""
+    from genrec_tpu.core.checkpoint import CheckpointManager
+
+    model = SASRec(num_items=10, max_seq_len=4, embed_dim=8, num_heads=2,
+                   num_blocks=1, ffn_dim=16)
+    params = model.init(jax.random.key(0), jnp.zeros((1, 4), jnp.int32))["params"]
+    opt = optax.adam(1e-3)
+    state = TrainState.create(params, opt, jax.random.key(42))
+    mgr = CheckpointManager(str(tmp_path / "ckpts"))
+    mgr.save(3, state)
+    mgr.close()
+
+    mgr2 = CheckpointManager(str(tmp_path / "ckpts"))
+    assert mgr2.latest_step() == 3
+    restored = mgr2.restore(state)
+    mgr2.close()
+    assert int(restored.step) == 0
+    np.testing.assert_array_equal(
+        np.asarray(jax.random.key_data(restored.rng)),
+        np.asarray(jax.random.key_data(state.rng)),
+    )
+    # Restored rng must be usable as a key.
+    jax.random.split(restored.rng)

@@ -1,0 +1,303 @@
+"""TIGER parity + jitted trie-constrained generation tests."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from genrec_tpu.models.tiger import Tiger, TigerGenerationOutput, tiger_generate
+from genrec_tpu.ops.trie import DenseTrie, PackedTrie, build_trie
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "data", "tiger_golden.npz")
+
+
+def _model():
+    return Tiger(embedding_dim=24, attn_dim=32, dropout=0.0, num_heads=4,
+                 n_layers=4, num_item_embeddings=16, num_user_embeddings=50,
+                 sem_id_dim=3, max_pos=64)
+
+
+def _params_from_golden(g):
+    w = {k[2:]: g[k] for k in g.files if k.startswith("w.")}
+    lin = lambda p: {"kernel": w[p + ".weight"].T}
+    norm = lambda p: {"weight": w[p + ".weight"]}
+
+    def block(prefix, cross):
+        d = {
+            "self_attn": {
+                "q": lin(f"{prefix}.self_attn.attn.q"),
+                "kv": lin(f"{prefix}.self_attn.attn.kv"),
+                "o": lin(f"{prefix}.self_attn.attn.o"),
+                "rel_bias": w[f"{prefix}.self_attn.attn.rel_bias.weight"],
+            },
+            "norm1": norm(f"{prefix}.norm1"),
+            "norm2": norm(f"{prefix}.norm2"),
+            "ff": {"wi": lin(f"{prefix}.ff.wi"), "wo": lin(f"{prefix}.ff.wo")},
+        }
+        if cross:
+            d["cross_attn"] = {
+                "q": lin(f"{prefix}.cross_attn.attn.q"),
+                "k": lin(f"{prefix}.cross_attn.attn.k"),
+                "v": lin(f"{prefix}.cross_attn.attn.v"),
+                "o": lin(f"{prefix}.cross_attn.attn.o"),
+            }
+            d["norm_cross"] = norm(f"{prefix}.norm_cross")
+        return d
+
+    params = {
+        "bos_embedding": w["bos_embedding"],
+        "norm": norm("norm"),
+        "norm_context": norm("norm_context"),
+        "sem_id_embedding": {"embedding": w["sem_id_embedding.emb.weight"]},
+        "user_id_embedding": {"embedding": w["user_id_embedding.emb.weight"]},
+        "pos_embedding": w["pos_embedding.weight"],
+        "decoder_pos_embedding": w["decoder_pos_embedding.weight"],
+        "in_proj": lin("in_proj"),
+        "in_proj_context": lin("in_proj_context"),
+        "out_proj": lin("out_proj"),
+        "output_head": lin("output_head"),
+        "transformer": {
+            "encoder": {
+                f"layer_{i}": block(f"transformer.encoder.layers.{i}", cross=False)
+                for i in range(2)
+            },
+            "decoder": {
+                f"layer_{i}": block(f"transformer.decoder.layers.{i}", cross=True)
+                for i in range(2)
+            },
+        },
+    }
+    return jax.tree_util.tree_map(jnp.asarray, params)
+
+
+@pytest.fixture(scope="module")
+def golden():
+    return np.load(GOLDEN)
+
+
+def test_forward_matches_reference(golden):
+    model = _model()
+    params = _params_from_golden(golden)
+    out = model.apply(
+        {"params": params},
+        jnp.asarray(golden["user"]), jnp.asarray(golden["items"]),
+        jnp.asarray(golden["types"]), jnp.asarray(golden["tgt"]),
+        jnp.asarray(golden["tgt_types"]), jnp.asarray(golden["seq_mask"]),
+    )
+    np.testing.assert_allclose(
+        np.asarray(out.logits), golden["logits"], atol=3e-4, rtol=1e-3
+    )
+    assert float(out.loss) == pytest.approx(float(golden["loss"]), rel=1e-5)
+
+
+# ---- trie tables ----------------------------------------------------------
+
+def test_dense_trie_legality():
+    valid = np.asarray([[1, 2, 3], [1, 2, 4], [5, 6, 7]])
+    trie = DenseTrie.build(valid, codebook_size=8)
+    m0 = np.asarray(trie.legal_mask(jnp.asarray([0]), 0))[0]
+    assert m0[1] and m0[5] and not m0[2]
+    p1 = trie.advance(jnp.asarray([0]), jnp.asarray([1]), 0)
+    m1 = np.asarray(trie.legal_mask(p1, 1))[0]
+    assert m1[2] and not m1[6]
+    p2 = trie.advance(p1, jnp.asarray([2]), 1)
+    m2 = np.asarray(trie.legal_mask(p2, 2))[0]
+    assert m2[3] and m2[4] and not m2[7]
+    # Dead prefix -> empty mask.
+    dead = trie.advance(p1, jnp.asarray([7]), 1)
+    assert not np.asarray(trie.legal_mask(dead, 2)).any()
+
+
+def test_packed_trie_matches_dense():
+    rng = np.random.default_rng(0)
+    valid = rng.integers(0, 8, (40, 3))
+    dense = DenseTrie.build(valid, 8)
+    packed = PackedTrie.build(valid, 8)
+    prefix_d = jnp.zeros((5,), jnp.int32)
+    prefix_p = jnp.zeros((5,), jnp.int32)
+    for step in range(3):
+        md = np.asarray(dense.legal_mask(prefix_d, step))
+        mp = np.asarray(packed.legal_mask(prefix_p, step))
+        np.testing.assert_array_equal(md, mp)
+        tok = jnp.asarray(valid[:5, step])
+        prefix_d = dense.advance(prefix_d, tok, step)
+        prefix_p = packed.advance(prefix_p, tok, step)
+
+
+def test_packed_trie_depth4_no_int32_overflow():
+    """The 4-code disambiguation space: base-K packing would need 256^4 >
+    2^31; rank-based prefixes must stay exact."""
+    rng = np.random.default_rng(1)
+    valid = np.concatenate(
+        [rng.integers(200, 256, (50, 3)), rng.integers(0, 3, (50, 1))], axis=1
+    )
+    trie = PackedTrie.build(valid, 256)
+    # Walk every valid tuple and check legality at each step.
+    prefix = jnp.zeros((50,), jnp.int32)
+    for step in range(4):
+        mask = np.asarray(trie.legal_mask(prefix, step))
+        tok = valid[:, step]
+        assert mask[np.arange(50), tok].all(), step
+        prefix = trie.advance(prefix, jnp.asarray(tok), step)
+        assert (np.asarray(prefix) >= 0).all()  # no wraparound
+        assert (np.asarray(prefix) < len(valid)).all()  # real ranks, not sentinel
+    # An illegal first step dies and stays dead.
+    dead = trie.advance(jnp.zeros((1,), jnp.int32), jnp.asarray([0]), 0)
+    assert not np.asarray(trie.legal_mask(dead, 1)).any()
+
+
+def test_packed_trie_in_generation():
+    """tiger_generate must work identically through the rank-based trie."""
+    rng = np.random.default_rng(0)
+    valid = np.unique(rng.integers(0, 8, (30, 3)), axis=0)
+    model = Tiger(embedding_dim=16, attn_dim=32, dropout=0.0, num_heads=4,
+                  n_layers=4, num_item_embeddings=8, num_user_embeddings=20,
+                  sem_id_dim=3, max_pos=64)
+    B, L = 2, 12
+    user = jnp.asarray(rng.integers(0, 20, (B,)), jnp.int32)
+    items = jnp.asarray(rng.integers(0, 8, (B, L)), jnp.int32)
+    types = jnp.asarray(np.tile(np.arange(3), (B, L // 3)).reshape(B, L) % 3, jnp.int32)
+    mask = jnp.ones((B, L), jnp.int32)
+    params = model.init(
+        jax.random.key(0), user, items, types,
+        jnp.zeros((B, 3), jnp.int32), jnp.zeros((B, 3), jnp.int32), mask,
+    )["params"]
+    o_dense = tiger_generate(model, params, DenseTrie.build(valid, 8), user,
+                             items, types, mask, jax.random.key(5),
+                             n_top_k_candidates=5, deterministic=True)
+    o_packed = tiger_generate(model, params, PackedTrie.build(valid, 8), user,
+                              items, types, mask, jax.random.key(5),
+                              n_top_k_candidates=5, deterministic=True)
+    np.testing.assert_array_equal(np.asarray(o_dense.sem_ids), np.asarray(o_packed.sem_ids))
+
+
+def test_build_trie_picks_dense_or_packed():
+    valid = np.zeros((4, 3), np.int64)
+    assert isinstance(build_trie(valid, 16), DenseTrie)
+    assert isinstance(build_trie(np.zeros((4, 4), np.int64), 4096), PackedTrie)
+
+
+# ---- generation -----------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def gen_setup():
+    model = Tiger(embedding_dim=16, attn_dim=32, dropout=0.0, num_heads=4,
+                  n_layers=4, num_item_embeddings=8, num_user_embeddings=20,
+                  sem_id_dim=3, max_pos=64)
+    rng = np.random.default_rng(0)
+    valid = np.unique(rng.integers(0, 8, (30, 3)), axis=0)
+    trie = DenseTrie.build(valid, 8)
+    B, L = 2, 12
+    batch = dict(
+        user=jnp.asarray(rng.integers(0, 20, (B,)), jnp.int32),
+        items=jnp.asarray(rng.integers(0, 8, (B, L)), jnp.int32),
+        types=jnp.asarray(np.tile(np.arange(3), (B, L // 3)).reshape(B, L) % 3, jnp.int32),
+        mask=jnp.ones((B, L), jnp.int32),
+    )
+    params = model.init(
+        jax.random.key(0), batch["user"], batch["items"], batch["types"],
+        jnp.zeros((B, 3), jnp.int32), jnp.zeros((B, 3), jnp.int32), batch["mask"],
+    )["params"]
+    return model, params, trie, valid, batch
+
+
+def test_generate_respects_trie(gen_setup):
+    model, params, trie, valid, b = gen_setup
+    out = tiger_generate(
+        model, params, trie, b["user"], b["items"], b["types"], b["mask"],
+        jax.random.key(1), n_top_k_candidates=5,
+    )
+    assert isinstance(out, TigerGenerationOutput)
+    assert out.sem_ids.shape == (2, 5, 3)
+    valid_set = {tuple(v) for v in valid.tolist()}
+    finite = np.asarray(out.log_probas) > -1e30
+    for bi in range(2):
+        for k in range(5):
+            if finite[bi, k]:
+                assert tuple(np.asarray(out.sem_ids)[bi, k].tolist()) in valid_set
+
+
+def test_generate_beams_are_unique(gen_setup):
+    model, params, trie, valid, b = gen_setup
+    out = tiger_generate(
+        model, params, trie, b["user"], b["items"], b["types"], b["mask"],
+        jax.random.key(2), n_top_k_candidates=5,
+    )
+    finite = np.asarray(out.log_probas) > -1e30
+    for bi in range(2):
+        seqs = [tuple(s) for s, f in zip(np.asarray(out.sem_ids)[bi].tolist(), finite[bi]) if f]
+        assert len(seqs) == len(set(seqs))
+
+
+def test_generate_deterministic_is_sorted_and_stable(gen_setup):
+    model, params, trie, valid, b = gen_setup
+    o1 = tiger_generate(model, params, trie, b["user"], b["items"], b["types"],
+                        b["mask"], jax.random.key(3), n_top_k_candidates=4,
+                        deterministic=True)
+    o2 = tiger_generate(model, params, trie, b["user"], b["items"], b["types"],
+                        b["mask"], jax.random.key(99), n_top_k_candidates=4,
+                        deterministic=True)
+    np.testing.assert_array_equal(np.asarray(o1.sem_ids), np.asarray(o2.sem_ids))
+    lp = np.asarray(o1.log_probas)
+    assert (np.diff(lp, axis=1) <= 1e-6).all()  # descending scores
+
+
+def test_generate_is_jittable(gen_setup):
+    model, params, trie, valid, b = gen_setup
+
+    @jax.jit
+    def gen(p, rng):
+        return tiger_generate(
+            model, p, trie, b["user"], b["items"], b["types"], b["mask"], rng,
+            n_top_k_candidates=5,
+        ).sem_ids
+
+    out = gen(params, jax.random.key(0))
+    assert out.shape == (2, 5, 3)
+
+
+def test_training_reduces_loss_on_mesh():
+    import optax
+
+    from genrec_tpu.core.harness import make_train_step
+    from genrec_tpu.core.state import TrainState
+    from genrec_tpu.data.batching import batch_iterator
+    from genrec_tpu.data.tiger_seq import synthetic_tiger_data
+    from genrec_tpu.parallel import get_mesh, replicate, shard_batch
+
+    data = synthetic_tiger_data(num_items=60, codebook_size=8, sem_id_dim=3,
+                                max_items=6, num_users=150, seed=0)
+    arrays = data.train_arrays()
+    model = Tiger(embedding_dim=16, attn_dim=32, dropout=0.1, num_heads=4,
+                  n_layers=2, num_item_embeddings=8, num_user_embeddings=100,
+                  sem_id_dim=3, max_pos=64)
+    L = 6 * 3
+    params = model.init(
+        jax.random.key(0), jnp.zeros((1,), jnp.int32), jnp.zeros((1, L), jnp.int32),
+        jnp.zeros((1, L), jnp.int32), jnp.zeros((1, 3), jnp.int32),
+        jnp.zeros((1, 3), jnp.int32), jnp.ones((1, L), jnp.int32),
+    )["params"]
+    opt = optax.adamw(3e-3)
+    tt = jnp.arange(3)
+
+    def loss_fn(p, batch, rng):
+        B = batch["user_ids"].shape[0]
+        out = model.apply(
+            {"params": p}, batch["user_ids"], batch["item_input_ids"],
+            batch["token_type_ids"], batch["target_ids"],
+            jnp.broadcast_to(tt, (B, 3)), batch["seq_mask"],
+            deterministic=False, rngs={"dropout": rng},
+        )
+        return out.loss, {}
+
+    mesh = get_mesh()
+    step = jax.jit(make_train_step(loss_fn, opt, clip_norm=1.0))
+    state = replicate(mesh, TrainState.create(params, opt, jax.random.key(1)))
+    losses = []
+    for epoch in range(3):
+        for batch, _ in batch_iterator(arrays, 64, shuffle=True, epoch=epoch, drop_last=True):
+            state, m = step(state, shard_batch(mesh, batch))
+            losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0] * 0.9, (losses[0], losses[-1])
