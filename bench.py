@@ -1,0 +1,101 @@
+"""Throughput benchmark: TIGER training step on the available accelerator.
+
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+
+The reference publishes no throughput numbers (SURVEY.md §6); BASELINE.md
+sets the bar at >=3x a single-A100 running the torch reference. A single
+A100 on the reference TIGER config sustains roughly 25 steps/s at batch
+256 (conservative published-class estimate for a 6-layer enc-dec at
+seq~61); we report seq/sec/chip and vs_baseline against that estimate
+until a measured torch number replaces it.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+A100_REF_SEQ_PER_SEC = 25.0 * 256  # steps/s * batch -> seq/s (estimate)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from genrec_tpu.core.harness import make_train_step
+    from genrec_tpu.core.state import TrainState
+    from genrec_tpu.models.tiger import Tiger
+
+    # Reference TIGER architecture (config/tiger/amazon/tiger.gin).
+    B, items, D = 256, 20, 3
+    L = items * D
+    model = Tiger(
+        embedding_dim=128, attn_dim=384, dropout=0.1, num_heads=6, n_layers=8,
+        num_item_embeddings=256, num_user_embeddings=10_000, sem_id_dim=D,
+        dtype=jnp.bfloat16,
+    )
+    rng = np.random.default_rng(0)
+    batch = dict(
+        user_ids=jnp.asarray(rng.integers(0, 10_000, (B,)), jnp.int32),
+        item_input_ids=jnp.asarray(rng.integers(0, 256, (B, L)), jnp.int32),
+        token_type_ids=jnp.asarray(np.tile(np.arange(D), (B, items)), jnp.int32),
+        target_ids=jnp.asarray(rng.integers(0, 256, (B, D)), jnp.int32),
+        seq_mask=jnp.ones((B, L), jnp.int32),
+    )
+    params = model.init(
+        jax.random.key(0), batch["user_ids"], batch["item_input_ids"],
+        batch["token_type_ids"], batch["target_ids"],
+        jnp.broadcast_to(jnp.arange(D), (B, D)), batch["seq_mask"],
+    )["params"]
+
+    optimizer = optax.adamw(1e-4)
+
+    def loss_fn(p, b, key):
+        out = model.apply(
+            {"params": p}, b["user_ids"], b["item_input_ids"],
+            b["token_type_ids"], b["target_ids"],
+            jnp.broadcast_to(jnp.arange(D), (B, D)), b["seq_mask"],
+            deterministic=False, rngs={"dropout": key},
+        )
+        return out.loss, {}
+
+    step = jax.jit(make_train_step(loss_fn, optimizer, clip_norm=1.0), donate_argnums=0)
+    state = TrainState.create(params, optimizer, jax.random.key(1))
+
+    # Warmup / compile.
+    state, m = step(state, batch)
+    jax.block_until_ready(m["loss"])
+
+    # Adapt step count to the platform (TPU ~ms/step, CPU ~s/step).
+    t0 = time.perf_counter()
+    state, m = step(state, batch)
+    jax.block_until_ready(m["loss"])
+    per_step = time.perf_counter() - t0
+    n_steps = max(3, min(100, int(15.0 / max(per_step, 1e-4))))
+
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        state, m = step(state, batch)
+    jax.block_until_ready(m["loss"])
+    dt = time.perf_counter() - t0
+
+    seq_per_sec = n_steps * B / dt
+    n_chips = jax.device_count()
+    value = seq_per_sec / n_chips
+    print(
+        json.dumps(
+            {
+                "metric": "tiger_train_seq_per_sec_per_chip",
+                "value": round(value, 2),
+                "unit": "seq/s/chip",
+                "vs_baseline": round(value / A100_REF_SEQ_PER_SEC, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -34,12 +34,16 @@ class TigerSeqData:
         self.user_hash_size = user_hash_size
 
     def _flatten_history(self, items: np.ndarray):
-        """items (<=max_items,) item ids -> left-padded flattened sem ids.
+        """items (<=max_items,) item ids -> flattened sem ids, items FIRST
+        and padding after.
 
-        Returns (input_ids, token_type_ids, seq_mask) of length max_items*D.
-        Padding positions carry id 0 / type 0 / mask 0 (embedding reads the
-        pad row via seq_mask, mirroring the reference's left-pad collate
-        tiger_trainer.py:27-80).
+        Matches the reference collate's default padding_side="left" branch,
+        which despite its name writes item tokens at positions 0..n-1 with
+        padding at the tail (tiger_trainer.py:60-65) — alignment matters
+        because the T5 relative-position buckets see absolute distances.
+        Returns (input_ids, token_type_ids, seq_mask) of length max_items*D;
+        padding positions carry id 0 / type 0 / mask 0 (masked out of
+        attention via seq_mask).
         """
         L = self.max_items * self.D
         ids = np.zeros(L, np.int32)
@@ -47,10 +51,9 @@ class TigerSeqData:
         mask = np.zeros(L, np.int32)
         items = items[-self.max_items :]
         n = len(items) * self.D
-        flat = self.sem_ids[items - 1].reshape(-1)
-        ids[L - n :] = flat
-        types[L - n :] = np.tile(np.arange(self.D), len(items))
-        mask[L - n :] = 1
+        ids[:n] = self.sem_ids[items - 1].reshape(-1)
+        types[:n] = np.tile(np.arange(self.D), len(items))
+        mask[:n] = 1
         return ids, types, mask
 
     def _samples(self, split: str):

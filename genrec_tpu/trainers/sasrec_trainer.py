@@ -162,19 +162,21 @@ def train(
     best_recall = -1.0
     best_params = None
     for epoch in range(epochs):
-        epoch_loss, n_batches = 0.0, 0
+        # Device-scalar accumulation: float() only at logging boundaries so
+        # the host never blocks on the jitted step (async dispatch).
+        epoch_loss, n_batches = None, 0
         for batch, _ in batch_iterator(
             train_arrays, batch_size, shuffle=True, seed=seed, epoch=epoch, drop_last=True
         ):
             state, metrics = step_fn(state, shard_batch(mesh, batch))
-            epoch_loss += float(metrics["loss"])
+            epoch_loss = metrics["loss"] if epoch_loss is None else epoch_loss + metrics["loss"]
             n_batches += 1
             global_step += 1
             if global_step % wandb_log_interval == 0:
                 tracker.log(
                     {"global_step": global_step, "train/loss": float(metrics["loss"])}
                 )
-        logger.info(f"epoch {epoch} loss {epoch_loss / max(n_batches,1):.4f}")
+        logger.info(f"epoch {epoch} loss {float(epoch_loss) / max(n_batches,1):.4f}")
 
         if ckpt_mgr is not None and (epoch + 1) % save_every_epoch == 0:
             ckpt_mgr.save(epoch, jax.tree_util.tree_map(np.asarray, state.params))

@@ -188,18 +188,22 @@ def train(
     global_step = 0
     best_recall, best_params = -1.0, None
     for epoch in range(start_epoch, epochs):
-        epoch_loss, n_batches = 0.0, 0
+        # Accumulate the device scalar; float() only at logging boundaries
+        # so host dispatch never blocks on the step (async dispatch).
+        epoch_loss, n_batches = None, 0
         for batch, _ in batch_iterator(
             train_arrays, batch_size * gradient_accumulate_every,
             shuffle=True, seed=seed, epoch=epoch, drop_last=True,
         ):
             state, m = step_fn(state, shard_batch(mesh, batch))
-            epoch_loss += float(m["loss"])
+            epoch_loss = m["loss"] if epoch_loss is None else epoch_loss + m["loss"]
             n_batches += 1
             global_step += 1
             if global_step % wandb_log_interval == 0:
                 tracker.log({"global_step": global_step, "train/loss": float(m["loss"])})
-        logger.info(f"epoch {epoch} loss {epoch_loss / max(n_batches, 1):.4f}")
+        logger.info(
+            f"epoch {epoch} loss {float(epoch_loss) / max(n_batches, 1):.4f}"
+        )
 
         if do_eval and (epoch + 1) % eval_every_epoch == 0:
             eval_rng, sub = jax.random.split(eval_rng)
