@@ -92,6 +92,9 @@ def main():
                 "value": round(value, 2),
                 "unit": "seq/s/chip",
                 "vs_baseline": round(value / A100_REF_SEQ_PER_SEC, 3),
+                # vs_baseline denominator is an ESTIMATE (reference publishes
+                # no throughput, BASELINE.md); marked so consumers know.
+                "baseline_source": "a100-estimate",
             }
         )
     )

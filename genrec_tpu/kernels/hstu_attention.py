@@ -1,0 +1,224 @@
+"""Fused HSTU SiLU-attention Pallas kernel.
+
+The reference materializes a (B, H, L, L) float bias tensor per layer per
+step (hstu.py:386-409) — at L=50 that's noise, but it scales O(L^2) in HBM
+traffic and is exactly what SURVEY.md §5.7 flags as the kernel-fusion
+target. This kernel computes, per (batch*head, q-block) tile:
+
+    scores = Q_blk @ K^T                       (MXU, fp32 accumulate)
+    scores += pos_bias[bucket(j - i)]          (bucket math in-registers)
+    scores += time_bias[bucket(|t_i - t_j|)]
+    scores  = -1e9 where causal/padding masked
+    out     = silu(scores) @ V                 (MXU)
+
+so neither bias nor the (L, L) score matrix ever round-trips to HBM.
+Bias-table lookups use a one-hot select loop over the (tiny) bucket tables
+— TPU-friendly, no dynamic gather.
+
+`hstu_attention` wraps the kernel in jax.custom_vjp with the backward pass
+taken from the XLA reference implementation (rematerialized), so the
+kernel is usable in training too.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG = -1e9
+
+
+def _pos_bucket_f(rel, num_buckets, max_distance):
+    """hstu_position_bucket (ops/buckets.py) in kernel-safe form."""
+    rp = jnp.maximum(rel, 0)
+    max_exact = num_buckets // 2
+    large = max_exact + (
+        jnp.log(jnp.maximum(rp, 1).astype(jnp.float32) / max_exact)
+        / math.log(max_distance / max_exact)
+        * (num_buckets - max_exact)
+    ).astype(jnp.int32)
+    large = jnp.minimum(large, num_buckets - 1)
+    return jnp.where(rp < max_exact, rp, large)
+
+
+def _time_bucket_f(diff, num_buckets):
+    abs_diff = jnp.maximum(jnp.abs(diff), 1).astype(jnp.float32)
+    b = (jnp.log(abs_diff) / 0.693).astype(jnp.int32)
+    return jnp.clip(b, 0, num_buckets - 1)
+
+
+def _kernel(
+    q_ref, k_ref, v_ref, ts_ref, mask_ref, ptab_ref, ttab_ref, out_ref,
+    *, blk_q: int, num_pos_buckets: int, num_time_buckets: int,
+    max_position_distance: int, use_time: bool,
+):
+    j = pl.program_id(1)
+    L = k_ref.shape[1]
+
+    q = q_ref[0]  # (blk_q, hd)
+    k = k_ref[0]  # (L, hd)
+    scores = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # (blk_q, L)
+
+    q_pos = j * blk_q + jax.lax.broadcasted_iota(jnp.int32, (blk_q, L), 0)
+    k_pos = jax.lax.broadcasted_iota(jnp.int32, (blk_q, L), 1)
+
+    # Replicated reference quirk: rel = key - query, clamped >= 0 in the
+    # bucket fn (see models/hstu.py RelativePositionBias).
+    pbucket = _pos_bucket_f(k_pos - q_pos, num_pos_buckets, max_position_distance)
+    pbias = jnp.zeros_like(scores)
+    for b in range(num_pos_buckets):
+        pbias = pbias + jnp.where(pbucket == b, ptab_ref[0, b], 0.0)
+    scores = scores + pbias
+
+    if use_time:
+        ts = ts_ref[...]  # (1, L) int32
+        t_q = jax.lax.dynamic_slice(ts, (0, j * blk_q), (1, blk_q))  # (1, blk_q)
+        tdiff = t_q.T - ts[0][None, :]  # (blk_q, L)
+        tbucket = _time_bucket_f(tdiff, num_time_buckets)
+        tbias = jnp.zeros_like(scores)
+        for b in range(num_time_buckets):
+            tbias = tbias + jnp.where(tbucket == b, ttab_ref[0, b], 0.0)
+        scores = scores + tbias
+
+    causal_or_pad = jnp.logical_or(k_pos > q_pos, mask_ref[0][None, :] != 0)
+    scores = jnp.where(causal_or_pad, NEG, scores)
+    attn = scores * jax.nn.sigmoid(scores)  # silu
+    out_ref[0] = jnp.dot(
+        attn.astype(v_ref.dtype), v_ref[0], preferred_element_type=jnp.float32
+    ).astype(out_ref.dtype)
+
+
+def _round_up(x, m):
+    return (x + m - 1) // m * m
+
+
+def hstu_attention_pallas(
+    q, k, v, timestamps, padding_mask, pos_table, time_table,
+    max_position_distance: int = 128, blk_q: int = 128, interpret: bool = False,
+):
+    """Fused SiLU attention.
+
+    Args:
+        q, k, v: (B, H, L, hd)
+        timestamps: (B, L) int32 or None
+        padding_mask: (B, L) bool/int — True/1 = padding
+        pos_table: (H, num_pos_buckets)
+        time_table: (H, num_time_buckets) or None
+    Returns:
+        (B, H, L, hd) attention output (same dtype as v).
+    """
+    B, H, L, hd = q.shape
+    use_time = timestamps is not None and time_table is not None
+    # Mosaic compiles only on TPU; elsewhere fall back to the interpreter
+    # so use_pallas=True models stay runnable (slowly) in CI.
+    interpret = interpret or jax.default_backend() != "tpu"
+    Lp = _round_up(L, blk_q)
+    hp = _round_up(hd, 128)
+
+    def pad(x, target_len, axis, value=0):
+        cfg = [(0, 0)] * x.ndim
+        cfg[axis] = (0, target_len - x.shape[axis])
+        return jnp.pad(x, cfg, constant_values=value)
+
+    qf = pad(pad(q.reshape(B * H, L, hd), Lp, 1), hp, 2)
+    kf = pad(pad(k.reshape(B * H, L, hd), Lp, 1), hp, 2)
+    vf = pad(pad(v.reshape(B * H, L, hd), Lp, 1), hp, 2)
+    # Padded key positions must be masked.
+    maskf = pad(padding_mask.astype(jnp.int32), Lp, 1, value=1)
+    if use_time:
+        tsf = pad(timestamps.astype(jnp.int32), Lp, 1)
+    else:
+        tsf = jnp.zeros((B, Lp), jnp.int32)
+        time_table = jnp.zeros((H, 1), jnp.float32)
+
+    n_q = Lp // blk_q
+    grid = (B * H, n_q)
+
+    kernel = functools.partial(
+        _kernel,
+        blk_q=blk_q,
+        num_pos_buckets=pos_table.shape[1],
+        num_time_buckets=time_table.shape[1],
+        max_position_distance=max_position_distance,
+        use_time=use_time,
+    )
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((B * H, Lp, hp), v.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, blk_q, hp), lambda i, j: (i, j, 0)),  # q block
+            pl.BlockSpec((1, Lp, hp), lambda i, j: (i, 0, 0)),  # full k
+            pl.BlockSpec((1, Lp, hp), lambda i, j: (i, 0, 0)),  # full v
+            pl.BlockSpec((1, Lp), lambda i, j: (i // H, 0)),  # timestamps (per batch)
+            pl.BlockSpec((1, Lp), lambda i, j: (i // H, 0)),  # padding mask
+            pl.BlockSpec((1, pos_table.shape[1]), lambda i, j: (i % H, 0)),
+            pl.BlockSpec((1, time_table.shape[1]), lambda i, j: (i % H, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, blk_q, hp), lambda i, j: (i, j, 0)),
+        interpret=interpret,
+    )(qf, kf, vf, tsf, maskf, pos_table, time_table)
+    return out.reshape(B, H, Lp, hp)[:, :, :L, :hd]
+
+
+def hstu_attention_xla(
+    q, k, v, timestamps, padding_mask, pos_table, time_table,
+    max_position_distance: int = 128,
+):
+    """Reference-shaped XLA implementation (materializes the bias); used as
+    fallback and as the source of the backward pass."""
+    from genrec_tpu.ops.buckets import hstu_log_bucket, hstu_position_bucket
+
+    B, H, L, hd = q.shape
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32)
+    pos = jnp.arange(L)
+    rel = pos[None, :] - pos[:, None]  # [i, j] = j - i (reference quirk)
+    pbuckets = hstu_position_bucket(rel, pos_table.shape[1], max_position_distance)
+    scores = scores + pos_table.T[pbuckets].transpose(2, 0, 1)[None]
+    if timestamps is not None and time_table is not None:
+        diff = timestamps[:, :, None] - timestamps[:, None, :]
+        tbuckets = hstu_log_bucket(diff, time_table.shape[1])
+        scores = scores + time_table.T[tbuckets].transpose(0, 3, 1, 2)
+    causal = jnp.triu(jnp.ones((L, L), bool), k=1)
+    scores = jnp.where(causal[None, None], NEG, scores)
+    scores = jnp.where(padding_mask.astype(bool)[:, None, None, :], NEG, scores)
+    attn = jax.nn.silu(scores).astype(v.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", attn, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7,))
+def hstu_attention(q, k, v, timestamps, padding_mask, pos_table, time_table,
+                   max_position_distance=128):
+    """Kernel forward + XLA-derived backward (rematerialized)."""
+    return hstu_attention_pallas(
+        q, k, v, timestamps, padding_mask, pos_table, time_table,
+        max_position_distance,
+    )
+
+
+def _fwd(q, k, v, timestamps, padding_mask, pos_table, time_table, mpd):
+    out = hstu_attention_pallas(
+        q, k, v, timestamps, padding_mask, pos_table, time_table, mpd
+    )
+    return out, (q, k, v, timestamps, padding_mask, pos_table, time_table)
+
+
+def _bwd(mpd, res, g):
+    q, k, v, timestamps, padding_mask, pos_table, time_table = res
+
+    def f(q, k, v, pos_table, time_table):
+        return hstu_attention_xla(
+            q, k, v, timestamps, padding_mask, pos_table, time_table, mpd
+        )
+
+    _, vjp = jax.vjp(f, q, k, v, pos_table, time_table)
+    dq, dk, dv, dpt, dtt = vjp(g)
+    return dq, dk, dv, None, None, dpt, dtt
+
+
+hstu_attention.defvjp(_fwd, _bwd)

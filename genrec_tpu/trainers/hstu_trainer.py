@@ -1,15 +1,11 @@
-"""SASRec trainer (parity target: reference genrec/trainers/sasrec_trainer.py).
+"""HSTU trainer (parity target: reference genrec/trainers/hstu_trainer.py).
 
-Loop shape matches the reference (epoch loop, Adam(b2=0.98), no LR
-schedule, full-vocab eval every epoch, best-Recall@10 snapshot) but the
-step is one compiled SPMD program over the data mesh and eval ranks stay
-on device (no per-sample Python loops — sasrec_trainer.py:63-72 replaced
-by `ops.batch_metrics`).
+Identical skeleton to the SASRec trainer (epoch loop, Adam(b2=0.98), no LR
+schedule, full-vocab eval) plus timestamp pass-through (hstu_trainer.py:152-157).
 """
 
 from __future__ import annotations
 
-import functools
 import os
 
 import jax
@@ -23,7 +19,7 @@ from genrec_tpu.core.logging import Tracker, setup_logger
 from genrec_tpu.core.state import TrainState
 from genrec_tpu.data.batching import batch_iterator
 from genrec_tpu.data.synthetic import SyntheticSeqDataset
-from genrec_tpu.models.sasrec import SASRec
+from genrec_tpu.models.hstu import HSTU
 from genrec_tpu.ops.metrics import first_match_ranks
 from genrec_tpu.parallel import distributed_init, get_mesh, metric_allreduce, replicate, shard_batch
 
@@ -31,25 +27,25 @@ from genrec_tpu.parallel import distributed_init, get_mesh, metric_allreduce, re
 def make_eval_step(model):
     @jax.jit
     def eval_step(params, batch, valid):
-        logits, _ = model.apply({"params": params}, batch["input_ids"])
-        last = logits[:, -1, :].at[:, 0].set(-jnp.inf)
+        logits, _ = model.apply(
+            {"params": params}, batch["input_ids"], batch.get("timestamps")
+        )
+        last = logits[:, -1, :].astype(jnp.float32).at[:, 0].set(-jnp.inf)
         _, top = jax.lax.top_k(last, 10)
-        # Padded rows (valid=0) are masked out of every sum.
         ranks = first_match_ranks(batch["targets"], top[..., None])
         v = valid.astype(jnp.float32)
         out = {"total": v.sum()}
         for k in (1, 5, 10):
             out[f"recall_sum@{k}"] = jnp.sum((ranks < k) * v)
             out[f"ndcg_sum@{k}"] = jnp.sum(
-                jnp.where(ranks < k, 1.0 / jnp.log2(ranks.astype(jnp.float32) + 2.0), 0.0)
-                * v
+                jnp.where(ranks < k, 1.0 / jnp.log2(ranks.astype(jnp.float32) + 2.0), 0.0) * v
             )
         return out
 
     return eval_step
 
 
-def evaluate(eval_step, params, arrays, batch_size, mesh) -> dict[str, float]:
+def evaluate(eval_step, params, arrays, batch_size, mesh):
     sums: dict[str, float] = {}
     for batch, valid in batch_iterator(arrays, batch_size):
         sharded = shard_batch(mesh, {**batch, "valid": valid.astype(np.int32)})
@@ -58,11 +54,10 @@ def evaluate(eval_step, params, arrays, batch_size, mesh) -> dict[str, float]:
             sums[k] = sums.get(k, 0.0) + float(v)
     sums = metric_allreduce(sums)
     total = max(sums.get("total", 0.0), 1.0)
-    out = {}
-    for k in (1, 5, 10):
-        out[f"Recall@{k}"] = sums[f"recall_sum@{k}"] / total
-        out[f"NDCG@{k}"] = sums[f"ndcg_sum@{k}"] / total
-    return out
+    return {
+        **{f"Recall@{k}": sums[f"recall_sum@{k}"] / total for k in (1, 5, 10)},
+        **{f"NDCG@{k}": sums[f"ndcg_sum@{k}"] / total for k in (1, 5, 10)},
+    }
 
 
 @configlib.configurable
@@ -75,8 +70,12 @@ def train(
     embed_dim=64,
     num_heads=2,
     num_blocks=2,
-    ffn_dim=256,
     dropout=0.2,
+    num_position_buckets=32,
+    num_time_buckets=64,
+    max_position_distance=128,
+    use_temporal_bias=True,
+    use_pallas="auto",
     dataset="synthetic",
     dataset_folder="dataset/amazon",
     split="beauty",
@@ -84,16 +83,15 @@ def train(
     do_eval=True,
     eval_every_epoch=1,
     eval_batch_size=256,
-    save_dir_root="out/sasrec",
+    save_dir_root="out/hstu",
     save_every_epoch=50,
     wandb_logging=False,
-    wandb_project="sasrec_training",
+    wandb_project="hstu_training",
     wandb_log_interval=100,
     amp=True,
     mixed_precision_type="bf16",
     seed=0,
 ):
-    """Returns final (valid_metrics, test_metrics) for programmatic use."""
     distributed_init()
     logger = setup_logger(save_dir_root)
     tracker = Tracker(wandb_logging, wandb_project, save_dir=save_dir_root)
@@ -102,84 +100,85 @@ def train(
     if dataset == "synthetic":
         ds = SyntheticSeqDataset(max_seq_len=max_seq_len, seed=seed)
         n_items = num_items or ds.num_items
-        train_arrays = ds.train_arrays()
-        valid_arrays = ds.eval_arrays("valid")
-        test_arrays = ds.eval_arrays("test")
+        train_arrays = ds.train_arrays_with_time()
+        valid_arrays = ds.eval_arrays_with_time("valid")
+        test_arrays = ds.eval_arrays_with_time("test")
     else:
         from genrec_tpu.data.amazon import AmazonSASRecData
 
-        ds = AmazonSASRecData(root=dataset_folder, split=split, max_seq_len=max_seq_len)
+        ds = AmazonSASRecData(
+            root=dataset_folder, split=split, max_seq_len=max_seq_len,
+            with_timestamps=True,
+        )
         n_items = ds.num_items
         train_arrays = ds.train_arrays()
         valid_arrays = ds.eval_arrays("valid")
         test_arrays = ds.eval_arrays("test")
 
-    compute_dtype = (
-        jnp.bfloat16 if (amp and mixed_precision_type == "bf16") else jnp.float32
-    )
-    model = SASRec(
+    compute_dtype = jnp.bfloat16 if (amp and mixed_precision_type == "bf16") else jnp.float32
+    if use_pallas == "auto":
+        # The fused kernel compiles only under Mosaic; interpret mode on
+        # CPU is correct but slow, so auto = TPU-only.
+        use_pallas = jax.default_backend() == "tpu"
+    model = HSTU(
         num_items=n_items,
         max_seq_len=max_seq_len,
         embed_dim=embed_dim,
         num_heads=num_heads,
         num_blocks=num_blocks,
-        ffn_dim=ffn_dim,
         dropout=dropout,
+        num_position_buckets=num_position_buckets,
+        num_time_buckets=num_time_buckets,
+        max_position_distance=max_position_distance,
+        use_temporal_bias=use_temporal_bias,
+        use_pallas=bool(use_pallas),
         dtype=compute_dtype,
     )
     rng = jax.random.key(seed)
     init_rng, state_rng = jax.random.split(rng)
     params = model.init(
-        init_rng, jnp.zeros((1, max_seq_len), jnp.int32), deterministic=True
+        init_rng, jnp.zeros((1, max_seq_len), jnp.int32),
+        jnp.zeros((1, max_seq_len), jnp.int32),
     )["params"]
 
-    # Reference uses Adam with beta2=0.98 and no schedule.
     optimizer = (
         optax.adamw(learning_rate, b2=0.98, weight_decay=weight_decay)
         if weight_decay
         else optax.adam(learning_rate, b2=0.98)
     )
 
-    def loss_fn(params, batch, step_rng):
+    def loss_fn(p, batch, step_rng):
         _, loss = model.apply(
-            {"params": params},
-            batch["input_ids"],
-            batch["targets"],
-            deterministic=False,
-            rngs={"dropout": step_rng},
+            {"params": p}, batch["input_ids"], batch.get("timestamps"),
+            batch["targets"], deterministic=False, rngs={"dropout": step_rng},
         )
         return loss, {}
 
     step_fn = jax.jit(make_train_step(loss_fn, optimizer, clip_norm=None), donate_argnums=0)
     state = replicate(mesh, TrainState.create(params, optimizer, state_rng))
-    eval_step = make_eval_step(model)  # one jit cache for every eval call
+    eval_step = make_eval_step(model)
 
     from genrec_tpu.core.checkpoint import CheckpointManager, save_params
 
-    ckpt_mgr = CheckpointManager(os.path.join(save_dir_root, "checkpoints")) if save_dir_root else None
+    ckpt = CheckpointManager(os.path.join(save_dir_root, "checkpoints")) if save_dir_root else None
 
     global_step = 0
-    best_recall = -1.0
-    best_params = None
+    best_recall, best_params = -1.0, None
     for epoch in range(epochs):
-        # Device-scalar accumulation: float() only at logging boundaries so
-        # the host never blocks on the jitted step (async dispatch).
         epoch_loss, n_batches = None, 0
         for batch, _ in batch_iterator(
             train_arrays, batch_size, shuffle=True, seed=seed, epoch=epoch, drop_last=True
         ):
-            state, metrics = step_fn(state, shard_batch(mesh, batch))
-            epoch_loss = metrics["loss"] if epoch_loss is None else epoch_loss + metrics["loss"]
+            state, m = step_fn(state, shard_batch(mesh, batch))
+            epoch_loss = m["loss"] if epoch_loss is None else epoch_loss + m["loss"]
             n_batches += 1
             global_step += 1
             if global_step % wandb_log_interval == 0:
-                tracker.log(
-                    {"global_step": global_step, "train/loss": float(metrics["loss"])}
-                )
+                tracker.log({"global_step": global_step, "train/loss": float(m["loss"])})
         logger.info(f"epoch {epoch} loss {float(epoch_loss) / n_batches if n_batches else 0.0:.4f}")
 
-        if ckpt_mgr is not None and (epoch + 1) % save_every_epoch == 0:
-            ckpt_mgr.save(epoch, jax.tree_util.tree_map(np.asarray, state.params))
+        if ckpt is not None and (epoch + 1) % save_every_epoch == 0:
+            ckpt.save(epoch, state)
 
         if do_eval and (epoch + 1) % eval_every_epoch == 0:
             m = evaluate(eval_step, state.params, valid_arrays, eval_batch_size, mesh)
@@ -196,11 +195,10 @@ def train(
     test_metrics = evaluate(eval_step, final_params, test_arrays, eval_batch_size, mesh)
     logger.info("test " + ", ".join(f"{k}={v:.4f}" for k, v in test_metrics.items()))
     tracker.log({f"test/{k}": v for k, v in test_metrics.items()})
-
     if save_dir_root:
         save_params(os.path.join(save_dir_root, "best_model"), final_params)
-    if ckpt_mgr is not None:
-        ckpt_mgr.close()
+    if ckpt is not None:
+        ckpt.close()
     tracker.finish()
     return valid_metrics, test_metrics
 

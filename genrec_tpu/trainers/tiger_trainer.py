@@ -202,7 +202,7 @@ def train(
             if global_step % wandb_log_interval == 0:
                 tracker.log({"global_step": global_step, "train/loss": float(m["loss"])})
         logger.info(
-            f"epoch {epoch} loss {float(epoch_loss) / max(n_batches, 1):.4f}"
+            f"epoch {epoch} loss {float(epoch_loss) / n_batches if n_batches else 0.0:.4f}"
         )
 
         if do_eval and (epoch + 1) % eval_every_epoch == 0:

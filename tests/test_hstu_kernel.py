@@ -1,0 +1,103 @@
+"""Fused HSTU attention kernel vs XLA reference implementation."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from genrec_tpu.kernels.hstu_attention import (
+    hstu_attention_pallas,
+    hstu_attention_xla,
+)
+
+
+def _inputs(B=2, H=2, L=50, hd=32, use_time=True, seed=0):
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.normal(size=(B, H, L, hd)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, H, L, hd)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, H, L, hd)), jnp.float32)
+    ts = jnp.asarray(
+        np.cumsum(rng.integers(3600, 2e5, size=(B, L)), axis=1) + 1_500_000_000,
+        jnp.int32,
+    ) if use_time else None
+    pad = np.zeros((B, L), bool)
+    pad[0, :7] = True
+    ptab = jnp.asarray(rng.normal(size=(H, 32)) * 0.1, jnp.float32)
+    ttab = (
+        jnp.asarray(rng.normal(size=(H, 64)) * 0.1, jnp.float32) if use_time else None
+    )
+    return q, k, v, ts, jnp.asarray(pad), ptab, ttab
+
+
+@pytest.mark.parametrize("use_time", [True, False])
+def test_kernel_matches_xla(use_time):
+    q, k, v, ts, pad, ptab, ttab = _inputs(use_time=use_time)
+    ref = hstu_attention_xla(q, k, v, ts, pad, ptab, ttab)
+    got = hstu_attention_pallas(q, k, v, ts, pad, ptab, ttab, interpret=True)
+    # Padded-query rows produce garbage in ref too (they're masked rows);
+    # compare only valid query rows.
+    valid = ~np.asarray(pad)
+    np.testing.assert_allclose(
+        np.asarray(got)[np.where(valid[:, None, :].repeat(2, 1))],
+        np.asarray(ref)[np.where(valid[:, None, :].repeat(2, 1))],
+        atol=2e-4, rtol=1e-4,
+    )
+
+
+def test_kernel_odd_lengths():
+    q, k, v, ts, pad, ptab, ttab = _inputs(L=37, hd=24, seed=1)
+    ref = hstu_attention_xla(q, k, v, ts, pad, ptab, ttab)
+    got = hstu_attention_pallas(q, k, v, ts, pad, ptab, ttab, interpret=True)
+    valid = ~np.asarray(pad)
+    sel = np.where(valid[:, None, :].repeat(2, 1))
+    np.testing.assert_allclose(np.asarray(got)[sel], np.asarray(ref)[sel],
+                               atol=2e-4, rtol=1e-4)
+
+
+def test_model_use_pallas_matches_xla_path():
+    """HSTU(use_pallas=True) forward == default path (interpret on CPU)."""
+    from genrec_tpu.models.hstu import HSTU
+
+    rng = np.random.default_rng(3)
+    ids = jnp.asarray(rng.integers(0, 21, (2, 12)), jnp.int32)
+    ts = jnp.asarray(
+        np.cumsum(rng.integers(3600, 2e5, size=(2, 12)), axis=1) + 1_500_000_000,
+        jnp.int32,
+    )
+    kw = dict(num_items=20, max_seq_len=12, embed_dim=16, num_heads=2,
+              num_blocks=2, dropout=0.0)
+    m_ref = HSTU(**kw)
+    m_pal = HSTU(**kw, use_pallas=True)
+    params = m_ref.init(jax.random.key(0), ids, ts)["params"]
+    l_ref, _ = m_ref.apply({"params": params}, ids, ts)
+    l_pal, _ = m_pal.apply({"params": params}, ids, ts)
+    np.testing.assert_allclose(np.asarray(l_pal), np.asarray(l_ref), atol=2e-4, rtol=1e-4)
+
+
+def test_custom_vjp_grads_match_xla():
+    from genrec_tpu.kernels.hstu_attention import hstu_attention
+
+    q, k, v, ts, pad, ptab, ttab = _inputs(B=1, H=2, L=16, hd=8)
+
+    # In interpret-safe sizes, compare grads of the custom-vjp op (pallas
+    # fwd would need TPU; here we only exercise the bwd wiring via the XLA
+    # forward) against direct XLA autodiff.
+    def loss_xla(q, k, v, ptab, ttab):
+        return jnp.sum(hstu_attention_xla(q, k, v, ts, pad, ptab, ttab) ** 2)
+
+    g_ref = jax.grad(loss_xla, argnums=(0, 1, 2, 3, 4))(q, k, v, ptab, ttab)
+
+    from genrec_tpu.kernels import hstu_attention as mod
+
+    orig = mod.hstu_attention_pallas
+    mod.hstu_attention_pallas = lambda *a, **kw: hstu_attention_xla(*a[:7])
+    try:
+        def loss_k(q, k, v, ptab, ttab):
+            return jnp.sum(hstu_attention(q, k, v, ts, pad, ptab, ttab) ** 2)
+
+        g_got = jax.grad(loss_k, argnums=(0, 1, 2, 3, 4))(q, k, v, ptab, ttab)
+    finally:
+        mod.hstu_attention_pallas = orig
+
+    for a, b in zip(g_ref, g_got):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4)
