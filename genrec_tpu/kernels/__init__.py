@@ -1,6 +1,6 @@
 """Pallas TPU kernels for the hot ops.
 
-The reference has no native kernels at all (SURVEY.md §2: 100%% Python,
+The reference has no native kernels at all (SURVEY.md §2: 100% Python,
 stock torch ops); this package is where the new framework's "native layer"
 lives: fused HSTU attention (rel/temporal bias computed inside the tile),
 with the XLA implementations as both fallback and backward-pass source.

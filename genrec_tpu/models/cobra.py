@@ -1,0 +1,583 @@
+"""COBRA: cascaded sparse-dense generative recommendation (arXiv:2503.02453).
+
+Parity target: reference genrec/models/cobra.py — interleaved C sparse
+codebook tokens + 1 dense text vector per item (CobraEmbedding :47-147,
+interleave_seq_mask :323-377), causal post-norm TransformerDecoder used
+decoder-only (:150-224; torch's cross-attention over an EMPTY memory
+contributes zero but its LayerNorm still applies — replicated), per-
+codebook heads with position-shifted supervision (codebook 0 predicted
+from the dense position, codebook c>0 from the previous codebook position,
+:417-457), dense in-batch InfoNCE masked by same-sequence (:466-495),
+codebook-entropy / per-codebook-accuracy metrics (:510-517), beam-search
+`generate` re-running the decoder per codebook step (:531-665), and
+`beam_fusion` = beam candidates + dense nearest-neighbour with
+alpha-blended scores (:679-760).
+
+TPU redesign:
+- the reference's scatter-based interleave becomes a static
+  reshape: (B, T, C, D) sparse ++ (B, T, 1, D) dense -> (B, T*(C+1), D) —
+  no scatter, no dynamic shapes (SURVEY.md §7 build item 8);
+- the dense-InfoNCE boolean compression (cobra.py:478-479) becomes
+  where-masking with a valid-row denominator — static shapes under jit;
+- generation is deterministic top-k beam search composed of C full
+  decoder calls, jit-friendly (static loop, static shapes per step).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from genrec_tpu.ops.normalize import l2norm
+
+_NEG_SIM = -1e4
+
+
+class CobraOutput(NamedTuple):
+    loss: jax.Array
+    loss_sparse: jax.Array
+    loss_dense: jax.Array
+    acc_correct: jax.Array
+    acc_total: jax.Array
+    recall_correct: jax.Array
+    recall_total: jax.Array
+    vec_cos_sim: jax.Array
+    codebook_entropy: jax.Array
+
+
+class CobraGenerationOutput(NamedTuple):
+    sem_ids: jax.Array  # (B, K, C)
+    dense_vecs: jax.Array  # (B, K, D)
+    scores: jax.Array  # (B, K)
+
+
+class BeamFusionOutput(NamedTuple):
+    item_ids: jax.Array  # (B, K)
+    sem_ids: jax.Array  # (B, K, C)
+    scores: jax.Array  # (B, K)
+
+
+class LightT5Encoder(nn.Module):
+    """Random-init text encoder: embed + post-norm transformer encoder,
+    mean-pool, project, L2-normalize (reference encoder.py:15-106)."""
+
+    n_layers: int = 1
+    hidden_dim: int = 768
+    output_dim: int = 768
+    num_heads: int = 8
+    ff_dim: int = 2048
+    vocab_size: int = 32128
+    max_seq_len: int = 512
+    dropout: float = 0.1
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, batch_tokens, deterministic: bool = True):
+        orig_3d = batch_tokens.ndim == 3
+        if orig_3d:
+            B, T, L = batch_tokens.shape
+            flat = batch_tokens.reshape(B * T, L)
+        else:
+            flat = batch_tokens
+            L = flat.shape[1]
+
+        emb = self.param(
+            "embedding", nn.initializers.normal(1.0), (self.vocab_size, self.hidden_dim)
+        )
+        pos = self.param(
+            "pos_embedding", nn.initializers.normal(1.0), (self.max_seq_len, self.hidden_dim)
+        )
+        x = emb[flat].astype(self.dtype) + pos[None, :L].astype(self.dtype)
+        pad = flat == 0
+
+        for i in range(self.n_layers):
+            x = _PostNormEncoderLayer(
+                self.hidden_dim, self.num_heads, self.ff_dim, self.dropout,
+                dtype=self.dtype, name=f"layer_{i}",
+            )(x, pad, deterministic)
+        x = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="layer_norm")(x)
+
+        mask = (~pad)[..., None].astype(jnp.float32)
+        pooled = (x * mask).sum(axis=1) / jnp.maximum(mask.sum(axis=1), 1e-9)
+        projected = nn.Dense(self.output_dim, dtype=self.dtype, name="proj")(pooled)
+        out = l2norm(projected)
+        if orig_3d:
+            out = out.reshape(B, T, -1)
+        return out
+
+
+class _TorchMHA(nn.Module):
+    """torch.nn.MultiheadAttention-equivalent self-attention (packed qkv
+    projection with bias, output projection with bias, scaled dot product)."""
+
+    dim: int
+    num_heads: int
+    dropout: float = 0.0
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, attn_mask=None, key_padding_mask=None, deterministic=True):
+        B, L, D = x.shape
+        H, hd = self.num_heads, D // self.num_heads
+        qkv = nn.Dense(3 * D, dtype=self.dtype, name="in_proj")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        split = lambda t: t.reshape(B, L, H, hd).transpose(0, 2, 1, 3)
+        q, k, v = split(q), split(k), split(v)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * (hd**-0.5)
+        # Finite fill, NOT -inf: fully-masked rows (padded queries) would
+        # otherwise produce NaN through the softmax GRADIENT, and NaN*0
+        # poisons the whole loss even though those rows are excluded from
+        # it. With -1e9 dead rows get uniform attention; their outputs only
+        # feed positions the losses mask out, and for live rows
+        # exp(-1e9 - max) underflows to exactly 0 — same result as -inf.
+        if attn_mask is not None:
+            scores = jnp.where(attn_mask[None, None], -1e9, scores)
+        if key_padding_mask is not None:
+            scores = jnp.where(key_padding_mask[:, None, None, :], -1e9, scores)
+        attn = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+        attn = nn.Dropout(self.dropout)(attn, deterministic=deterministic)
+        out = jnp.einsum("bhqk,bhkd->bhqd", attn, v)
+        out = out.transpose(0, 2, 1, 3).reshape(B, L, D)
+        return nn.Dense(D, dtype=self.dtype, name="out_proj")(out)
+
+
+class _PostNormEncoderLayer(nn.Module):
+    """torch nn.TransformerEncoderLayer (norm_first=False, relu)."""
+
+    dim: int
+    num_heads: int
+    ff_dim: int
+    dropout: float
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, key_padding_mask, deterministic):
+        h = _TorchMHA(self.dim, self.num_heads, self.dropout, self.dtype, name="self_attn")(
+            x, key_padding_mask=key_padding_mask, deterministic=deterministic
+        )
+        x = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="norm1")(
+            x + nn.Dropout(self.dropout)(h, deterministic=deterministic)
+        ).astype(x.dtype)
+        h = nn.Dense(self.ff_dim, dtype=self.dtype, name="linear1")(x)
+        h = nn.Dropout(self.dropout)(nn.relu(h), deterministic=deterministic)
+        h = nn.Dense(self.dim, dtype=self.dtype, name="linear2")(h)
+        x = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="norm2")(
+            x + nn.Dropout(self.dropout)(h, deterministic=deterministic)
+        ).astype(x.dtype)
+        return x
+
+
+class _PostNormDecoderLayer(nn.Module):
+    """torch nn.TransformerDecoderLayer with EMPTY memory: the cross-attn
+    term contributes zero but its add&norm still applies (cobra.py:205-216)."""
+
+    dim: int
+    num_heads: int
+    ff_dim: int
+    dropout: float
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, attn_mask, key_padding_mask, deterministic):
+        h = _TorchMHA(self.dim, self.num_heads, self.dropout, self.dtype, name="self_attn")(
+            x, attn_mask=attn_mask, key_padding_mask=key_padding_mask,
+            deterministic=deterministic,
+        )
+        x = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="norm1")(
+            x + nn.Dropout(self.dropout)(h, deterministic=deterministic)
+        ).astype(x.dtype)
+        # Cross-attention over empty memory == +0, then norm2. The (unused)
+        # cross projection params still exist in torch; they are omitted
+        # here deliberately — they receive no gradient either way.
+        x = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="norm2")(x).astype(x.dtype)
+        h = nn.Dense(self.ff_dim, dtype=self.dtype, name="linear1")(x)
+        h = nn.Dropout(self.dropout)(nn.relu(h), deterministic=deterministic)
+        h = nn.Dense(self.dim, dtype=self.dtype, name="linear2")(h)
+        x = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="norm3")(
+            x + nn.Dropout(self.dropout)(h, deterministic=deterministic)
+        ).astype(x.dtype)
+        return x
+
+
+class CobraDecoder(nn.Module):
+    hidden_dim: int = 768
+    n_layers: int = 6
+    n_heads: int = 12
+    ff_dim: int = 2048
+    dropout: float = 0.1
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, tgt, tgt_key_padding_mask=None, deterministic=True):
+        L = tgt.shape[1]
+        causal = jnp.triu(jnp.ones((L, L), bool), k=1)
+        x = tgt
+        for i in range(self.n_layers):
+            x = _PostNormDecoderLayer(
+                self.hidden_dim, self.n_heads, self.ff_dim, self.dropout,
+                dtype=self.dtype, name=f"layer_{i}",
+            )(x, causal, tgt_key_padding_mask, deterministic)
+        return x
+
+
+class CobraEmbedding(nn.Module):
+    """Interleave C sparse codebook embeddings + 1 dense vector per item.
+
+    Static-reshape interleave instead of the reference's scatter loop.
+    """
+
+    id_vocab_size: int
+    n_codebooks: int = 3
+    d_model: int = 768
+    max_len: int = 1024
+    dtype: jnp.dtype = jnp.float32
+
+    @property
+    def pad_id(self) -> int:
+        return self.id_vocab_size * self.n_codebooks
+
+    def setup(self):
+        self.id_embed = self.param(
+            "id_embed", nn.initializers.normal(1.0),
+            (self.id_vocab_size * self.n_codebooks + 1, self.d_model),
+        )
+        self.type_embed = self.param(
+            "type_embed", nn.initializers.normal(1.0), (2, self.d_model)
+        )
+        self.pos_embed = self.param(
+            "pos_embed", nn.initializers.normal(1.0), (self.max_len, self.d_model)
+        )
+
+    def __call__(self, input_ids, input_vecs, mask, n_complete_items: Optional[int] = None):
+        """input_ids (B, L), input_vecs (B, T, D), mask (B, L + T_complete)."""
+        B, L = input_ids.shape
+        C = self.n_codebooks
+        T_vecs = input_vecs.shape[1]
+        if n_complete_items is None:
+            n_complete_items = L // C
+        n_complete_tokens = n_complete_items * C
+
+        token_type = jnp.arange(L) % C
+        is_pad = input_ids == self.pad_id
+        offset_ids = jnp.where(is_pad, input_ids, input_ids + token_type[None] * self.id_vocab_size)
+        sparse = self.id_embed[offset_ids].astype(self.dtype)
+        # Pad row is the last table row; torch padding_idx pins it to zero.
+        sparse = jnp.where(is_pad[..., None], 0.0, sparse)
+
+        chunks = []
+        if n_complete_tokens > 0:
+            comp = sparse[:, :n_complete_tokens].reshape(B, n_complete_items, C, -1)
+            dense = input_vecs[:, :n_complete_items, None, :].astype(self.dtype)
+            inter = jnp.concatenate([comp, dense], axis=2)  # (B, T, C+1, D)
+            chunks.append(inter.reshape(B, n_complete_items * (C + 1), -1))
+        if L - n_complete_tokens > 0:
+            chunks.append(sparse[:, n_complete_tokens:])
+        h = jnp.concatenate(chunks, axis=1) if len(chunks) > 1 else chunks[0]
+
+        out_len = h.shape[1]
+        type_row = jnp.concatenate(
+            [
+                jnp.tile(jnp.concatenate([jnp.zeros(C, jnp.int32), jnp.ones(1, jnp.int32)]), n_complete_items),
+                jnp.zeros(L - n_complete_tokens, jnp.int32),
+            ]
+        )[:out_len]
+        m = mask[..., None].astype(self.dtype)
+        h = h * m
+        h = h + self.pos_embed[None, :out_len].astype(self.dtype) * m
+        h = h + self.type_embed[type_row][None].astype(self.dtype) * m
+        return h
+
+
+def interleave_seq_mask(seq_mask, C: int, n_complete_items: Optional[int] = None):
+    """(B, L) sparse mask -> (B, L + T_complete) with the dense slot after
+    each complete item carrying that item's last-sparse-token mask."""
+    B, L = seq_mask.shape
+    if n_complete_items is None:
+        n_complete_items = L // C
+    n_complete_tokens = n_complete_items * C
+    parts = []
+    if n_complete_tokens > 0:
+        comp = seq_mask[:, :n_complete_tokens].reshape(B, n_complete_items, C)
+        dense = comp[:, :, C - 1 : C]  # mask of last sparse token
+        parts.append(jnp.concatenate([comp, dense], axis=2).reshape(B, -1))
+    if L - n_complete_tokens > 0:
+        parts.append(seq_mask[:, n_complete_tokens:])
+    return jnp.concatenate(parts, axis=1) if len(parts) > 1 else parts[0]
+
+
+class Cobra(nn.Module):
+    encoder_n_layers: int = 1
+    encoder_hidden_dim: int = 768
+    encoder_num_heads: int = 8
+    encoder_vocab_size: int = 32128
+    id_vocab_size: int = 512
+    n_codebooks: int = 3
+    d_model: int = 768
+    max_len: int = 1024
+    temperature: float = 0.2
+    decoder_n_layers: int = 8
+    decoder_num_heads: int = 6
+    decoder_dropout: float = 0.1
+    dtype: jnp.dtype = jnp.float32
+
+    @property
+    def pad_id(self) -> int:
+        return self.id_vocab_size * self.n_codebooks
+
+    def setup(self):
+        self.encoder = LightT5Encoder(
+            n_layers=self.encoder_n_layers,
+            hidden_dim=self.encoder_hidden_dim,
+            output_dim=self.d_model,
+            num_heads=self.encoder_num_heads,
+            vocab_size=self.encoder_vocab_size,
+            dtype=self.dtype,
+            name="encoder",
+        )
+        self.cobra_emb = CobraEmbedding(
+            id_vocab_size=self.id_vocab_size,
+            n_codebooks=self.n_codebooks,
+            d_model=self.d_model,
+            max_len=self.max_len,
+            dtype=self.dtype,
+            name="cobra_emb",
+        )
+        self.decoder = CobraDecoder(
+            self.d_model, n_layers=self.decoder_n_layers,
+            n_heads=self.decoder_num_heads, dropout=self.decoder_dropout,
+            dtype=self.dtype, name="decoder",
+        )
+        self.sparse_head = [
+            nn.Dense(self.id_vocab_size, dtype=self.dtype, name=f"sparse_head_{c}")
+            for c in range(self.n_codebooks)
+        ]
+
+    # ---- training ---------------------------------------------------------
+
+    def __call__(self, input_ids, encoder_input_ids, deterministic=True) -> CobraOutput:
+        C = self.n_codebooks
+        vecs = self.encoder(encoder_input_ids, deterministic=deterministic)
+        B, TC = input_ids.shape
+        T = TC // C
+
+        sparse_mask = input_ids != self.pad_id
+        seq_mask = interleave_seq_mask(sparse_mask, C)
+        emb = self.cobra_emb(input_ids, vecs, seq_mask)
+        h = self.decoder(emb, tgt_key_padding_mask=~seq_mask, deterministic=deterministic)
+
+        n_pos = T - 1
+        loss_sparse = 0.0
+        total_correct = jnp.zeros((), jnp.int32)
+        total_tokens = jnp.zeros((), jnp.int32)
+        all_item_correct = jnp.ones((B, n_pos), bool)
+        all_valid = None
+        for c in range(C):
+            if c == 0:
+                pos_c = jnp.arange(0, T - 1) * (C + 1) + C  # dense positions
+                target_pos = jnp.arange(1, T) * C
+            else:
+                pos_c = jnp.arange(1, T) * (C + 1) + (c - 1)
+                target_pos = jnp.arange(1, T) * C + c
+            logits = self.sparse_head[c](h[:, pos_c, :]).astype(jnp.float32)
+            target = input_ids[:, target_pos]
+            valid = target != self.pad_id
+            if all_valid is None:
+                all_valid = valid
+            tgt_clip = jnp.clip(target, 0, self.id_vocab_size - 1)
+            logz = jax.nn.logsumexp(logits, axis=-1)
+            gold = jnp.take_along_axis(logits, tgt_clip[..., None], axis=-1)[..., 0]
+            ce = (logz - gold) * valid
+            loss_sparse = loss_sparse + ce.sum() / jnp.maximum(valid.sum(), 1)
+
+            pred1 = jnp.argmax(logits, axis=-1)
+            top5 = jax.lax.top_k(logits, 5)[1]
+            total_correct = total_correct + jnp.sum((pred1 == target) & valid)
+            total_tokens = total_tokens + valid.sum()
+            all_item_correct = all_item_correct & ((pred1 == target) | ~valid)
+        loss_sparse = loss_sparse / C
+
+        item_correct = all_item_correct & all_valid
+        recall_correct = item_correct.sum()
+        recall_total = all_valid.sum()
+
+        # Dense InfoNCE — static-shape where-masking instead of boolean
+        # compression (cobra.py:478-489).
+        vec_pos = jnp.arange(1, T) * (C + 1) + (C - 1)
+        vec_pred = h[:, vec_pos, :]
+        vec_gt = jax.lax.stop_gradient(vecs[:, 1:, :])
+        Q = B * (T - 1)
+        valid_dense = seq_mask[:, (C + 1) :: (C + 1)].reshape(Q)
+        vp = l2norm(vec_pred.reshape(Q, -1).astype(jnp.float32))
+        vg = l2norm(vec_gt.reshape(Q, -1).astype(jnp.float32))
+
+        seq_ids = jnp.repeat(jnp.arange(B), T - 1)
+        same_seq = (seq_ids[None, :] == seq_ids[:, None]) & ~jnp.eye(Q, dtype=bool)
+        sim = (vp @ vg.T) / self.temperature
+        sim = jnp.where(same_seq, _NEG_SIM, sim)
+        # Invalid columns must not act as negatives; invalid rows drop out.
+        sim = jnp.where(~valid_dense[None, :] & ~jnp.eye(Q, dtype=bool), _NEG_SIM, sim)
+        logz = jax.nn.logsumexp(sim, axis=-1)
+        diag = jnp.diagonal(sim)
+        dense_ce = (logz - diag) * valid_dense
+        loss_dense = dense_ce.sum() / jnp.maximum(valid_dense.sum(), 1)
+
+        cos = jnp.sum(vp * vg, axis=-1)
+        vec_cos_sim = jnp.sum(cos * valid_dense) / jnp.maximum(valid_dense.sum(), 1)
+
+        # Codebook usage entropy (reference hardcodes ::3; generalized to C).
+        entropies = []
+        for c in range(C):
+            ids_c = input_ids[:, c::C]
+            usage = jnp.bincount(ids_c.reshape(-1), length=self.pad_id + 1).astype(jnp.float32)
+            prob = usage / jnp.maximum(usage.sum(), 1)
+            entropies.append(-jnp.sum(prob * jnp.log(prob + 1e-12)))
+        codebook_entropy = jnp.mean(jnp.asarray(entropies))
+
+        return CobraOutput(
+            loss=loss_sparse + loss_dense,
+            loss_sparse=loss_sparse,
+            loss_dense=loss_dense,
+            acc_correct=total_correct,
+            acc_total=total_tokens,
+            recall_correct=recall_correct,
+            recall_total=recall_total,
+            vec_cos_sim=vec_cos_sim,
+            codebook_entropy=codebook_entropy,
+        )
+
+    # ---- generation -------------------------------------------------------
+
+    def encode_items(self, encoder_input_ids):
+        return self.encoder(encoder_input_ids, deterministic=True)
+
+    def decode_hidden(self, input_ids, vecs, n_complete_items):
+        """Run the decoder over (possibly partial) sequences; returns
+        (h, seq_mask)."""
+        sparse_mask = input_ids != self.pad_id
+        seq_mask = interleave_seq_mask(sparse_mask, self.n_codebooks, n_complete_items)
+        emb = self.cobra_emb(input_ids, vecs, seq_mask, n_complete_items)
+        h = self.decoder(emb, tgt_key_padding_mask=~seq_mask, deterministic=True)
+        return h, seq_mask
+
+
+def cobra_generate(
+    model: Cobra,
+    params,
+    input_ids,
+    encoder_input_ids,
+    n_candidates: int = 10,
+    temperature: float = 1.0,
+    item_vecs=None,
+) -> CobraGenerationOutput:
+    """Deterministic top-k beam search over the C codebooks (jit-friendly:
+    C full decoder calls on static shapes, mirroring cobra.py:531-665)."""
+    C = model.n_codebooks
+    K = n_candidates
+    V = model.id_vocab_size
+    B = input_ids.shape[0]
+
+    vecs = (
+        item_vecs
+        if item_vecs is not None
+        else model.apply({"params": params}, encoder_input_ids, method=Cobra.encode_items)
+    )
+    T_items = vecs.shape[1]
+
+    beam_tokens = None  # (B, K, c)
+    beam_scores = None
+    h_last = None
+    for c in range(C):
+        if c == 0:
+            h, seq_mask = model.apply(
+                {"params": params}, input_ids, vecs, T_items,
+                method=Cobra.decode_hidden,
+            )
+            seq_lens = seq_mask.sum(axis=1)
+            h_c = h[jnp.arange(B), seq_lens - 1]  # (B, D) last dense pos
+            logits = _apply_head(model, params, 0, h_c) / temperature
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            beam_scores, tok = jax.lax.top_k(logp, K)  # (B, K)
+            beam_tokens = tok[..., None]  # (B, K, 1)
+            if C == 1:
+                h_last = jnp.broadcast_to(h_c[:, None], (B, K, h_c.shape[-1]))
+        else:
+            flat_ids = jnp.concatenate(
+                [
+                    jnp.broadcast_to(input_ids[:, None], (B, K, input_ids.shape[1])),
+                    beam_tokens,
+                ],
+                axis=-1,
+            ).reshape(B * K, -1)
+            flat_vecs = jnp.broadcast_to(
+                vecs[:, None], (B, K, T_items, vecs.shape[-1])
+            ).reshape(B * K, T_items, -1)
+            h, seq_mask = model.apply(
+                {"params": params}, flat_ids, flat_vecs, T_items,
+                method=Cobra.decode_hidden,
+            )
+            seq_lens = seq_mask.sum(axis=1)
+            h_c = h[jnp.arange(B * K), seq_lens - 1]  # (B*K, D)
+            logits = _apply_head(model, params, c, h_c) / temperature
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1).reshape(B, K, V)
+            combined = (beam_scores[..., None] + logp).reshape(B, K * V)
+            beam_scores, idx = jax.lax.top_k(combined, K)
+            parent = idx // V
+            tok = idx % V
+            beam_tokens = jnp.concatenate(
+                [
+                    jnp.take_along_axis(beam_tokens, parent[..., None], axis=1),
+                    tok[..., None],
+                ],
+                axis=-1,
+            )
+            if c == C - 1:
+                h_k = h_c.reshape(B, K, -1)
+                h_last = jnp.take_along_axis(h_k, parent[..., None], axis=1)
+
+    return CobraGenerationOutput(
+        sem_ids=beam_tokens,
+        dense_vecs=l2norm(h_last.astype(jnp.float32)),
+        scores=beam_scores,
+    )
+
+
+def _apply_head(model: Cobra, params, c: int, x):
+    k = params[f"sparse_head_{c}"]
+    return x @ k["kernel"] + k["bias"]
+
+
+def beam_fusion(
+    model: Cobra,
+    params,
+    input_ids,
+    encoder_input_ids,
+    item_dense_vecs,
+    item_sem_ids,
+    n_candidates: int = 10,
+    n_beam: int = 50,
+    temperature: float = 1.0,
+    alpha: float = 0.5,
+    item_vecs=None,
+) -> BeamFusionOutput:
+    """Beam candidates + dense nearest-neighbour, alpha-fused (cobra.py:679-760).
+
+    The dense similarity is one (B, n_beam, D) x (D, N) matmul — pure MXU.
+    """
+    gen = cobra_generate(
+        model, params, input_ids, encoder_input_ids,
+        n_candidates=n_beam, temperature=temperature, item_vecs=item_vecs,
+    )
+    item_vecs_n = l2norm(item_dense_vecs.astype(jnp.float32))
+    sim = jnp.einsum("bkd,nd->bkn", gen.dense_vecs, item_vecs_n)
+    max_sim = sim.max(axis=-1)
+    best_item = jnp.argmax(sim, axis=-1)  # (B, n_beam)
+
+    beam_norm = jax.nn.softmax(gen.scores, axis=-1)
+    fused = alpha * beam_norm + (1 - alpha) * (max_sim + 1) / 2
+    top_scores, top_idx = jax.lax.top_k(fused, n_candidates)
+    item_ids = jnp.take_along_axis(best_item, top_idx, axis=1)
+    sem_ids = item_sem_ids[item_ids]
+    return BeamFusionOutput(item_ids=item_ids, sem_ids=sem_ids, scores=top_scores)

@@ -132,15 +132,17 @@ class HSTULayer(nn.Module):
                 self.position_bias.table(), ttab, self.max_position_distance,
             )
         else:
-            scores = jnp.einsum("bhqd,bhkd->bhqk", Q, K).astype(jnp.float32)
-            scores = scores + self.position_bias(L)[None]
-            if self.use_temporal_bias and timestamps is not None:
-                scores = scores + self.temporal_bias(timestamps)
-            causal = jnp.triu(jnp.ones((L, L), bool), k=1)
-            scores = jnp.where(causal[None, None], _NEG, scores)
-            scores = jnp.where(padding_mask[:, None, None, :], _NEG, scores)
-            attn = nn.silu(scores).astype(x.dtype)
-            out = jnp.einsum("bhqk,bhkd->bhqd", attn, V)
+            from genrec_tpu.kernels.hstu_attention import hstu_attention_xla
+
+            ttab = (
+                self.temporal_bias.table()
+                if (self.use_temporal_bias and timestamps is not None)
+                else None
+            )
+            out = hstu_attention_xla(
+                Q, K, V, timestamps if ttab is not None else None, padding_mask,
+                self.position_bias.table(), ttab, self.max_position_distance,
+            ).astype(x.dtype)
         out = out.transpose(0, 2, 1, 3).reshape(B, L, D)
         out = self.attn_norm(out).astype(x.dtype) * U
         x = residual + self.drop(out, deterministic=deterministic)

@@ -15,10 +15,15 @@ import jax.numpy as jnp
 def l2norm(x: jax.Array, axis: int = -1, eps: float = 1e-12) -> jax.Array:
     """L2-normalize along ``axis``.
 
-    Matches torch.nn.functional.normalize: divides by max(||x||, eps) so the
-    zero vector maps to zero rather than NaN.
+    Matches torch.nn.functional.normalize (divides by max(||x||, eps), so
+    the zero vector maps to zero) — but clamps BEFORE the sqrt: sqrt at 0
+    has an infinite derivative and the 0 * inf in the chain rule poisons
+    gradients of any loss touching an exactly-zero vector (e.g. padded
+    items at init). max(sqrt(max(s, eps^2)), eps) == max(sqrt(s), eps)
+    pointwise, with a finite gradient everywhere.
     """
-    n = jnp.linalg.norm(x, ord=2, axis=axis, keepdims=True)
+    sq = jnp.sum(x * x, axis=axis, keepdims=True)
+    n = jnp.sqrt(jnp.maximum(sq, eps * eps))
     return x / jnp.maximum(n, eps)
 
 

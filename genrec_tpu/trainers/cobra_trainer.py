@@ -1,0 +1,248 @@
+"""COBRA trainer (parity target: reference genrec/trainers/cobra_trainer.py).
+
+Epoch loop, AdamW + cosine schedule, weighted sparse+dense loss
+(:359-362); eval recomputes all item dense vecs from the current encoder
+(:303-334), runs `beam_fusion` (n_beam=20, alpha=0.5) and accumulates
+TopKAccumulator + per-codebook top-1 accuracy (:414-452).
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from genrec_tpu import configlib
+from genrec_tpu.core.harness import make_train_step
+from genrec_tpu.core.logging import Tracker, setup_logger
+from genrec_tpu.core.state import TrainState
+from genrec_tpu.data.batching import batch_iterator, pad_to_batch
+from genrec_tpu.data.cobra_seq import CobraSeqData, synthetic_cobra_data
+from genrec_tpu.models.cobra import Cobra, beam_fusion
+from genrec_tpu.ops.metrics import TopKAccumulator
+from genrec_tpu.ops.schedules import cosine_schedule_with_warmup
+from genrec_tpu.parallel import distributed_init, get_mesh, replicate, shard_batch
+
+
+def compute_item_dense_vecs(model, params, item_texts: np.ndarray, batch_size=256):
+    """Dense vectors for every item from the CURRENT encoder (re-done each
+    eval; reference cobra_trainer.py:303-334)."""
+
+    @jax.jit
+    def enc(p, txt):
+        return model.apply({"params": p}, txt[:, None, :], method=Cobra.encode_items)[:, 0]
+
+    outs = []
+    n = len(item_texts)
+    for s in range(0, n, batch_size):
+        chunk = {"t": item_texts[s : s + batch_size]}
+        n_real = len(chunk["t"])
+        padded, _ = pad_to_batch(chunk, batch_size)
+        outs.append(np.asarray(enc(params, padded["t"]))[:n_real])
+    return jnp.asarray(np.concatenate(outs))
+
+
+def make_fusion_fn(model, item_sem_ids, n_candidates, n_beam, alpha):
+    @jax.jit
+    def fuse(params, batch, item_vecs):
+        return beam_fusion(
+            model, params, batch["input_ids"], batch["encoder_input_ids"],
+            item_vecs, item_sem_ids,
+            n_candidates=n_candidates, n_beam=n_beam, alpha=alpha,
+        )
+
+    return fuse
+
+
+def evaluate(fusion_fn, params, arrays, item_vecs, batch_size, mesh, C):
+    acc = TopKAccumulator(ks=(1, 5, 10))
+    cb_correct = np.zeros(C)
+    cb_total = 0
+    for batch, valid in batch_iterator(arrays, batch_size):
+        out = fusion_fn(params, shard_batch(mesh, batch), item_vecs)
+        n = int(valid.sum())
+        topk = np.asarray(out.sem_ids)[:n]
+        target = batch["target_sem_ids"][:n]
+        acc.accumulate(jnp.asarray(target), jnp.asarray(topk))
+        top1 = topk[:, 0, :]
+        for c in range(C):
+            cb_correct[c] += (top1[:, c] == target[:, c]).sum()
+        cb_total += n
+    metrics = acc.reduce(cross_process=True)
+    metrics.update({f"codebook_acc_{c}": cb_correct[c] / max(cb_total, 1) for c in range(C)})
+    return metrics
+
+
+@configlib.configurable
+def train(
+    epochs=50,
+    batch_size=64,
+    learning_rate=3e-4,
+    num_warmup_steps=100,
+    weight_decay=0.01,
+    sparse_loss_weight=1.0,
+    dense_loss_weight=1.0,
+    encoder_n_layers=1,
+    encoder_hidden_dim=768,
+    encoder_num_heads=8,
+    encoder_vocab_size=32128,
+    id_vocab_size=512,
+    n_codebooks=3,
+    d_model=768,
+    max_len=1024,
+    infonce_temperature=0.2,
+    decoder_n_layers=8,
+    decoder_num_heads=6,
+    decoder_dropout=0.1,
+    max_items=20,
+    n_beam=20,
+    fusion_alpha=0.5,
+    dataset="synthetic",
+    dataset_folder="dataset/amazon",
+    split="beauty",
+    sem_ids_path=None,
+    do_eval=True,
+    eval_every_epoch=10,
+    eval_batch_size=32,
+    save_dir_root="out/cobra",
+    save_every_epoch=50,
+    wandb_logging=False,
+    wandb_project="cobra_training",
+    wandb_log_interval=100,
+    amp=True,
+    mixed_precision_type="bf16",
+    seed=0,
+):
+    distributed_init()
+    logger = setup_logger(save_dir_root)
+    tracker = Tracker(wandb_logging, wandb_project, save_dir=save_dir_root)
+    mesh = get_mesh()
+
+    if dataset == "synthetic":
+        data = synthetic_cobra_data(
+            id_vocab_size=id_vocab_size, n_codebooks=n_codebooks,
+            text_vocab=encoder_vocab_size, max_items=max_items, seed=seed,
+        )
+    else:
+        raise NotImplementedError(
+            "amazon COBRA data needs tokenized item text; run the "
+            "sentence-T5 preprocessing (data/items.py) and wire "
+            "CobraSeqData(load_sequences(...), load_sem_ids(...), texts)."
+        )
+
+    train_arrays = data.train_arrays()
+    valid_arrays = data.eval_arrays("valid")
+    test_arrays = data.eval_arrays("test")
+    item_sem_ids = jnp.asarray(data.sem_ids)
+
+    compute_dtype = jnp.bfloat16 if (amp and mixed_precision_type == "bf16") else jnp.float32
+    model = Cobra(
+        encoder_n_layers=encoder_n_layers,
+        encoder_hidden_dim=encoder_hidden_dim,
+        encoder_num_heads=encoder_num_heads,
+        encoder_vocab_size=encoder_vocab_size,
+        id_vocab_size=id_vocab_size,
+        n_codebooks=n_codebooks,
+        d_model=d_model,
+        max_len=max_len,
+        temperature=infonce_temperature,
+        decoder_n_layers=decoder_n_layers,
+        decoder_num_heads=decoder_num_heads,
+        decoder_dropout=decoder_dropout,
+        dtype=compute_dtype,
+    )
+    rng = jax.random.key(seed)
+    init_rng, state_rng = jax.random.split(rng)
+    params = model.init(
+        init_rng,
+        jnp.full((1, (max_items + 1) * n_codebooks), data.pad_id, jnp.int32),
+        jnp.zeros((1, max_items + 1, data.item_texts.shape[1]), jnp.int32),
+    )["params"]
+
+    steps_per_epoch = max(1, len(train_arrays["input_ids"]) // batch_size)
+    total_steps = epochs * steps_per_epoch
+    schedule = cosine_schedule_with_warmup(learning_rate, num_warmup_steps, total_steps)
+    optimizer = optax.adamw(schedule, weight_decay=weight_decay)
+
+    def loss_fn(p, batch, step_rng):
+        out = model.apply(
+            {"params": p}, batch["input_ids"], batch["encoder_input_ids"],
+            deterministic=False, rngs={"dropout": step_rng},
+        )
+        loss = sparse_loss_weight * out.loss_sparse + dense_loss_weight * out.loss_dense
+        return loss, {
+            "loss_sparse": out.loss_sparse,
+            "loss_dense": out.loss_dense,
+            "acc": out.acc_correct / jnp.maximum(out.acc_total, 1),
+            "codebook_entropy": out.codebook_entropy,
+        }
+
+    step_fn = jax.jit(make_train_step(loss_fn, optimizer, clip_norm=1.0), donate_argnums=0)
+    state = replicate(mesh, TrainState.create(params, optimizer, state_rng))
+    fusion_fn = make_fusion_fn(model, item_sem_ids, 10, n_beam, fusion_alpha)
+
+    from genrec_tpu.core.checkpoint import CheckpointManager, save_params
+
+    ckpt = CheckpointManager(os.path.join(save_dir_root, "checkpoints")) if save_dir_root else None
+
+    global_step = 0
+    best_recall, best_params = -1.0, None
+    for epoch in range(epochs):
+        epoch_loss, n_batches = None, 0
+        for batch, _ in batch_iterator(
+            train_arrays, batch_size, shuffle=True, seed=seed, epoch=epoch, drop_last=True
+        ):
+            state, m = step_fn(state, shard_batch(mesh, batch))
+            epoch_loss = m["loss"] if epoch_loss is None else epoch_loss + m["loss"]
+            n_batches += 1
+            global_step += 1
+            if global_step % wandb_log_interval == 0:
+                tracker.log(
+                    {
+                        "global_step": global_step,
+                        "train/loss": float(m["loss"]),
+                        "train/loss_sparse": float(m["loss_sparse"]),
+                        "train/loss_dense": float(m["loss_dense"]),
+                        "train/acc": float(m["acc"]),
+                        "train/codebook_entropy": float(m["codebook_entropy"]),
+                    }
+                )
+        logger.info(f"epoch {epoch} loss {float(epoch_loss) / n_batches if n_batches else 0.0:.4f}")
+
+        if ckpt is not None and (epoch + 1) % save_every_epoch == 0:
+            ckpt.save(epoch, state)
+
+        if do_eval and (epoch + 1) % eval_every_epoch == 0:
+            item_vecs = compute_item_dense_vecs(model, state.params, data.item_texts)
+            m = evaluate(fusion_fn, state.params, valid_arrays, item_vecs,
+                         eval_batch_size, mesh, n_codebooks)
+            logger.info(
+                f"epoch {epoch} valid " + ", ".join(f"{k}={v:.4f}" for k, v in m.items())
+            )
+            tracker.log({"epoch": epoch, **{f"eval/{k}": v for k, v in m.items()}})
+            if m["Recall@10"] > best_recall:
+                best_recall = m["Recall@10"]
+                best_params = jax.tree_util.tree_map(np.asarray, state.params)
+
+    final_params = state.params if best_params is None else best_params
+    item_vecs = compute_item_dense_vecs(model, final_params, data.item_texts)
+    valid_metrics = evaluate(fusion_fn, final_params, valid_arrays, item_vecs,
+                             eval_batch_size, mesh, n_codebooks)
+    test_metrics = evaluate(fusion_fn, final_params, test_arrays, item_vecs,
+                            eval_batch_size, mesh, n_codebooks)
+    logger.info("test " + ", ".join(f"{k}={v:.4f}" for k, v in test_metrics.items()))
+    tracker.log({f"test/{k}": v for k, v in test_metrics.items()})
+    if save_dir_root:
+        save_params(os.path.join(save_dir_root, "best_model"), final_params)
+    if ckpt is not None:
+        ckpt.close()
+    tracker.finish()
+    return valid_metrics, test_metrics
+
+
+if __name__ == "__main__":
+    configlib.parse_config()
+    train()

@@ -221,7 +221,7 @@ def train(
             )
 
         if ckpt is not None and ((epoch + 1) % save_model_every == 0 or epoch + 1 == epochs):
-            ckpt.save(epoch, jax.tree_util.tree_map(np.asarray, state.params))
+            ckpt.save(epoch, state)  # full TrainState: one resumable format everywhere
 
     # Export the portable sem-id artifact for downstream stages.
     sem_ids = compute_sem_ids(model, state.params, all_x)

@@ -179,7 +179,7 @@ def train(
         logger.info(f"epoch {epoch} loss {float(epoch_loss) / n_batches if n_batches else 0.0:.4f}")
 
         if ckpt_mgr is not None and (epoch + 1) % save_every_epoch == 0:
-            ckpt_mgr.save(epoch, jax.tree_util.tree_map(np.asarray, state.params))
+            ckpt_mgr.save(epoch, state)  # full TrainState: one resumable format everywhere
 
         if do_eval and (epoch + 1) % eval_every_epoch == 0:
             m = evaluate(eval_step, state.params, valid_arrays, eval_batch_size, mesh)

@@ -54,6 +54,19 @@ def test_kernel_odd_lengths():
                                atol=2e-4, rtol=1e-4)
 
 
+def test_kernel_multiple_query_blocks():
+    """Exercise the j-indexed paths (q_pos offset, timestamp slice, output
+    index map) with several query blocks: L=200, blk_q=64 -> 4 blocks."""
+    q, k, v, ts, pad, ptab, ttab = _inputs(L=200, hd=16, seed=2)
+    ref = hstu_attention_xla(q, k, v, ts, pad, ptab, ttab)
+    got = hstu_attention_pallas(q, k, v, ts, pad, ptab, ttab, blk_q=64,
+                                interpret=True)
+    valid = ~np.asarray(pad)
+    sel = np.where(valid[:, None, :].repeat(2, 1))
+    np.testing.assert_allclose(np.asarray(got)[sel], np.asarray(ref)[sel],
+                               atol=5e-4, rtol=1e-4)
+
+
 def test_model_use_pallas_matches_xla_path():
     """HSTU(use_pallas=True) forward == default path (interpret on CPU)."""
     from genrec_tpu.models.hstu import HSTU
