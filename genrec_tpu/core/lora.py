@@ -1,0 +1,70 @@
+"""LoRA via param surgery (reference wraps peft, lcrec_trainer.py:306-315).
+
+Pure-pytree implementation: `lora_init` creates low-rank (A, B) factors
+for every Dense kernel whose path matches a target substring;
+`lora_merge` produces effective params W + (alpha/r) * A @ B. Training
+optimizes ONLY the LoRA tree (the base stays frozen and closed over), so
+optimizer state is tiny — the standard LoRA memory win.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def _path_str(path) -> str:
+    return "/".join(str(getattr(p, "key", p)) for p in path)
+
+
+def lora_init(
+    params: Any,
+    key: jax.Array,
+    rank: int = 8,
+    targets: Sequence[str] = ("q_proj", "v_proj"),
+) -> dict:
+    """Return {path_str: {"a": (in, r), "b": (r, out)}} for matching 2D kernels.
+
+    A ~ N(0, 1/r), B = 0 — so the merged model starts exactly at the base.
+    """
+    flat = {}
+
+    def visit(path, leaf):
+        p = _path_str(path)
+        if (
+            leaf.ndim == 2
+            and p.endswith("kernel")
+            and any(t in p for t in targets)
+        ):
+            nonlocal key
+            key, sub = jax.random.split(key)
+            d_in, d_out = leaf.shape
+            flat[p] = {
+                "a": jax.random.normal(sub, (d_in, rank), leaf.dtype) / rank,
+                "b": jnp.zeros((rank, d_out), leaf.dtype),
+            }
+        return leaf
+
+    jax.tree_util.tree_map_with_path(visit, params)
+    return flat
+
+
+def lora_merge(params: Any, lora: dict, alpha: float = 16.0, rank: int = 8) -> Any:
+    """Effective params: W + (alpha/rank) * A @ B at matched paths."""
+    scale = alpha / rank
+
+    def visit(path, leaf):
+        p = _path_str(path)
+        if p in lora:
+            return leaf + scale * (lora[p]["a"] @ lora[p]["b"])
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(visit, params)
+
+
+def lora_param_count(lora: dict) -> int:
+    return sum(
+        int(v["a"].size + v["b"].size) for v in lora.values()
+    )

@@ -94,17 +94,12 @@ def synthetic_cobra_data(
     path can learn."""
     from genrec_tpu.data.synthetic import SyntheticSeqDataset
 
+    from genrec_tpu.data.sem_ids import random_unique_sem_ids
+
     ds = SyntheticSeqDataset(num_items=num_items, seed=seed, **seq_kwargs)
-    rng = np.random.default_rng(seed + 1)
-    seen = set()
-    sem_ids = np.zeros((num_items, n_codebooks), np.int32)
-    for i in range(num_items):
-        while True:
-            t = tuple(rng.integers(0, id_vocab_size, n_codebooks))
-            if t not in seen:
-                seen.add(t)
-                sem_ids[i] = t
-                break
+    sem_ids = random_unique_sem_ids(
+        num_items, id_vocab_size, n_codebooks, np.random.default_rng(seed + 1)
+    )
     # Deterministic item "words" + noise token.
     texts = np.zeros((num_items, text_len), np.int32)
     for i in range(num_items):

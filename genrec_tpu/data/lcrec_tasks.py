@@ -1,0 +1,266 @@
+"""LCRec SFT task factory + self-contained tokenizer.
+
+Parity target: reference genrec/data/amazon_lcrec.py — six SFT task
+families (seqrec, item2index, index2item, fusionseqrec, itemsearch,
+preferenceobtain; :5-12), prompt-template pools (:42-161), task sampling
+weights (:214-221), sem-id -> ``<Cc_k>`` token rendering (:456-475), and
+an Alpaca-style instruction/response frame (:29-33). Eval generates
+seqrec only (:432-454). Template TEXT here is original wording (behavioral
+role preserved; reference phrasing not copied).
+
+The `WordTokenizer` is a dependency-free stand-in for the HF tokenizer in
+zero-egress environments: word-level vocab + single-id special tokens for
+every ``<Cc_k>`` (the property the constrained decoder relies on —
+ConstrainedDecodingHelper only admits codebook tokens that tokenize to a
+single id, lcrec_trainer.py:100-104). Real runs pass an HF tokenizer with
+added special tokens instead.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+RESPONSE_MARKER = "### Response:"
+
+# Original template pools (several variants per task, as the reference has
+# large pools; wording is ours).
+_SEQREC_TEMPLATES = [
+    "The user interacted with these items in order: {history}. Predict the"
+    " next item's index.",
+    "Interaction history: {history}. Which item index comes next?",
+    "Given the browsing sequence {history}, generate the index of the item"
+    " the user will want next.",
+]
+_ITEM2INDEX_TEMPLATES = [
+    "Here is an item description: {text}. Output the item's index.",
+    "Map this item to its index tokens: {text}.",
+]
+_INDEX2ITEM_TEMPLATES = [
+    "Describe the item whose index is {index}.",
+    "What item does the index {index} refer to?",
+]
+_FUSIONSEQREC_TEMPLATES = [
+    "History with descriptions: {history_text}. Predict the next item's index.",
+]
+_ITEMSEARCH_TEMPLATES = [
+    "A user asks for: {query}. Return the index of the best-matching item.",
+]
+_PREFERENCE_TEMPLATES = [
+    "Given the interaction history {history}, summarize what the user prefers.",
+]
+
+TASKS = ("seqrec", "item2index", "index2item", "fusionseqrec", "itemsearch", "preferenceobtain")
+# Reference task sampling weights (amazon_lcrec.py:214-221 shape: seqrec-heavy).
+DEFAULT_TASK_WEIGHTS = (0.5, 0.15, 0.1, 0.1, 0.1, 0.05)
+
+
+def render_sem_id(sem_id) -> str:
+    """(c0, c1, ...) -> "<C0_5><C1_2>..." (amazon_lcrec.py:456-475)."""
+    return "".join(f"<C{c}_{int(k)}>" for c, k in enumerate(sem_id))
+
+
+def alpaca_frame(instruction: str, response: str = "") -> tuple[str, str]:
+    prompt = (
+        "Below is an instruction that describes a task. Write a response "
+        "that appropriately completes the request.\n\n### Instruction:\n"
+        f"{instruction}\n\n{RESPONSE_MARKER}\n"
+    )
+    return prompt, response
+
+
+class WordTokenizer:
+    """Word-level tokenizer with single-id special tokens.
+
+    ids: 0 = pad, 1 = eos, 2 = unk, then words, then codebook specials
+    appended LAST so they form the contiguous tail ranges the constrained
+    decoder slices.
+    """
+
+    def __init__(self, words: list[str], num_codebooks: int, codebook_size: int):
+        self.pad_id, self.eos_id, self.unk_id = 0, 1, 2
+        self.word_to_id = {w: i + 3 for i, w in enumerate(words)}
+        self.base_vocab = 3 + len(words)
+        self.num_codebooks = num_codebooks
+        self.codebook_size = codebook_size
+        self.special = {
+            f"<C{c}_{k}>": self.base_vocab + c * codebook_size + k
+            for c in range(num_codebooks)
+            for k in range(codebook_size)
+        }
+        self.vocab_size = self.base_vocab + num_codebooks * codebook_size
+
+    def encode(self, text: str) -> list[int]:
+        import re
+
+        out = []
+        for piece in re.split(r"(<C\d+_\d+>)", text):
+            if not piece:
+                continue
+            if piece in self.special:
+                out.append(self.special[piece])
+            else:
+                for w in piece.split():
+                    out.append(self.word_to_id.get(w, self.unk_id))
+        return out
+
+
+class LCRecTaskData:
+    """Build SFT samples over sequences + sem-ids + item texts."""
+
+    def __init__(
+        self,
+        sequences: list[np.ndarray],
+        sem_ids: np.ndarray,
+        item_texts: list[str],
+        tokenizer: WordTokenizer,
+        max_len: int = 96,
+        max_history: int = 8,
+        task_weights=DEFAULT_TASK_WEIGHTS,
+        seed: int = 0,
+    ):
+        self.sequences = sequences
+        self.sem_ids = np.asarray(sem_ids)
+        self.item_texts = item_texts
+        self.tok = tokenizer
+        self.max_len = max_len
+        self.max_history = max_history
+        self.task_weights = np.asarray(task_weights) / np.sum(task_weights)
+        self.rng = np.random.default_rng(seed)
+
+    def _index(self, item: int) -> str:
+        return render_sem_id(self.sem_ids[item - 1])
+
+    def _history_str(self, items) -> str:
+        return ", ".join(self._index(i) for i in items[-self.max_history :])
+
+    def _sample_for(self, task: str, seq: np.ndarray):
+        r = self.rng
+        body = seq[:-2]
+        if task == "seqrec" and len(body) >= 2:
+            t = r.integers(1, len(body))
+            tmpl = _SEQREC_TEMPLATES[r.integers(len(_SEQREC_TEMPLATES))]
+            return tmpl.format(history=self._history_str(body[:t])), self._index(body[t])
+        item = int(seq[r.integers(len(body))]) if len(body) else int(seq[0])
+        text = self.item_texts[item - 1]
+        if task == "item2index":
+            tmpl = _ITEM2INDEX_TEMPLATES[r.integers(len(_ITEM2INDEX_TEMPLATES))]
+            return tmpl.format(text=text), self._index(item)
+        if task == "index2item":
+            tmpl = _INDEX2ITEM_TEMPLATES[r.integers(len(_INDEX2ITEM_TEMPLATES))]
+            return tmpl.format(index=self._index(item)), text
+        if task == "fusionseqrec" and len(body) >= 2:
+            t = r.integers(1, len(body))
+            hist = ", ".join(
+                f"{self.item_texts[i - 1]} {self._index(i)}"
+                for i in body[max(0, t - 3) : t]
+            )
+            return _FUSIONSEQREC_TEMPLATES[0].format(history_text=hist), self._index(body[t])
+        if task == "itemsearch":
+            return _ITEMSEARCH_TEMPLATES[0].format(query=text), self._index(item)
+        if task == "preferenceobtain" and len(body) >= 2:
+            liked = " and ".join(self.item_texts[i - 1] for i in body[-3:])
+            return _PREFERENCE_TEMPLATES[0].format(history=self._history_str(body)), (
+                f"the user prefers {liked}"
+            )
+        # Fallback for short sequences.
+        return _ITEM2INDEX_TEMPLATES[0].format(text=text), self._index(item)
+
+    def _pack(self, prompt: str, response: str):
+        """Left-pad to max_len; labels = -100 on prompt and pad
+        (lcrec_trainer.py:43-84)."""
+        p_ids = self.tok.encode(prompt)
+        r_ids = self.tok.encode(response) + [self.tok.eos_id]
+        ids = (p_ids + r_ids)[-self.max_len :]
+        n_prompt = max(0, min(len(p_ids), self.max_len - len(r_ids)))
+        pad = self.max_len - len(ids)
+        input_ids = np.full(self.max_len, self.tok.pad_id, np.int32)
+        labels = np.full(self.max_len, -100, np.int32)
+        mask = np.zeros(self.max_len, np.int32)
+        input_ids[pad:] = ids
+        mask[pad:] = 1
+        labels[pad + n_prompt :] = ids[n_prompt:]
+        return input_ids, mask, labels
+
+    def train_arrays(self, samples_per_user: int = 2) -> dict:
+        out_i, out_m, out_l = [], [], []
+        for seq in self.sequences:
+            if len(seq) < 3:
+                continue
+            for _ in range(samples_per_user):
+                task = TASKS[self.rng.choice(len(TASKS), p=self.task_weights)]
+                prompt, response = self._sample_for(task, seq)
+                i, m, l = self._pack(*alpaca_frame(prompt, response))
+                out_i.append(i)
+                out_m.append(m)
+                out_l.append(l)
+        return {
+            "input_ids": np.stack(out_i),
+            "attention_mask": np.stack(out_m),
+            "labels": np.stack(out_l),
+        }
+
+    def eval_arrays(self, split: str = "valid") -> dict:
+        """seqrec-only eval (amazon_lcrec.py:432-454): prompt without
+        response; target = held-out item's sem-id tuple."""
+        out_i, out_m, out_t = [], [], []
+        for seq in self.sequences:
+            if len(seq) < 3:
+                continue
+            hist = seq[:-2] if split == "valid" else seq[:-1]
+            target = seq[-2] if split == "valid" else seq[-1]
+            prompt, _ = alpaca_frame(
+                _SEQREC_TEMPLATES[0].format(history=self._history_str(hist))
+            )
+            p_ids = self.tok.encode(prompt)[-self.max_len :]
+            pad = self.max_len - len(p_ids)
+            input_ids = np.full(self.max_len, self.tok.pad_id, np.int32)
+            mask = np.zeros(self.max_len, np.int32)
+            input_ids[pad:] = p_ids
+            mask[pad:] = 1
+            out_i.append(input_ids)
+            out_m.append(mask)
+            out_t.append(self.sem_ids[target - 1])
+        return {
+            "input_ids": np.stack(out_i),
+            "attention_mask": np.stack(out_m),
+            "target_ids": np.stack(out_t).astype(np.int32),
+        }
+
+
+def synthetic_lcrec_data(
+    num_items: int = 100,
+    codebook_size: int = 8,
+    num_codebooks: int = 3,
+    seed: int = 0,
+    **seq_kwargs,
+):
+    from genrec_tpu.data.synthetic import SyntheticSeqDataset
+
+    from genrec_tpu.data.sem_ids import random_unique_sem_ids
+
+    ds = SyntheticSeqDataset(num_items=num_items, seed=seed, **seq_kwargs)
+    sem_ids = random_unique_sem_ids(
+        num_items, codebook_size, num_codebooks, np.random.default_rng(seed + 1)
+    )
+    adjectives = ["red", "blue", "soft", "small", "large", "shiny", "warm", "light"]
+    nouns = ["cream", "ball", "shoe", "bag", "brush", "lotion", "soap", "towel"]
+    item_texts = [
+        f"{adjectives[i % len(adjectives)]} {nouns[(i // 8) % len(nouns)]} item{i}"
+        for i in range(num_items)
+    ]
+    words = sorted(
+        {w for t in item_texts for w in t.split()}
+        | {w for tmpl in (
+            "Below is an instruction that describes a task. Write a response "
+            "that appropriately completes the request. ### Instruction: "
+            "### Response: The user interacted with these items in order: "
+            "Predict the next item's index. Interaction history: Which item "
+            "index comes next? Given the browsing sequence generate of item "
+            "user will want Here is an description: Output the item's Map "
+            "this to its tokens: Describe whose what does refer to? History "
+            "with descriptions: A asks for: Return best-matching summarize "
+            "prefers and the a"
+        ).split() for w in [tmpl]}
+    )
+    tok = WordTokenizer(words, num_codebooks, codebook_size)
+    return LCRecTaskData(ds.sequences, sem_ids, item_texts, tok), tok

@@ -29,6 +29,29 @@ def load_sem_ids(path: str) -> tuple[np.ndarray, int]:
     return z["sem_ids"], int(z["codebook_size"])
 
 
+def random_unique_sem_ids(
+    num_items: int, codebook_size: int, dim: int, rng: np.random.Generator
+) -> np.ndarray:
+    """Distinct random sem-id tuples for synthetic datasets (shared by the
+    tiger/cobra/lcrec synthetic builders)."""
+    capacity = codebook_size**dim
+    if num_items > capacity:
+        raise ValueError(
+            f"cannot draw {num_items} distinct tuples from a {codebook_size}^{dim}"
+            f"={capacity} id space"
+        )
+    seen: set[tuple] = set()
+    out = np.zeros((num_items, dim), np.int32)
+    for i in range(num_items):
+        while True:
+            t = tuple(rng.integers(0, codebook_size, dim))
+            if t not in seen:
+                seen.add(t)
+                out[i] = t
+                break
+    return out
+
+
 def dedup_sem_ids(sem_ids: np.ndarray, codebook_size: int) -> np.ndarray:
     """Append a collision-disambiguation column (0..n within duplicates).
 

@@ -111,15 +111,10 @@ def synthetic_tiger_data(
     """Synthetic sequences + distinct random sem-id tuples (CI path)."""
     from genrec_tpu.data.synthetic import SyntheticSeqDataset
 
+    from genrec_tpu.data.sem_ids import random_unique_sem_ids
+
     ds = SyntheticSeqDataset(num_items=num_items, seed=seed, **seq_kwargs)
-    rng = np.random.default_rng(seed + 1)
-    seen = set()
-    sem_ids = np.zeros((num_items, sem_id_dim), np.int32)
-    for i in range(num_items):
-        while True:
-            t = tuple(rng.integers(0, codebook_size, sem_id_dim))
-            if t not in seen:
-                seen.add(t)
-                sem_ids[i] = t
-                break
+    sem_ids = random_unique_sem_ids(
+        num_items, codebook_size, sem_id_dim, np.random.default_rng(seed + 1)
+    )
     return TigerSeqData(ds.sequences, sem_ids, max_items=max_items)

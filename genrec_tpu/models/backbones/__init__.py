@@ -1,0 +1,5 @@
+"""LLM backbones (Qwen2-class decoder) for LCRec / NoteLLM."""
+
+from genrec_tpu.models.backbones.qwen import QwenConfig, QwenLM
+
+__all__ = ["QwenConfig", "QwenLM"]

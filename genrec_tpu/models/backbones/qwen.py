@@ -1,0 +1,247 @@
+"""Qwen2-class decoder-only LLM backbone in Flax.
+
+The reference's LCRec/NoteLLM wrap HF `AutoModelForCausalLM` with a
+Qwen2.5 backbone (lcrec.py:39-40, notellm.py:44-77; config/base.gin:19).
+This is the JAX equivalent (SURVEY.md §7 hard part #2): RMSNorm ->
+GQA attention with RoPE (q/k/v biased, o bias-free, Qwen2 layout) ->
+SwiGLU MLP, pre-norm residuals, optional tied LM head.
+
+Weight parity is tested against a random-init HF Qwen2ForCausalLM
+(instantiated offline from config) — see tests/test_qwen.py — and
+`params_from_hf_state_dict` converts real checkpoints when available.
+
+TPU notes: static shapes, fp32 softmax/norm statistics, bf16 matmuls via
+`dtype`; `jax.checkpoint`-friendly layer structure; decode uses a static
+KV cache (`init_cache` + per-step `decode_step`) so generation is one
+compiled while-free loop per new token.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from genrec_tpu.models.layers import RMSNorm
+
+
+@dataclasses.dataclass(frozen=True)
+class QwenConfig:
+    vocab_size: int = 151936
+    hidden_size: int = 1536
+    intermediate_size: int = 8960
+    num_hidden_layers: int = 28
+    num_attention_heads: int = 12
+    num_key_value_heads: int = 2
+    max_position_embeddings: int = 4096
+    rope_theta: float = 1_000_000.0
+    rms_norm_eps: float = 1e-6
+    tie_word_embeddings: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+
+def _rope(x, positions, theta):
+    """NeoX-style half-rotation RoPE. x: (B, L, H, hd), positions: (B, L)."""
+    hd = x.shape[-1]
+    half = hd // 2
+    freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # (B, L, half)
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    out = jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    )
+    return out.astype(x.dtype)
+
+
+class QwenAttention(nn.Module):
+    cfg: QwenConfig
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, positions, attn_bias, cache=None):
+        cfg = self.cfg
+        B, L, _ = x.shape
+        H, KV, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+        q = nn.Dense(H * hd, use_bias=True, dtype=self.dtype, name="q_proj")(x)
+        k = nn.Dense(KV * hd, use_bias=True, dtype=self.dtype, name="k_proj")(x)
+        v = nn.Dense(KV * hd, use_bias=True, dtype=self.dtype, name="v_proj")(x)
+        q = q.reshape(B, L, H, hd)
+        k = k.reshape(B, L, KV, hd)
+        v = v.reshape(B, L, KV, hd)
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+
+        new_cache = None
+        if cache is not None:
+            # cache: dict(k=(B, S, KV, hd), v=..., idx scalar): static-size
+            # decode cache updated at position idx.
+            idx = cache["idx"]
+            ck = jax.lax.dynamic_update_slice(cache["k"], k, (0, idx, 0, 0))
+            cv = jax.lax.dynamic_update_slice(cache["v"], v, (0, idx, 0, 0))
+            k, v = ck, cv
+            new_cache = {"k": ck, "v": cv, "idx": idx + L}
+
+        # GQA: repeat kv heads.
+        rep = H // KV
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+
+        scores = jnp.einsum("blhd,bshd->bhls", q, k).astype(jnp.float32) * (hd**-0.5)
+        scores = scores + attn_bias  # (B or 1, 1, L, S) additive
+        attn = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+        out = jnp.einsum("bhls,bshd->blhd", attn, v).reshape(B, L, H * hd)
+        out = nn.Dense(cfg.hidden_size, use_bias=False, dtype=self.dtype, name="o_proj")(out)
+        return out, new_cache
+
+
+class QwenMLP(nn.Module):
+    cfg: QwenConfig
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        gate = nn.Dense(cfg.intermediate_size, use_bias=False, dtype=self.dtype, name="gate_proj")(x)
+        up = nn.Dense(cfg.intermediate_size, use_bias=False, dtype=self.dtype, name="up_proj")(x)
+        return nn.Dense(cfg.hidden_size, use_bias=False, dtype=self.dtype, name="down_proj")(
+            nn.silu(gate) * up
+        )
+
+
+class QwenBlock(nn.Module):
+    cfg: QwenConfig
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, positions, attn_bias, cache=None):
+        h = RMSNorm(self.cfg.hidden_size, self.cfg.rms_norm_eps, name="input_layernorm")(x)
+        h, new_cache = QwenAttention(self.cfg, self.dtype, name="self_attn")(
+            h.astype(self.dtype), positions, attn_bias, cache
+        )
+        x = x + h
+        h = RMSNorm(self.cfg.hidden_size, self.cfg.rms_norm_eps, name="post_attention_layernorm")(x)
+        x = x + QwenMLP(self.cfg, self.dtype, name="mlp")(h.astype(self.dtype))
+        return x, new_cache
+
+
+class QwenLM(nn.Module):
+    cfg: QwenConfig
+    dtype: jnp.dtype = jnp.float32
+
+    def setup(self):
+        self.embed_tokens = self.param(
+            "embed_tokens", nn.initializers.normal(0.02),
+            (self.cfg.vocab_size, self.cfg.hidden_size),
+        )
+        self.blocks = [
+            QwenBlock(self.cfg, self.dtype, name=f"layer_{i}")
+            for i in range(self.cfg.num_hidden_layers)
+        ]
+        self.norm = RMSNorm(self.cfg.hidden_size, self.cfg.rms_norm_eps, name="norm")
+        if not self.cfg.tie_word_embeddings:
+            self.lm_head = self.param(
+                "lm_head", nn.initializers.normal(0.02),
+                (self.cfg.vocab_size, self.cfg.hidden_size),
+            )
+
+    def _head(self, h):
+        w = self.embed_tokens if self.cfg.tie_word_embeddings else self.lm_head
+        return h @ w.T.astype(self.dtype)
+
+    def __call__(self, input_ids, attention_mask=None, positions=None,
+                 return_hidden: bool = False):
+        """Full-sequence forward. attention_mask: (B, L) 1=valid."""
+        B, L = input_ids.shape
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(L), (B, L))
+        causal = jnp.where(jnp.triu(jnp.ones((L, L), bool), k=1), -1e9, 0.0)
+        bias = causal[None, None]
+        if attention_mask is not None:
+            bias = bias + jnp.where(attention_mask[:, None, None, :] == 0, -1e9, 0.0)
+
+        x = self.embed_tokens[input_ids].astype(self.dtype)
+        for block in self.blocks:
+            x, _ = block(x, positions, bias)
+        h = self.norm(x).astype(self.dtype)
+        logits = self._head(h)
+        if return_hidden:
+            return logits, h
+        return logits
+
+    # ---- KV-cache decode ---------------------------------------------------
+
+    def init_cache(self, batch_size: int, max_len: int):
+        cfg = self.cfg
+        return [
+            {
+                "k": jnp.zeros((batch_size, max_len, cfg.num_key_value_heads, cfg.head_dim), self.dtype),
+                "v": jnp.zeros((batch_size, max_len, cfg.num_key_value_heads, cfg.head_dim), self.dtype),
+                "idx": jnp.asarray(0, jnp.int32),
+            }
+            for _ in range(cfg.num_hidden_layers)
+        ]
+
+    def decode_step(self, input_ids, positions, caches, pad_mask):
+        """Advance by input_ids.shape[1] tokens against a static cache.
+
+        pad_mask: (B, S) 1 = valid cache slot (after this step's write).
+        Returns (logits_at_last, new_caches).
+        """
+        B, L = input_ids.shape
+        S = caches[0]["k"].shape[1]
+        # Bias over cache slots: mask invalid slots; also causal within the
+        # newly-written block.
+        slot = jnp.arange(S)[None, None, None, :]
+        write_pos = caches[0]["idx"] + jnp.arange(L)
+        causal = jnp.where(slot > write_pos[None, None, :, None], -1e9, 0.0)
+        bias = causal + jnp.where(pad_mask[:, None, None, :] == 0, -1e9, 0.0)
+
+        x = self.embed_tokens[input_ids].astype(self.dtype)
+        new_caches = []
+        for block, cache in zip(self.blocks, caches):
+            x, nc = block(x, positions, bias, cache)
+            new_caches.append(nc)
+        h = self.norm(x).astype(self.dtype)
+        return self._head(h)[:, -1, :], new_caches
+
+
+def params_from_hf_state_dict(sd: dict, cfg: QwenConfig) -> dict:
+    """Convert an HF Qwen2ForCausalLM state dict (numpy arrays) into this
+    module's param tree."""
+    lin = lambda p, bias: (
+        {"kernel": sd[p + ".weight"].T, "bias": sd[p + ".bias"]}
+        if bias
+        else {"kernel": sd[p + ".weight"].T}
+    )
+    params = {
+        "embed_tokens": sd["model.embed_tokens.weight"],
+        "norm": {"weight": sd["model.norm.weight"]},
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = sd["lm_head.weight"]
+    for i in range(cfg.num_hidden_layers):
+        p = f"model.layers.{i}"
+        params[f"layer_{i}"] = {
+            "self_attn": {
+                "q_proj": lin(f"{p}.self_attn.q_proj", True),
+                "k_proj": lin(f"{p}.self_attn.k_proj", True),
+                "v_proj": lin(f"{p}.self_attn.v_proj", True),
+                "o_proj": lin(f"{p}.self_attn.o_proj", False),
+            },
+            "mlp": {
+                "gate_proj": lin(f"{p}.mlp.gate_proj", False),
+                "up_proj": lin(f"{p}.mlp.up_proj", False),
+                "down_proj": lin(f"{p}.mlp.down_proj", False),
+            },
+            "input_layernorm": {"weight": sd[f"{p}.input_layernorm.weight"]},
+            "post_attention_layernorm": {"weight": sd[f"{p}.post_attention_layernorm.weight"]},
+        }
+    return params

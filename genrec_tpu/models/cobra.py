@@ -31,6 +31,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from genrec_tpu.ops.losses import cross_entropy_with_ignore
 from genrec_tpu.ops.normalize import l2norm
 
 _NEG_SIM = -1e4
@@ -386,10 +387,7 @@ class Cobra(nn.Module):
             valid = target != self.pad_id
             if all_valid is None:
                 all_valid = valid
-            tgt_clip = jnp.clip(target, 0, self.id_vocab_size - 1)
-            logz = jax.nn.logsumexp(logits, axis=-1)
-            gold = jnp.take_along_axis(logits, tgt_clip[..., None], axis=-1)[..., 0]
-            ce = (logz - gold) * valid
+            ce, _ = cross_entropy_with_ignore(logits, target, ignore_index=self.pad_id)
             loss_sparse = loss_sparse + ce.sum() / jnp.maximum(valid.sum(), 1)
 
             pred1 = jnp.argmax(logits, axis=-1)

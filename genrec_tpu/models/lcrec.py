@@ -1,0 +1,168 @@
+"""LCRec: LLM-based recommendation with collaborative semantics
+(arXiv:2311.09049, ICDE 2024).
+
+Parity target: reference genrec/models/lcrec.py — Qwen-class causal-LM
+backbone (:39-40), `<Ci_j>` codebook special tokens appended to the vocab
+with embedding resize (:48-60), SFT tokenization with prompt masking
+(:88-112, labels -100 on prompt/pad), batched constrained beam search
+(:164-243) driven by per-step allowed-token sets
+(lcrec_trainer.py:87-128's ConstrainedDecodingHelper).
+
+TPU redesign: because codebook tokens are appended as CONTIGUOUS vocab
+ranges, the per-step constraint is a static slice — step c scores only
+logits[base + c*K : base + (c+1)*K] — so the whole beam search compiles to
+one jitted program over a shared KV cache (prompt encoded once, beams
+share it) with no per-token host callback.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from genrec_tpu.models.backbones.qwen import QwenConfig, QwenLM
+
+
+class LCRecGenerationOutput(NamedTuple):
+    sem_ids: jax.Array  # (B, W, C) codebook indices (not token ids)
+    log_probas: jax.Array  # (B, W)
+
+
+def extend_vocab(cfg: QwenConfig, params, num_codebooks: int, codebook_size: int, key):
+    """Append num_codebooks*codebook_size codebook tokens to the vocab.
+
+    Mirrors `add_codebook_tokens` + `resize_token_embeddings`
+    (lcrec.py:48-60): new embedding rows are drawn from the backbone's
+    init distribution; token id of <Cc_k> = base_vocab + c*K + k.
+    Returns (new_cfg, new_params, base_vocab).
+    """
+    n_new = num_codebooks * codebook_size
+    base = cfg.vocab_size
+    new_cfg = QwenConfig(**{**cfg.__dict__, "vocab_size": base + n_new})
+    k1, k2 = jax.random.split(key)
+    params = dict(params)
+    emb = params["embed_tokens"]
+    new_rows = 0.02 * jax.random.normal(k1, (n_new, emb.shape[1]), emb.dtype)
+    params["embed_tokens"] = jnp.concatenate([emb, new_rows], axis=0)
+    if not cfg.tie_word_embeddings:
+        head = params["lm_head"]
+        new_head = 0.02 * jax.random.normal(k2, (n_new, head.shape[1]), head.dtype)
+        params["lm_head"] = jnp.concatenate([head, new_head], axis=0)
+    return new_cfg, params, base
+
+
+def sft_loss(model: QwenLM, params, input_ids, attention_mask, labels):
+    """Causal-LM CE with -100-masked labels (HF convention: logits at t
+    predict labels at t+1; reference lcrec_trainer.py uses model(labels=...))."""
+    from genrec_tpu.ops.losses import cross_entropy_with_ignore
+
+    logits = model.apply({"params": params}, input_ids, attention_mask=attention_mask)
+    per_tok, valid = cross_entropy_with_ignore(
+        logits[:, :-1, :], labels[:, 1:], ignore_index=-100
+    )
+    return per_tok.sum() / jnp.maximum(valid.sum(), 1)
+
+
+def generate_topk_constrained(
+    model: QwenLM,
+    params,
+    input_ids,
+    attention_mask,
+    base_vocab: int,
+    num_codebooks: int,
+    codebook_size: int,
+    beam_width: int = 10,
+    temperature: float = 1.0,
+    max_cache: int | None = None,
+):
+    """Constrained beam search over the codebook-token cascade.
+
+    The prompt (left-padded via attention_mask) is encoded once per batch
+    row into a KV cache; the cache is then broadcast across beams and C
+    decode steps run with the static per-step vocabulary slice. Fully
+    jittable (static shapes, no host callbacks).
+    """
+    B, L = input_ids.shape
+    W = beam_width
+    K = codebook_size
+    C = num_codebooks
+    S = max_cache or (L + C)
+
+    # Positions must be left-pad-aware (HF convention).
+    positions = jnp.maximum(jnp.cumsum(attention_mask, axis=1) - 1, 0)
+
+    caches = model.apply({"params": params}, B, S, method=QwenLM.init_cache)
+    pad = jnp.concatenate(
+        [attention_mask, jnp.zeros((B, S - L), attention_mask.dtype)], axis=1
+    )
+    logits, caches = model.apply(
+        {"params": params}, input_ids, positions, caches, pad,
+        method=QwenLM.decode_step,
+    )
+
+    def bcast_cache(c):
+        return {
+            "k": jnp.repeat(c["k"], W, axis=0),
+            "v": jnp.repeat(c["v"], W, axis=0),
+            "idx": c["idx"],
+        }
+
+    caches = [bcast_cache(c) for c in caches]
+    pad_bw = jnp.repeat(pad, W, axis=0)
+    next_pos = positions[:, -1] + 1  # (B,)
+
+    beam_tokens = jnp.zeros((B, W, C), jnp.int32)
+    beam_scores = jnp.full((B, W), -jnp.inf).at[:, 0].set(0.0)
+
+    for c in range(C):
+        lo = base_vocab + c * K
+        logp = jax.nn.log_softmax(
+            logits.astype(jnp.float32) / temperature, axis=-1
+        )
+        logp_w = jax.lax.dynamic_slice_in_dim(logp, lo, K, axis=1)
+        if c == 0:
+            # First step: all beams identical; expand from the B-row
+            # logits. With beam_width > codebook_size only K distinct
+            # first tokens exist — fill the rest with -inf beams (they
+            # are displaced by real W*K candidates at step 1).
+            W0 = min(W, K)
+            scores, toks = jax.lax.top_k(logp_w, W0)  # (B, W0)
+            if W0 < W:
+                scores = jnp.concatenate(
+                    [scores, jnp.full((B, W - W0), -jnp.inf)], axis=1
+                )
+                toks = jnp.concatenate(
+                    [toks, jnp.zeros((B, W - W0), toks.dtype)], axis=1
+                )
+            beam_scores = scores
+            beam_tokens = beam_tokens.at[:, :, 0].set(toks)
+        else:
+            logp_w = logp_w.reshape(B, W, K)
+            combined = (beam_scores[..., None] + logp_w).reshape(B, W * K)
+            beam_scores, idx = jax.lax.top_k(combined, W)
+            parent = idx // K
+            tok = idx % K
+            beam_tokens = jnp.take_along_axis(beam_tokens, parent[..., None], axis=1)
+            beam_tokens = beam_tokens.at[:, :, c].set(tok)
+            # Reorder caches to follow the selected parents.
+            flat_parent = (parent + jnp.arange(B)[:, None] * W).reshape(B * W)
+            caches = [
+                {"k": cc["k"][flat_parent], "v": cc["v"][flat_parent], "idx": cc["idx"]}
+                for cc in caches
+            ]
+        if c < C - 1:
+            # Feed the chosen tokens and advance the cache one step.
+            tok_ids = (beam_tokens[:, :, c] + base_vocab + c * K).reshape(B * W, 1)
+            step_pos = (next_pos[:, None] + c).repeat(W, axis=0).reshape(B * W, 1)
+            slot = jnp.arange(S)[None, :]
+            write_at = (caches[0]["idx"]).astype(jnp.int32)
+            pad_bw = jnp.where(slot == write_at, 1, pad_bw)
+            logits, caches = model.apply(
+                {"params": params}, tok_ids, step_pos, caches, pad_bw,
+                method=QwenLM.decode_step,
+            )
+
+    return LCRecGenerationOutput(sem_ids=beam_tokens, log_probas=beam_scores)

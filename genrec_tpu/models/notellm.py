@@ -1,0 +1,111 @@
+"""NoteLLM-style Query2Embedding: LLM-as-retrieval-embedder.
+
+Parity target: reference genrec/models/notellm.py — Qwen2 backbone with an
+appended ``[EMB]`` special token whose last hidden state is the sentence
+embedding (:113-129), contrastive loss over PAIRED batches (rows 0,2,4..
+are queries, 1,3,5.. their positives) with a learnable temperature tau
+(exp'd, :170-176) and hard-negative down-weighting (:177-189), optional
+category-generation auxiliary CE mixed by alpha (:191-203), and a
+paired-batch top-k accuracy metric (:236-265). Library-only in the
+reference (no trainer/config) — same here.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from genrec_tpu.models.backbones.qwen import QwenConfig, QwenLM
+from genrec_tpu.models.lcrec import extend_vocab
+from genrec_tpu.ops.losses import cross_entropy_with_ignore
+from genrec_tpu.ops.normalize import l2norm
+
+
+class Query2EmbeddingOutput(NamedTuple):
+    sentence_embedding: jax.Array  # (B, D) L2-normalized
+    loss: Optional[jax.Array]
+    cl_loss: Optional[jax.Array]
+    gen_loss: Optional[jax.Array]
+
+
+def add_emb_token(cfg: QwenConfig, params, key):
+    """Append the [EMB] special token (resize_token_embeddings equivalent).
+    Returns (new_cfg, new_params, emb_token_id)."""
+    new_cfg, new_params, base = extend_vocab(cfg, params, 1, 1, key)
+    return new_cfg, new_params, base  # the single appended id
+
+
+def query2embedding_forward(
+    model: QwenLM,
+    params,
+    input_ids,
+    attention_mask,
+    emb_token_idx,
+    tau: jax.Array,
+    labels=None,
+    hardneg=None,
+    alpha: float = 0.01,
+    hardneg_r: float = 0.1,
+    return_loss: bool = True,
+) -> Query2EmbeddingOutput:
+    """Sentence embedding + paired contrastive (+ optional generation) loss.
+
+    input_ids rows are interleaved pairs: even rows queries, odd rows
+    positives. emb_token_idx: (B, 1) position of [EMB] per row.
+    """
+    positions = jnp.maximum(jnp.cumsum(attention_mask, axis=1) - 1, 0)
+    logits, hidden = model.apply(
+        {"params": params}, input_ids, attention_mask=attention_mask,
+        positions=positions, return_hidden=True,
+    )
+    B = input_ids.shape[0]
+    sent = hidden[jnp.arange(B), emb_token_idx[:, 0]]
+    sent = l2norm(sent.astype(jnp.float32))
+    if not return_loss:
+        return Query2EmbeddingOutput(sent, None, None, None)
+
+    q, p = sent[::2], sent[1::2]
+    sim = q @ p.T  # (B/2, B/2) already normalized
+    scaled = sim * jnp.exp(tau)
+    # -log softmax diagonal (reference :170-176).
+    logz = jax.nn.logsumexp(scaled, axis=1)
+    neg_logp = logz - jnp.diagonal(scaled)
+
+    if hardneg is not None:
+        # Hard negatives: replace their CE term with the down-weighted
+        # mean-similarity penalty log(mean_sim + 1) * r (reference :177-189).
+        hard_term = jnp.log(sim.mean(axis=1) + 1.0) * hardneg_r
+        per_row = jnp.where(hardneg, hard_term, neg_logp)
+        cl_loss = per_row.mean()
+    else:
+        cl_loss = neg_logp.mean()
+
+    gen_loss = None
+    loss = cl_loss
+    if labels is not None:
+        per_tok, valid = cross_entropy_with_ignore(
+            logits[:, :-1, :], labels[:, 1:], ignore_index=-100
+        )
+        n_valid = valid.sum()
+        gen_loss = per_tok.sum() / jnp.maximum(n_valid, 1)
+        # Reference guard (notellm.py:191-192): fully-masked labels fall
+        # back to the pure contrastive loss, not cl_loss/(1+alpha).
+        loss = jnp.where(
+            n_valid > 0, (cl_loss + gen_loss * alpha) / (1 + alpha), cl_loss
+        )
+
+    return Query2EmbeddingOutput(sent, loss, cl_loss, gen_loss)
+
+
+def paired_topk_accuracy(embeddings: jax.Array, topk: int = 5) -> float:
+    """Top-k retrieval accuracy over interleaved (query, positive) pairs
+    (reference compute_metrics :236-265, single-chunk variant)."""
+    q = l2norm(embeddings[::2].astype(jnp.float32))
+    p = l2norm(embeddings[1::2].astype(jnp.float32))
+    sim = q @ p.T
+    n = sim.shape[0]
+    _, idx = jax.lax.top_k(sim.T, min(topk, n))  # per positive, top queries
+    correct = (idx == jnp.arange(n)[:, None]).any(axis=1)
+    return float(correct.mean())

@@ -27,21 +27,25 @@ from genrec_tpu.ops.schedules import cosine_schedule_with_warmup
 from genrec_tpu.parallel import distributed_init, get_mesh, replicate, shard_batch
 
 
+import functools
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def _encode_items_jit(model, params, txt):
+    return model.apply({"params": params}, txt[:, None, :], method=Cobra.encode_items)[:, 0]
+
+
 def compute_item_dense_vecs(model, params, item_texts: np.ndarray, batch_size=256):
     """Dense vectors for every item from the CURRENT encoder (re-done each
-    eval; reference cobra_trainer.py:303-334)."""
-
-    @jax.jit
-    def enc(p, txt):
-        return model.apply({"params": p}, txt[:, None, :], method=Cobra.encode_items)[:, 0]
-
+    eval; reference cobra_trainer.py:303-334). The jit is cached on
+    (model, shapes), so repeat evals don't recompile."""
     outs = []
     n = len(item_texts)
     for s in range(0, n, batch_size):
         chunk = {"t": item_texts[s : s + batch_size]}
         n_real = len(chunk["t"])
         padded, _ = pad_to_batch(chunk, batch_size)
-        outs.append(np.asarray(enc(params, padded["t"]))[:n_real])
+        outs.append(np.asarray(_encode_items_jit(model, params, padded["t"]))[:n_real])
     return jnp.asarray(np.concatenate(outs))
 
 

@@ -1,0 +1,96 @@
+"""NoteLLM Query2Embedding tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from genrec_tpu.models.backbones.qwen import QwenConfig, QwenLM
+from genrec_tpu.models.notellm import (
+    add_emb_token,
+    paired_topk_accuracy,
+    query2embedding_forward,
+)
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = QwenConfig(
+        vocab_size=50, hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=64,
+        rope_theta=10000.0, tie_word_embeddings=False,
+    )
+    model0 = QwenLM(cfg)
+    params = model0.init(jax.random.key(0), jnp.zeros((1, 4), jnp.int32))["params"]
+    cfg2, params2, emb_id = add_emb_token(cfg, params, jax.random.key(1))
+    return QwenLM(cfg2), params2, emb_id
+
+
+def _batch(emb_id, B=6, L=10, seed=0):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(3, 50, (B, L)).astype(np.int32)
+    ids[:, -1] = emb_id
+    mask = np.ones((B, L), np.int32)
+    emb_idx = np.full((B, 1), L - 1, np.int32)
+    return jnp.asarray(ids), jnp.asarray(mask), jnp.asarray(emb_idx)
+
+
+def test_embedding_is_normalized_and_at_emb_token(tiny):
+    model, params, emb_id = tiny
+    ids, mask, idx = _batch(emb_id)
+    out = query2embedding_forward(
+        model, params, ids, mask, idx, tau=jnp.asarray(3.0), return_loss=False
+    )
+    np.testing.assert_allclose(
+        np.asarray(jnp.linalg.norm(out.sentence_embedding, axis=1)),
+        np.ones(6), atol=1e-5,
+    )
+    assert out.loss is None
+
+
+def test_contrastive_loss_finite_and_grad_flows(tiny):
+    model, params, emb_id = tiny
+    ids, mask, idx = _batch(emb_id)
+
+    def loss(p, tau):
+        return query2embedding_forward(model, p, ids, mask, idx, tau).loss
+
+    l = loss(params, jnp.asarray(3.0))
+    assert np.isfinite(float(l))
+    g_tau = jax.grad(lambda t: loss(params, t))(jnp.asarray(3.0))
+    assert float(jnp.abs(g_tau)) > 0  # learnable temperature gets gradient
+
+
+def test_hardneg_rows_use_downweighted_term(tiny):
+    model, params, emb_id = tiny
+    ids, mask, idx = _batch(emb_id)
+    hard = jnp.asarray([False, True, False])
+    out_h = query2embedding_forward(
+        model, params, ids, mask, idx, jnp.asarray(3.0), hardneg=hard
+    )
+    out_n = query2embedding_forward(model, params, ids, mask, idx, jnp.asarray(3.0))
+    assert float(out_h.loss) != pytest.approx(float(out_n.loss))
+
+
+def test_category_aux_loss_mixes_by_alpha(tiny):
+    model, params, emb_id = tiny
+    ids, mask, idx = _batch(emb_id)
+    labels = jnp.where(jnp.arange(10)[None, :] >= 7, ids, -100)
+    out = query2embedding_forward(
+        model, params, ids, mask, idx, jnp.asarray(3.0), labels=labels, alpha=0.01
+    )
+    assert out.gen_loss is not None
+    expected = (float(out.cl_loss) + float(out.gen_loss) * 0.01) / 1.01
+    assert float(out.loss) == pytest.approx(expected, rel=1e-5)
+
+
+def test_paired_topk_accuracy_perfect_pairs():
+    rng = np.random.default_rng(0)
+    e = rng.normal(size=(8, 16))
+    paired = np.repeat(e[::1], 1, axis=0)
+    # Construct perfect pairs: query i == positive i.
+    inter = np.empty((16, 16))
+    inter[::2] = e
+    inter[1::2] = e
+    acc = paired_topk_accuracy(jnp.asarray(inter), topk=1)
+    assert acc == 1.0
