@@ -1,0 +1,65 @@
+"""Profiling / tracing hooks.
+
+The reference has NO tracing or profiling at all (SURVEY.md §5.1 — tqdm
+bars only). Here profiling is a first-class utility: `trace()` wraps
+jax.profiler (TensorBoard-viewable XLA traces incl. per-kernel timing),
+`StepTimer` gives steps/sec + seq/sec with compile-step exclusion, and
+`annotate` names regions inside traces.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(logdir: str):
+    """Capture a jax.profiler trace (view with TensorBoard's profile tab)."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named region inside a trace (context manager)."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+class StepTimer:
+    """Throughput meter that ignores the first (compile) step.
+
+    >>> t = StepTimer(batch_size=256)
+    >>> for batch in data:
+    ...     state, m = step(state, batch)
+    ...     t.tick(m["loss"])          # blocks on the step's result
+    >>> t.summary()  # {'steps_per_sec': ..., 'seq_per_sec': ...}
+    """
+
+    def __init__(self, batch_size: int, skip_first: int = 1):
+        self.batch_size = batch_size
+        self.skip_first = skip_first
+        self._count = 0
+        # skip_first=0 means "time from construction".
+        self._t0 = time.perf_counter() if skip_first == 0 else None
+
+    def tick(self, result=None) -> None:
+        if result is not None:
+            jax.block_until_ready(result)
+        self._count += 1
+        if self._count == self.skip_first:
+            self._t0 = time.perf_counter()
+
+    def summary(self) -> dict:
+        timed = self._count - self.skip_first
+        if self._t0 is None or timed <= 0:
+            return {"steps_per_sec": 0.0, "seq_per_sec": 0.0}
+        dt = time.perf_counter() - self._t0
+        return {
+            "steps_per_sec": timed / dt,
+            "seq_per_sec": timed * self.batch_size / dt,
+        }

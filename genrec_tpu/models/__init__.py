@@ -4,6 +4,23 @@ Parity map (reference genrec/models/__init__.py:18-33):
 SASRec, HSTU, RqVae (+QuantizeForwardMode), Tiger, LCRec, Cobra, NoteLLM.
 """
 
+from genrec_tpu.models.cobra import Cobra, beam_fusion, cobra_generate
+from genrec_tpu.models.hstu import HSTU
+from genrec_tpu.models.rqvae import QuantizeForwardMode, RqVae
 from genrec_tpu.models.sasrec import SASRec
+from genrec_tpu.models.tiger import Tiger, tiger_generate
 
-__all__ = ["SASRec"]
+__all__ = [
+    "SASRec",
+    "HSTU",
+    "RqVae",
+    "QuantizeForwardMode",
+    "Tiger",
+    "tiger_generate",
+    "Cobra",
+    "cobra_generate",
+    "beam_fusion",
+]
+# LCRec / NoteLLM / the Qwen backbone live in genrec_tpu.models.lcrec,
+# genrec_tpu.models.notellm and genrec_tpu.models.backbones (not imported
+# here to keep the light models importable without the LLM stack).

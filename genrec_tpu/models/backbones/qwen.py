@@ -157,8 +157,13 @@ class QwenLM(nn.Module):
         return h @ w.T.astype(self.dtype)
 
     def __call__(self, input_ids, attention_mask=None, positions=None,
-                 return_hidden: bool = False):
-        """Full-sequence forward. attention_mask: (B, L) 1=valid."""
+                 return_hidden: bool = False, compute_logits: bool = True):
+        """Full-sequence forward. attention_mask: (B, L) 1=valid.
+
+        compute_logits=False skips the (L, vocab) LM-head matmul — the
+        dominant cost for embedding-only uses (NoteLLM) where only the
+        hidden states are consumed.
+        """
         B, L = input_ids.shape
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(L), (B, L))
@@ -171,7 +176,7 @@ class QwenLM(nn.Module):
         for block in self.blocks:
             x, _ = block(x, positions, bias)
         h = self.norm(x).astype(self.dtype)
-        logits = self._head(h)
+        logits = self._head(h) if compute_logits else None
         if return_hidden:
             return logits, h
         return logits

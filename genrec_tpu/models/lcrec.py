@@ -39,9 +39,11 @@ def extend_vocab(cfg: QwenConfig, params, num_codebooks: int, codebook_size: int
     init distribution; token id of <Cc_k> = base_vocab + c*K + k.
     Returns (new_cfg, new_params, base_vocab).
     """
+    import dataclasses
+
     n_new = num_codebooks * codebook_size
     base = cfg.vocab_size
-    new_cfg = QwenConfig(**{**cfg.__dict__, "vocab_size": base + n_new})
+    new_cfg = dataclasses.replace(cfg, vocab_size=base + n_new)
     k1, k2 = jax.random.split(key)
     params = dict(params)
     emb = params["embed_tokens"]

@@ -56,9 +56,12 @@ def query2embedding_forward(
     positives. emb_token_idx: (B, 1) position of [EMB] per row.
     """
     positions = jnp.maximum(jnp.cumsum(attention_mask, axis=1) - 1, 0)
+    # The LM head (L x vocab matmul) is only needed for the category
+    # generation loss; embedding-only paths skip it.
+    need_logits = return_loss and labels is not None
     logits, hidden = model.apply(
         {"params": params}, input_ids, attention_mask=attention_mask,
-        positions=positions, return_hidden=True,
+        positions=positions, return_hidden=True, compute_logits=need_logits,
     )
     B = input_ids.shape[0]
     sent = hidden[jnp.arange(B), emb_token_idx[:, 0]]

@@ -1,0 +1,104 @@
+"""Ring attention: sequence-parallel exact attention over a mesh axis.
+
+The reference has NO long-context story (SURVEY.md §5.7: dense O(L^2)
+attention at L<=512). This framework treats sequence/context parallelism
+as first-class: the sequence axis is sharded over a mesh axis ("sp"),
+each device holds Lq/N queries and Lk/N keys/values, and K/V shards
+rotate around the ring with `jax.lax.ppermute` while a numerically-stable
+online softmax (flash-attention-style running max / normalizer) folds in
+each incoming block. Peak memory per device is O(L/N * L/N) for the score
+tile — never the full L x L matrix — and the N-1 ppermute hops ride ICI.
+
+Composable: `ring_attention` is the shard_map body; `ring_attention_sharded`
+wraps it for a given mesh+axis. Works under jit, supports causal masking
+via global positions, bf16-safe (fp32 accumulators).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _block_attn(q, k, v, q_pos, k_pos, m, l, acc, scale, causal):
+    """Fold one K/V block into the running (m, l, acc) accumulators.
+
+    q: (B, Lq, H, d); k/v: (B, Lk, H, d); positions: (Lq,), (Lk,).
+    m, l: (B, H, Lq); acc: (B, Lq, H, d). All accumulators fp32.
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        mask = k_pos[None, :] > q_pos[:, None]  # (Lq, Lk), True = illegal
+        s = jnp.where(mask[None, None], -jnp.inf, s)
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    # Guard fully-masked rows (m_new = -inf): exp(-inf - -inf) would be NaN.
+    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    p = jnp.exp(s - m_safe[..., None])  # (B, H, Lq, Lk)
+    correction = jnp.exp(jnp.where(jnp.isfinite(m), m - m_safe, -jnp.inf))
+    l_new = l * correction + p.sum(axis=-1)
+    acc_new = (
+        acc * correction.transpose(0, 2, 1)[..., None]
+        + jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    )
+    return m_new, l_new, acc_new
+
+
+def ring_attention(
+    q, k, v, axis_name: str, axis_size: int, causal: bool = False,
+    scale: float | None = None
+):
+    """shard_map body: q/k/v are the LOCAL sequence shards (B, L_local, H, d).
+
+    ``axis_size`` is the (static) ring size; the block loop unrolls so the
+    final iteration skips its ppermute — n-1 rotations, not n.
+    """
+    B, Lq, H, d = q.shape
+    n = axis_size
+    my = jax.lax.axis_index(axis_name)
+    scale = scale if scale is not None else d**-0.5
+
+    local_pos = jnp.arange(Lq)
+    q_pos = my * Lq + local_pos
+
+    m = jnp.full((B, H, Lq), -jnp.inf, jnp.float32)
+    l = jnp.zeros((B, H, Lq), jnp.float32)
+    acc = jnp.zeros((B, Lq, H, d), jnp.float32)
+
+    k_blk, v_blk = k, v
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    for step in range(n):
+        src = (my - step) % n  # which shard this block came from
+        k_pos = src * Lq + local_pos
+        m, l, acc = _block_attn(q, k_blk, v_blk, q_pos, k_pos, m, l, acc, scale, causal)
+        if step < n - 1:  # the last block's rotation would be discarded
+            k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+            v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+
+    l = jnp.maximum(l, 1e-20)  # fully-masked rows produce zeros, not NaN
+    out = acc / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention_sharded(
+    mesh: Mesh, axis: str = "sp", causal: bool = False
+):
+    """Build a jit-able attention fn whose sequence dim is sharded on
+    ``axis``: (B, L, H, d) x3 -> (B, L, H, d)."""
+    from jax import shard_map
+
+    spec = P(None, axis, None, None)
+    n = mesh.shape[axis]
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    def fn(q, k, v):
+        return ring_attention(q, k, v, axis_name=axis, axis_size=n, causal=causal)
+
+    return fn

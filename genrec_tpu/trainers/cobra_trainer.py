@@ -62,6 +62,8 @@ def make_fusion_fn(model, item_sem_ids, n_candidates, n_beam, alpha):
 
 
 def evaluate(fusion_fn, params, arrays, item_vecs, batch_size, mesh, C):
+    from genrec_tpu.parallel import metric_allreduce
+
     acc = TopKAccumulator(ks=(1, 5, 10))
     cb_correct = np.zeros(C)
     cb_total = 0
@@ -76,7 +78,11 @@ def evaluate(fusion_fn, params, arrays, item_vecs, batch_size, mesh, C):
             cb_correct[c] += (top1[:, c] == target[:, c]).sum()
         cb_total += n
     metrics = acc.reduce(cross_process=True)
-    metrics.update({f"codebook_acc_{c}": cb_correct[c] / max(cb_total, 1) for c in range(C)})
+    # Same cross-host scope as the TopK metrics.
+    cb = metric_allreduce({"correct": list(cb_correct), "total": float(cb_total)})
+    metrics.update(
+        {f"codebook_acc_{c}": cb["correct"][c] / max(cb["total"], 1) for c in range(C)}
+    )
     return metrics
 
 

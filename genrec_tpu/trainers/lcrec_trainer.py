@@ -52,6 +52,8 @@ def make_generate_fn(model, base_vocab, num_codebooks, codebook_size, beam_width
 
 
 def evaluate(gen_fn, params, arrays, batch_size, mesh, num_codebooks):
+    from genrec_tpu.parallel import metric_allreduce
+
     acc = TopKAccumulator(ks=(1, 5, 10))
     cb_correct = np.zeros(num_codebooks)
     cb_total = 0
@@ -65,7 +67,15 @@ def evaluate(gen_fn, params, arrays, batch_size, mesh, num_codebooks):
             cb_correct[c] += (top1[:, c] == target[:, c]).sum()
         cb_total += n
     m = acc.reduce(cross_process=True)
-    m.update({f"codebook_acc_{c}": cb_correct[c] / max(cb_total, 1) for c in range(num_codebooks)})
+    # Codebook counters must be summed across hosts too, same scope as
+    # the TopK metrics.
+    cb = metric_allreduce({"correct": list(cb_correct), "total": float(cb_total)})
+    m.update(
+        {
+            f"codebook_acc_{c}": cb["correct"][c] / max(cb["total"], 1)
+            for c in range(num_codebooks)
+        }
+    )
     return m
 
 
@@ -97,6 +107,7 @@ def train(
     sem_ids_path=None,
     do_eval=True,
     eval_only=False,
+    resume_from_checkpoint=False,
     eval_every_epoch=2,
     eval_batch_size=16,
     save_dir_root="out/lcrec",
@@ -188,6 +199,15 @@ def train(
     from genrec_tpu.core.checkpoint import CheckpointManager, save_params
 
     ckpt = CheckpointManager(os.path.join(save_dir_root, "checkpoints")) if save_dir_root else None
+
+    # eval_only restores the latest checkpoint (the reference loads a
+    # trained model for eval_only, lcrec_trainer.py:358-364); resume picks
+    # up mid-training.
+    if (eval_only or resume_from_checkpoint) and ckpt is not None and ckpt.latest_step() is not None:
+        state = replicate(mesh, ckpt.restore(state))
+        logger.info(f"restored checkpoint at step {int(state.step)}")
+    elif eval_only:
+        logger.warning("eval_only without a checkpoint: evaluating the INITIAL model")
 
     if eval_only:
         m = evaluate(gen_fn, params_of(state.params), valid_arrays, eval_batch_size, mesh, num_codebooks)
