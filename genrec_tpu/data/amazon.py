@@ -107,22 +107,52 @@ def load_sequences(
         else:
             raise FileNotFoundError(reviews_path)
 
-    item_ids: dict[str, int] = {}
-    users: dict[str, list[tuple[int, int]]] = {}
-    for r in parse_gzip_json(reviews_path):
-        asin, uid = r.get("asin"), r.get("reviewerID")
-        if not asin or not uid:
-            continue
-        if asin not in item_ids:
-            item_ids[asin] = len(item_ids) + 1  # 0 is padding
-        users.setdefault(uid, []).append((r.get("unixReviewTime", 0), item_ids[asin]))
+    # Native streaming parser (genrec_tpu.native) when buildable — same
+    # first-appearance id assignment as the Python fallback below.
+    native = None
+    try:
+        from genrec_tpu.native import parse_reviews_native
 
-    seqs, tss = [], []
-    for uid, events in users.items():
-        events.sort(key=lambda x: x[0])
-        if len(events) >= min_seq_len:
-            seqs.append(np.asarray([e[1] for e in events], np.int64))
-            tss.append(np.asarray([e[0] for e in events], np.int64))
+        native = parse_reviews_native(reviews_path)  # per-process temp handoff
+    except Exception:
+        native = None
+
+    if native is not None:
+        u_idx, i_idx, ts_arr, _, item_names = native
+        n_item_ids = len(item_names)
+        asins = item_names
+        # Vectorized assembly: stable sort by (user, time) keeps file order
+        # for ties (== the Python path's stable per-user sort), then split
+        # on user boundaries. User indices are first-appearance ordered.
+        order = np.lexsort((ts_arr, u_idx))
+        u_sorted = np.asarray(u_idx)[order]
+        i_sorted = np.asarray(i_idx)[order] + 1  # 0 is padding
+        t_sorted = np.asarray(ts_arr)[order]
+        bounds = np.flatnonzero(np.diff(u_sorted)) + 1
+        seq_list = np.split(i_sorted, bounds)
+        ts_list = np.split(t_sorted, bounds)
+        seqs = [s for s in seq_list if len(s) >= min_seq_len]
+        tss = [t for s, t in zip(seq_list, ts_list) if len(s) >= min_seq_len]
+    else:
+        item_ids: dict[str, int] = {}
+        users_events: dict = {}
+        for r in parse_gzip_json(reviews_path):
+            asin, uid = r.get("asin"), r.get("reviewerID")
+            if not asin or not uid:
+                continue
+            if asin not in item_ids:
+                item_ids[asin] = len(item_ids) + 1  # 0 is padding
+            users_events.setdefault(uid, []).append(
+                (r.get("unixReviewTime", 0), item_ids[asin])
+            )
+        n_item_ids = len(item_ids)
+        asins = list(item_ids)
+        seqs, tss = [], []
+        for uid, events in users_events.items():
+            events.sort(key=lambda x: x[0])
+            if len(events) >= min_seq_len:
+                seqs.append(np.asarray([e[1] for e in events], np.int64))
+                tss.append(np.asarray([e[0] for e in events], np.int64))
 
     os.makedirs(os.path.dirname(cache), exist_ok=True)
     np.savez_compressed(
@@ -130,10 +160,23 @@ def load_sequences(
         items=np.concatenate(seqs) if seqs else np.zeros(0, np.int64),
         timestamps=np.concatenate(tss) if tss else np.zeros(0, np.int64),
         lengths=np.asarray([len(s) for s in seqs], np.int64),
-        num_items=len(item_ids),
+        num_items=n_item_ids,
+        # asin for item id i+1 = asins[i]: persisted so downstream stages
+        # (e.g. COBRA's item-text attach) never re-derive the ordering.
+        asins=np.asarray(asins),
     )
-    logger.info("parsed %d sequences, %d items", len(seqs), len(item_ids))
-    return seqs, tss, len(item_ids)
+    logger.info("parsed %d sequences, %d items", len(seqs), n_item_ids)
+    return seqs, tss, n_item_ids
+
+
+def load_item_asins(root: str, split: str, min_seq_len: int = 5) -> list[str]:
+    """asin for each item id (row i -> id i+1), from the sequence cache."""
+    load_sequences(root, split, min_seq_len, download=False)  # ensure cache
+    cache = os.path.join(root, "processed", f"{split}_seqs_min{min_seq_len}.npz")
+    z = np.load(cache)
+    if "asins" not in z:
+        raise ValueError(f"{cache} predates asin persistence; delete and re-parse")
+    return [str(a) for a in z["asins"]]
 
 
 class AmazonSASRecData:

@@ -80,6 +80,44 @@ class CobraSeqData:
         }
 
 
+def amazon_cobra_data(
+    root: str,
+    split: str,
+    sem_ids_path: str,
+    tokenizer_name: str = "sentence-transformers/sentence-t5-base",
+    max_text_len: int = 32,
+    max_items: int = 20,
+):
+    """Amazon wiring: sequences + sem-id artifact + HF-tokenized item text
+    (reference amazon_cobra.py:217-227). Needs a local HF tokenizer."""
+    import os
+
+    from transformers import AutoTokenizer
+
+    from genrec_tpu.data.amazon import (
+        DATASET_FILES,
+        load_item_asins,
+        load_sequences,
+        parse_gzip_json,
+    )
+    from genrec_tpu.data.items import format_item_text
+    from genrec_tpu.data.sem_ids import load_sem_ids
+
+    seqs, _, num_items = load_sequences(root, split)
+    sem_ids, codebook_size = load_sem_ids(sem_ids_path)
+
+    # asin ordering persisted by load_sequences — no reviews re-parse.
+    asins = load_item_asins(root, split)
+    meta = os.path.join(root, "raw", split, DATASET_FILES[split]["meta"])
+    metas = {r.get("asin"): r for r in parse_gzip_json(meta) if r.get("asin")}
+    texts = [format_item_text(metas.get(a, {})) for a in asins]
+
+    tok = AutoTokenizer.from_pretrained(tokenizer_name)
+    enc = tok(texts, padding="max_length", truncation=True, max_length=max_text_len)
+    item_texts = np.asarray(enc["input_ids"], np.int32)
+    return CobraSeqData(seqs, sem_ids, item_texts, codebook_size, max_items=max_items)
+
+
 def synthetic_cobra_data(
     num_items: int = 120,
     id_vocab_size: int = 16,

@@ -94,23 +94,14 @@ def encode_item_texts(
     Requires `transformers` + a locally available T5 encoder. Kept out of
     the training path so trainers never import torch.
     """
-    from genrec_tpu.data.amazon import DATASET_FILES, parse_gzip_json
+    from genrec_tpu.data.amazon import DATASET_FILES, load_item_asins, parse_gzip_json
 
     meta_path = os.path.join(root, "raw", split, DATASET_FILES[split]["meta"])
-    reviews_path = os.path.join(root, "raw", split, DATASET_FILES[split]["reviews"])
 
-    # Rebuild the asin->id map exactly as load_sequences does so row i of
-    # the output matches item id i+1.
-    item_ids: dict[str, int] = {}
-    for r in parse_gzip_json(reviews_path):
-        asin, uid = r.get("asin"), r.get("reviewerID")
-        if asin and uid and asin not in item_ids:
-            item_ids[asin] = len(item_ids) + 1
-
+    # asin ordering persisted by load_sequences (row i -> item id i+1).
+    asins = load_item_asins(root, split)
     metas = {r.get("asin"): r for r in parse_gzip_json(meta_path) if r.get("asin")}
-    texts = [""] * len(item_ids)
-    for asin, iid in item_ids.items():
-        texts[iid - 1] = format_item_text(metas.get(asin, {}))
+    texts = [format_item_text(metas.get(a, {})) for a in asins]
 
     # The reference uses SentenceTransformer.encode (amazon.py:192-205),
     # whose sentence-t5 pipeline is encoder -> mean-pool -> Dense(d->768)

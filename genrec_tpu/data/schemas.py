@@ -8,6 +8,14 @@ from typing import NamedTuple, Optional
 import numpy as np
 
 
+class SeqData(NamedTuple):
+    """One user's raw sequence sample (reference schemas.py:7-17)."""
+
+    user_id: int
+    item_ids: np.ndarray
+    target_ids: np.ndarray
+
+
 class SeqBatch(NamedTuple):
     """A fixed-shape sequence batch.
 
@@ -22,3 +30,18 @@ class SeqBatch(NamedTuple):
     targets: np.ndarray
     timestamps: Optional[np.ndarray] = None
     user_ids: Optional[np.ndarray] = None
+
+
+class TokenizedSeqBatch(NamedTuple):
+    """A semantic-id tokenized batch (reference schemas.py:20-36): the
+    flattened (item, codebook) token stream TIGER consumes."""
+
+    user_ids: np.ndarray  # (B,)
+    sem_ids: np.ndarray  # (B, T*D) flattened history sem-ids
+    sem_ids_fut: np.ndarray  # (B, D) target item's sem-ids
+    seq_mask: np.ndarray  # (B, T*D)
+    token_type_ids: np.ndarray  # (B, T*D) position % D
+    token_type_ids_fut: np.ndarray  # (B, D)
+
+
+FUT_SUFFIX = "_fut"

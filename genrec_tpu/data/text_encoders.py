@@ -1,0 +1,96 @@
+"""Pretrained text encoders for one-time item-embedding preprocessing.
+
+The reference embeds item text inside torch Datasets with
+sentence-transformers / HF models (encoder.py: SentenceT5Encoder :108-199,
+ErnieEncoder :202-294, BgeEncoder :297-377 — the latter two are Chinese-
+text variants unused by any reference trainer). In this framework text
+encoding is a PREPROCESSING stage: these wrappers run wherever the HF
+weights exist locally (zero-egress training hosts read the cached .npy
+instead), so the JAX training path stays torch-free.
+
+COBRA's trainable LightT5Encoder lives in models/cobra.py (it is part of
+the model, not preprocessing); its pretrained variant can be initialized
+from embeddings produced here.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _require_transformers():
+    try:
+        import torch  # noqa: F401
+        from transformers import AutoModel, AutoTokenizer  # noqa: F401
+    except ImportError as e:  # pragma: no cover
+        raise ImportError(
+            "text encoding needs torch + transformers (preprocessing only)"
+        ) from e
+
+
+class _HFMeanPoolEncoder:
+    """Tokenize -> encoder -> mean-pool -> (optional dense) -> L2-norm."""
+
+    def __init__(self, model_name: str, max_length: int = 256, normalize: bool = True):
+        _require_transformers()
+        import torch
+        from transformers import AutoModel, AutoTokenizer
+
+        self._torch = torch
+        self.tokenizer = AutoTokenizer.from_pretrained(model_name)
+        self.model = AutoModel.from_pretrained(model_name).eval()
+        self.max_length = max_length
+        self.normalize = normalize
+
+    def encode(self, texts: list[str], batch_size: int = 64) -> np.ndarray:
+        torch = self._torch
+        outs = []
+        with torch.no_grad():
+            for s in range(0, len(texts), batch_size):
+                t = self.tokenizer(
+                    texts[s : s + batch_size], padding=True, truncation=True,
+                    max_length=self.max_length, return_tensors="pt",
+                )
+                h = self.model(**t).last_hidden_state
+                m = t["attention_mask"][..., None].float()
+                pooled = (h * m).sum(1) / m.sum(1).clamp(min=1e-9)
+                if self.normalize:
+                    pooled = torch.nn.functional.normalize(pooled, dim=-1)
+                outs.append(pooled.numpy())
+        return np.concatenate(outs).astype(np.float32)
+
+
+class SentenceT5Encoder:
+    """sentence-t5 family via the full sentence-transformers pipeline
+    (pooling + Dense projection + normalize) — required for dimensional
+    parity with the reference's cached embeddings (see data/items.py)."""
+
+    def __init__(self, model_name: str = "sentence-transformers/sentence-t5-xl"):
+        try:
+            from sentence_transformers import SentenceTransformer
+        except ImportError as e:  # pragma: no cover
+            raise ImportError("SentenceT5Encoder needs sentence-transformers") from e
+        self.model = SentenceTransformer(model_name)
+
+    def encode(self, texts: list[str], batch_size: int = 64) -> np.ndarray:
+        return np.asarray(
+            self.model.encode(texts, batch_size=batch_size, show_progress_bar=False),
+            np.float32,
+        )
+
+
+class ErnieEncoder(_HFMeanPoolEncoder):
+    """Chinese-text encoder (reference encoder.py:202-294; unused by any
+    reference trainer but part of the module surface)."""
+
+    def __init__(self, model_name: str = "nghuyong/ernie-3.0-base-zh", **kw):
+        super().__init__(model_name, **kw)
+
+
+class BgeEncoder(_HFMeanPoolEncoder):
+    """BGE Chinese-text encoder (reference encoder.py:297-377). BGE uses
+    CLS pooling; mean-pool approximation is deliberate and documented —
+    both are for offline preprocessing, not the training path."""
+
+    def __init__(self, model_name: str = "BAAI/bge-base-zh", **kw):
+        super().__init__(model_name, **kw)

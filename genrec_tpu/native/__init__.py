@@ -1,0 +1,12 @@
+"""Native (C++) runtime components.
+
+The reference is 100% Python (SURVEY.md §2); this package is the
+framework's native IO layer: a zlib streaming field-extractor for the
+Amazon review dumps, compiled on demand with g++ and bound via ctypes.
+Every native path has a pure-Python fallback, so the framework works
+without a toolchain.
+"""
+
+from genrec_tpu.native.loader import native_available, parse_reviews_native
+
+__all__ = ["native_available", "parse_reviews_native"]

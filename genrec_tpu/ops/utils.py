@@ -1,0 +1,27 @@
+"""Misc array utilities (reference genrec/modules/utils.py:63-137)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def select_columns_per_row(x: jax.Array, indices: jax.Array) -> jax.Array:
+    """Per-row column gather: out[i, j] = x[i, indices[i, j]]
+    (reference utils.py:63-73, einops-free)."""
+    return jnp.take_along_axis(x, indices, axis=1)
+
+
+def compute_debug_metrics(seq_mask: jax.Array, prefix: str = "") -> dict:
+    """Sequence-length quantiles from a (B, L) validity mask
+    (reference utils.py:120-137)."""
+    lengths = seq_mask.sum(axis=1).astype(jnp.float32)
+    qs = jnp.quantile(lengths, jnp.asarray([0.25, 0.5, 0.75, 0.9, 1.0]))
+    return {
+        f"{prefix}seq_len_p25": qs[0],
+        f"{prefix}seq_len_p50": qs[1],
+        f"{prefix}seq_len_p75": qs[2],
+        f"{prefix}seq_len_p90": qs[3],
+        f"{prefix}seq_len_max": qs[4],
+        f"{prefix}seq_len_mean": lengths.mean(),
+    }

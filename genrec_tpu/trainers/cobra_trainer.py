@@ -137,11 +137,15 @@ def train(
             text_vocab=encoder_vocab_size, max_items=max_items, seed=seed,
         )
     else:
-        raise NotImplementedError(
-            "amazon COBRA data needs tokenized item text; run the "
-            "sentence-T5 preprocessing (data/items.py) and wire "
-            "CobraSeqData(load_sequences(...), load_sem_ids(...), texts)."
+        from genrec_tpu.data.cobra_seq import amazon_cobra_data
+
+        if sem_ids_path is None:
+            raise ValueError("amazon dataset needs sem_ids_path (RQ-VAE artifact)")
+        data = amazon_cobra_data(
+            dataset_folder, split, sem_ids_path, max_items=max_items
         )
+        id_vocab_size = data.id_vocab_size
+        n_codebooks = data.C
 
     train_arrays = data.train_arrays()
     valid_arrays = data.eval_arrays("valid")

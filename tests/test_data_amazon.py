@@ -74,3 +74,57 @@ def test_unknown_split_raises(fake_root):
 def test_missing_file_no_download(tmp_path):
     with pytest.raises(FileNotFoundError):
         load_sequences(str(tmp_path), "beauty", download=False)
+
+
+def test_native_parser_matches_python(fake_root):
+    """The C++ extractor must assign identical ids/sequences to the Python
+    path (same first-appearance ordering)."""
+    from genrec_tpu.native import native_available, parse_reviews_native
+
+    if not native_available():
+        pytest.skip("no C++ toolchain")
+    import glob
+
+    gz = glob.glob(os.path.join(fake_root, "raw", "beauty", "*.json.gz"))[0]
+    out = parse_reviews_native(gz, gz + ".bin")
+    assert out is not None
+    u_idx, i_idx, ts, users, items = out
+    # Python reference parse.
+    from genrec_tpu.data.amazon import parse_gzip_json
+
+    py_users, py_items, rows = {}, {}, []
+    for r in parse_gzip_json(gz):
+        u, a = r["reviewerID"], r["asin"]
+        py_users.setdefault(u, len(py_users))
+        py_items.setdefault(a, len(py_items))
+        rows.append((py_users[u], py_items[a], r.get("unixReviewTime", 0)))
+    assert users == list(py_users)
+    assert items == list(py_items)
+    np.testing.assert_array_equal(
+        np.stack([u_idx, i_idx, ts], 1), np.asarray(rows)
+    )
+
+
+def test_native_parser_adversarial_lines(tmp_path):
+    """reviewText containing the literal timestamp key, empty asin, and
+    non-object lines must not diverge from the Python path."""
+    from genrec_tpu.native import native_available, parse_reviews_native
+
+    if not native_available():
+        pytest.skip("no C++ toolchain")
+    gz_path = tmp_path / "adv.json.gz"
+    rows = [
+        {"reviewerID": "u1", "asin": "a1",
+         "reviewText": 'someone wrote "unixReviewTime": 999 in a review',
+         "unixReviewTime": 1234},
+        {"reviewerID": "u1", "asin": "", "unixReviewTime": 5},  # empty asin
+        {"reviewerID": "u2", "asin": "a2", "unixReviewTime": 777},
+    ]
+    with gzip.open(gz_path, "wt") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+        f.write("not a json object at all\n")
+    out = parse_reviews_native(str(gz_path))
+    u_idx, i_idx, ts, users, items = out
+    assert list(ts) == [1234, 777]  # real timestamp, not the in-text 999
+    assert users == ["u1", "u2"] and items == ["a1", "a2"]

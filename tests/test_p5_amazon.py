@@ -1,0 +1,85 @@
+"""P5 pipeline tests against fabricated P5-format files."""
+
+import gzip
+import json
+import os
+
+import numpy as np
+import pytest
+
+from genrec_tpu.data.p5_amazon import (
+    P5AmazonData,
+    item_train_mask,
+    p5_item_text,
+    parse_sequential_data,
+    random_crop_subsample,
+)
+
+
+@pytest.fixture
+def p5_root(tmp_path):
+    raw = tmp_path / "raw" / "beauty"
+    raw.mkdir(parents=True)
+    # 3 users, items 1..6 (1-based in the file).
+    (raw / "sequential_data.txt").write_text(
+        "1 1 2 3 4 5\n2 2 3 4 5 6\n3 1 3 5 2 4 6\n"
+    )
+    (raw / "datamaps.json").write_text(
+        json.dumps({"item2id": {f"A{i}": str(i) for i in range(1, 7)}})
+    )
+    with gzip.open(raw / "meta.json.gz", "wt") as f:
+        for i in range(1, 7):
+            f.write(json.dumps({"asin": f"A{i}", "title": f"item {i}",
+                                "brand": None, "price": i * 1.5,
+                                "categories": [["Beauty", "Hair"]]}) + "\n")
+    return str(tmp_path)
+
+
+def test_parse_and_splits(p5_root):
+    data = P5AmazonData(p5_root, "beauty", max_seq_len=3)
+    assert data.num_items == 6
+    # 0-based remap.
+    np.testing.assert_array_equal(data.sequences[0], [0, 1, 2, 3, 4])
+    hist, tgt = data.split_sequences("train")
+    np.testing.assert_array_equal(hist[0], [0, 1, 2])
+    assert tgt[0] == 3
+    hist, tgt = data.split_sequences("val")
+    np.testing.assert_array_equal(hist[0], [0, 1, 2])
+    assert tgt[0] == 3
+    hist, tgt = data.split_sequences("test")
+    np.testing.assert_array_equal(hist[0], [1, 2, 3])
+    assert tgt[0] == 4
+
+
+def test_item_texts_template(p5_root):
+    data = P5AmazonData(p5_root, "beauty")
+    texts = data.item_texts()
+    assert texts[0] == "Title: item 1; Brand: Unknown; Categories: ['Beauty', 'Hair']; Price: 1.5; "
+    assert len(texts) == 6
+
+
+def test_item_train_mask_deterministic():
+    m1 = item_train_mask(1000)
+    m2 = item_train_mask(1000)
+    np.testing.assert_array_equal(m1, m2)
+    frac = m1.mean()
+    assert 0.92 < frac < 0.98  # ~95% train
+
+
+def test_random_crop_subsample_bounds():
+    rng = np.random.default_rng(0)
+    seq = np.arange(50)  # history + future, reference-style
+    for _ in range(20):
+        c = random_crop_subsample(seq, max_seq_len=8, rng=rng)
+        # >= 2 inputs + 1 target; at most max_seq_len inputs + target.
+        assert 3 <= len(c) <= 9
+        np.testing.assert_array_equal(c, np.arange(c[0], c[-1] + 1))
+    # Short sequences are returned whole.
+    np.testing.assert_array_equal(
+        random_crop_subsample(np.arange(3), 8, rng), np.arange(3)
+    )
+
+
+def test_missing_files_clear_error(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        P5AmazonData(str(tmp_path), "beauty")
