@@ -171,8 +171,9 @@ def load_sequences(
 
 def load_item_asins(root: str, split: str, min_seq_len: int = 5) -> list[str]:
     """asin for each item id (row i -> id i+1), from the sequence cache."""
-    load_sequences(root, split, min_seq_len, download=False)  # ensure cache
     cache = os.path.join(root, "processed", f"{split}_seqs_min{min_seq_len}.npz")
+    if not os.path.exists(cache):
+        load_sequences(root, split, min_seq_len, download=False)
     z = np.load(cache)
     if "asins" not in z:
         raise ValueError(f"{cache} predates asin persistence; delete and re-parse")

@@ -90,27 +90,21 @@ def amazon_cobra_data(
 ):
     """Amazon wiring: sequences + sem-id artifact + HF-tokenized item text
     (reference amazon_cobra.py:217-227). Needs a local HF tokenizer."""
-    import os
-
     from transformers import AutoTokenizer
 
-    from genrec_tpu.data.amazon import (
-        DATASET_FILES,
-        load_item_asins,
-        load_sequences,
-        parse_gzip_json,
-    )
-    from genrec_tpu.data.items import format_item_text
+    from genrec_tpu.data.amazon import load_sequences
+    from genrec_tpu.data.items import load_item_texts
     from genrec_tpu.data.sem_ids import load_sem_ids
 
     seqs, _, num_items = load_sequences(root, split)
     sem_ids, codebook_size = load_sem_ids(sem_ids_path)
-
-    # asin ordering persisted by load_sequences — no reviews re-parse.
-    asins = load_item_asins(root, split)
-    meta = os.path.join(root, "raw", split, DATASET_FILES[split]["meta"])
-    metas = {r.get("asin"): r for r in parse_gzip_json(meta) if r.get("asin")}
-    texts = [format_item_text(metas.get(a, {})) for a in asins]
+    if len(sem_ids) != num_items:
+        raise ValueError(
+            f"sem-id artifact {sem_ids_path} has {len(sem_ids)} rows but the "
+            f"{split} split has {num_items} items — artifact from a different "
+            "split or a stale parse"
+        )
+    texts = load_item_texts(root, split)
 
     tok = AutoTokenizer.from_pretrained(tokenizer_name)
     enc = tok(texts, padding="max_length", truncation=True, max_length=max_text_len)

@@ -70,6 +70,18 @@ class ItemEmbeddingData:
         return self.embeddings[tr], self.embeddings[ev]
 
 
+def load_item_texts(root: str, split: str) -> list[str]:
+    """Formatted item text per item id (row i -> id i+1), from the persisted
+    asin ordering + raw meta — the ONE assembly shared by the embedding
+    preprocessing and COBRA's tokenized-text path."""
+    from genrec_tpu.data.amazon import DATASET_FILES, load_item_asins, parse_gzip_json
+
+    asins = load_item_asins(root, split)
+    meta_path = os.path.join(root, "raw", split, DATASET_FILES[split]["meta"])
+    metas = {r.get("asin"): r for r in parse_gzip_json(meta_path) if r.get("asin")}
+    return [format_item_text(metas.get(a, {})) for a in asins]
+
+
 def format_item_text(meta: dict) -> str:
     """Item text template — byte-for-byte the reference's layout
     (amazon.py:198-204): newline-joined, all five keys always present,
@@ -94,14 +106,7 @@ def encode_item_texts(
     Requires `transformers` + a locally available T5 encoder. Kept out of
     the training path so trainers never import torch.
     """
-    from genrec_tpu.data.amazon import DATASET_FILES, load_item_asins, parse_gzip_json
-
-    meta_path = os.path.join(root, "raw", split, DATASET_FILES[split]["meta"])
-
-    # asin ordering persisted by load_sequences (row i -> item id i+1).
-    asins = load_item_asins(root, split)
-    metas = {r.get("asin"): r for r in parse_gzip_json(meta_path) if r.get("asin")}
-    texts = [format_item_text(metas.get(a, {})) for a in asins]
+    texts = load_item_texts(root, split)
 
     # The reference uses SentenceTransformer.encode (amazon.py:192-205),
     # whose sentence-t5 pipeline is encoder -> mean-pool -> Dense(d->768)

@@ -17,7 +17,6 @@ of loading an RQ-VAE checkpoint in the constructor.
 
 from __future__ import annotations
 
-import gzip
 import json
 import os
 
@@ -82,23 +81,17 @@ class P5AmazonData:
     # ---- item side (RQ-VAE training) --------------------------------------
 
     def item_texts(self) -> list[str]:
+        from genrec_tpu.data.amazon import parse_gzip_json
+
         raw = os.path.join(self.root, "raw", self.split)
         with open(os.path.join(raw, "datamaps.json")) as f:
             maps = json.load(f)
         asin2id = {a: int(v) - 1 for a, v in maps["item2id"].items()}
         texts = [""] * self.num_items
-        with gzip.open(os.path.join(raw, "meta.json.gz"), "rt", encoding="utf-8") as f:
-            for line in f:
-                try:
-                    meta = json.loads(line)
-                except json.JSONDecodeError:
-                    try:
-                        meta = eval(line)  # noqa: S307 - 2014 dump quirk
-                    except Exception:
-                        continue
-                iid = asin2id.get(meta.get("asin"))
-                if iid is not None and 0 <= iid < self.num_items:
-                    texts[iid] = p5_item_text(meta)
+        for meta in parse_gzip_json(os.path.join(raw, "meta.json.gz")):
+            iid = asin2id.get(meta.get("asin"))
+            if iid is not None and 0 <= iid < self.num_items:
+                texts[iid] = p5_item_text(meta)
         return texts
 
     def item_embeddings(self, train_only: bool | None = None) -> np.ndarray:
@@ -145,14 +138,15 @@ def random_crop_subsample(
 ) -> np.ndarray:
     """Training-time subsampling (P5AmazonReviewsSeqDataset:472-477).
 
-    ``seq`` is history + [future item]; the reference draws a window end
-    with end >= start + 3 so every crop has >= 2 input items plus the
-    target (the caller splits window[:-1] / window[-1]). Window covers at
-    most max_seq_len inputs + 1 target.
+    ``seq`` is history + [future item]. Reference semantics reproduced
+    exactly: start ~ U[0, len-3], then end ~ U[start+3, start+max_seq_len+1]
+    clipped to the sequence — so crop LENGTHS are sampled in
+    [3, max_seq_len+1] at random offsets (not always the maximal window).
+    The caller splits window[:-1] (inputs) / window[-1] (target).
     """
     n = len(seq)
     if n <= 3:
         return seq
-    end = int(rng.integers(3, n + 1))
-    start = max(0, end - (max_seq_len + 1))
-    return seq[start:end]
+    start = int(rng.integers(0, max(0, n - 3) + 1))
+    end = int(rng.integers(start + 3, start + max_seq_len + 2))
+    return seq[start : min(end, n)]
