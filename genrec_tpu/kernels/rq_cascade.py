@@ -1,0 +1,96 @@
+"""Fused residual-quantization cascade Pallas kernel.
+
+Semantic-id extraction is a hot loop (SURVEY.md §3.1: collision-rate eval
+re-encodes EVERY item each eval; datasets tokenize the full catalog). The
+XLA path runs L sequential Quantize layers, each materializing a (B, K)
+distance matrix and the intermediate residual in HBM. This kernel keeps
+one batch tile resident in VMEM for the whole cascade:
+
+    per level l:  dist = |c|^2 - 2 x_res @ C_l^T      (MXU)
+                  ids  = argmin(dist)
+                  x_res -= onehot(ids) @ C_l           (MXU gather)
+
+The codeword gather is a one-hot matmul — TPU-friendly, no dynamic row
+gather. Applies to the raw-codebook configuration (no sim_vq projection /
+normalization — the shipped RQ-VAE configs); the general path falls back
+to the Flax model.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _kernel(x_ref, cb_ref, ids_ref, qsum_ref, *, n_layers: int, K: int):
+    x = x_ref[0].astype(jnp.float32)  # (blk_b, D)
+    res = x
+    qsum = jnp.zeros_like(x)
+    for l in range(n_layers):
+        cb = cb_ref[l].astype(jnp.float32)  # (Kp, D)
+        c2 = jnp.sum(cb * cb, axis=1)  # (Kp,)
+        dist = c2[None, :] - 2.0 * jnp.dot(
+            res, cb.T, preferred_element_type=jnp.float32
+        )  # (blk_b, Kp)
+        # Padded codeword columns (>= K) can never win the argmin.
+        col = jax.lax.broadcasted_iota(jnp.int32, dist.shape, 1)
+        dist = jnp.where(col >= K, jnp.inf, dist)
+        ids = jnp.argmin(dist, axis=1)  # (blk_b,)
+        onehot = (
+            jax.lax.broadcasted_iota(jnp.int32, dist.shape, 1) == ids[:, None]
+        ).astype(jnp.float32)
+        chosen = jnp.dot(onehot, cb, preferred_element_type=jnp.float32)
+        res = res - chosen
+        qsum = qsum + chosen
+        ids_ref[0, :, l] = ids.astype(jnp.int32)
+    qsum_ref[0] = qsum.astype(qsum_ref.dtype)
+
+
+def _round_up(x, m):
+    return (x + m - 1) // m * m
+
+
+def rq_cascade_pallas(
+    x, codebooks, blk_b: int = 256, interpret: bool = False
+):
+    """x: (B, D) residual inputs (already encoded); codebooks: (L, K, D).
+
+    Returns (sem_ids (B, L) int32, quantized_sum (B, D)).
+    """
+    B, D = x.shape
+    L, K, _ = codebooks.shape
+    interpret = interpret or jax.default_backend() != "tpu"
+    Bp = _round_up(B, blk_b)
+    Dp = _round_up(D, 128)
+    Kp = _round_up(K, 128)
+
+    xf = jnp.pad(x, ((0, Bp - B), (0, Dp - D)))
+    # Padded codeword rows are excluded inside the kernel (iota mask on
+    # columns >= K), so zero-padding is safe here.
+    cbf = jnp.pad(codebooks, ((0, 0), (0, Kp - K), (0, Dp - D)))
+
+    kernel = functools.partial(_kernel, n_layers=L, K=K)
+    ids, qsum = pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((Bp // blk_b, blk_b, L), jnp.int32),
+            jax.ShapeDtypeStruct((Bp // blk_b, blk_b, Dp), x.dtype),
+        ),
+        grid=(Bp // blk_b,),
+        in_specs=[
+            pl.BlockSpec((1, blk_b, Dp), lambda i: (i, 0, 0)),
+            pl.BlockSpec((L, Kp, Dp), lambda i: (0, 0, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, blk_b, L), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, blk_b, Dp), lambda i: (i, 0, 0)),
+        ),
+        interpret=interpret,
+    )(xf.reshape(Bp // blk_b, blk_b, Dp), cbf)
+    return (
+        ids.reshape(Bp, L)[:B],
+        qsum.reshape(Bp, Dp)[:B, :D],
+    )

@@ -135,14 +135,19 @@ class QwenBlock(nn.Module):
 class QwenLM(nn.Module):
     cfg: QwenConfig
     dtype: jnp.dtype = jnp.float32
+    # Rematerialize each block's activations in the backward pass — trades
+    # FLOPs for HBM, the standard lever for 1.5B-scale training on one
+    # chip (reference: gradient_checkpointing_enable, lcrec.py:42-46).
+    remat: bool = False
 
     def setup(self):
         self.embed_tokens = self.param(
             "embed_tokens", nn.initializers.normal(0.02),
             (self.cfg.vocab_size, self.cfg.hidden_size),
         )
+        block_cls = nn.remat(QwenBlock, static_argnums=()) if self.remat else QwenBlock
         self.blocks = [
-            QwenBlock(self.cfg, self.dtype, name=f"layer_{i}")
+            block_cls(self.cfg, self.dtype, name=f"layer_{i}")
             for i in range(self.cfg.num_hidden_layers)
         ]
         self.norm = RMSNorm(self.cfg.hidden_size, self.cfg.rms_norm_eps, name="norm")

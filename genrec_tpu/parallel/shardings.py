@@ -1,0 +1,64 @@
+"""Parameter-sharding rule sets: tensor parallelism as a framework feature.
+
+A rule set maps param-path substrings to PartitionSpecs over the ("data",
+"model") mesh; `shard_params` applies them with divisibility guards (axes
+that don't divide the tp degree stay replicated). The TIGER rules shard
+what dominates its memory/FLOPs: the flat vocab output head, the sem-id
+embedding rows, and the FFN hidden dim. Gradients/optimizer states follow
+automatically (optax init inherits placements).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# A rule: (path-substring predicate, axis index to shard, mesh axis name).
+Rule = tuple[Callable[[str], bool], int, str]
+
+
+def tiger_rules(model_axis: str = "model") -> Sequence[Rule]:
+    return (
+        (lambda p: "output_head" in p and p.endswith("kernel"), 1, model_axis),
+        (lambda p: "sem_id_embedding" in p, 0, model_axis),
+        (lambda p: "ff" in p and "wi" in p and p.endswith("kernel"), 1, model_axis),
+        (lambda p: "ff" in p and "wo" in p and p.endswith("kernel"), 0, model_axis),
+    )
+
+
+def qwen_rules(model_axis: str = "model") -> Sequence[Rule]:
+    """Megatron-style: column-parallel q/k/v/gate/up, row-parallel o/down,
+    vocab-sharded embedding + head."""
+    col = ("q_proj", "k_proj", "v_proj", "gate_proj", "up_proj")
+    row = ("o_proj", "down_proj")
+    return (
+        (lambda p: any(c in p for c in col) and p.endswith("kernel"), 1, model_axis),
+        (lambda p: any(r in p for r in row) and p.endswith("kernel"), 0, model_axis),
+        (lambda p: p.endswith("embed_tokens") or p.endswith("lm_head"), 0, model_axis),
+    )
+
+
+def param_specs(params, rules: Sequence[Rule], mesh: Mesh):
+    """PartitionSpec tree for ``params`` under ``rules`` (replicated where
+    no rule matches or the axis doesn't divide the mesh axis size)."""
+
+    def spec_of(path, leaf):
+        p = "/".join(str(getattr(k, "key", k)) for k in path)
+        for pred, axis, mesh_axis in rules:
+            if pred(p) and leaf.ndim > axis:
+                if leaf.shape[axis] % mesh.shape[mesh_axis] == 0:
+                    out = [None] * leaf.ndim
+                    out[axis] = mesh_axis
+                    return P(*out)
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec_of, params)
+
+
+def shard_params(mesh: Mesh, params, rules: Sequence[Rule]):
+    specs = param_specs(params, rules, mesh)
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs
+    )

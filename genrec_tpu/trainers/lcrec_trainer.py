@@ -91,6 +91,7 @@ def train(
     beam_width=10,
     max_text_len=96,
     use_lora=False,
+    gradient_checkpointing=False,
     lora_rank=8,
     lora_alpha=16.0,
     lora_targets=("q_proj", "v_proj"),
@@ -142,7 +143,7 @@ def train(
             max_position_embeddings=max_text_len + num_codebooks + 1,
             rope_theta=10000.0, tie_word_embeddings=False,
         )
-        model0 = QwenLM(cfg, dtype=compute_dtype)
+        model0 = QwenLM(cfg, dtype=compute_dtype, remat=gradient_checkpointing)
         params = model0.init(init_rng, jnp.zeros((1, 4), jnp.int32))["params"]
     else:
         # Checkpoint conversion exists (backbones.qwen.params_from_hf_state_dict
@@ -158,7 +159,8 @@ def train(
 
     # Append codebook special tokens (resize_token_embeddings equivalent).
     cfg, params, base_vocab = extend_vocab(cfg, params, num_codebooks, codebook_size, vocab_rng)
-    model = QwenLM(cfg, dtype=compute_dtype)
+    # remat mirrors the reference's gradient_checkpointing_enable (lcrec.py:42-46).
+    model = QwenLM(cfg, dtype=compute_dtype, remat=gradient_checkpointing)
     logger.info(f"vocab {base_vocab} + {num_codebooks * codebook_size} codebook tokens")
 
     train_arrays = data.train_arrays()

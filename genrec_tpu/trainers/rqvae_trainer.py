@@ -47,16 +47,35 @@ def _sem_ids_of(model, params, x):
     return out.sem_ids
 
 
+@functools.partial(jax.jit, static_argnums=0)
+def _sem_ids_of_pallas(model, params, x):
+    """Encode with the MLP, then run the fused residual-cascade kernel
+    (kernels/rq_cascade.py) — one VMEM-resident pass over all layers."""
+    import jax.numpy as jnp
+
+    from genrec_tpu.kernels.rq_cascade import rq_cascade_pallas
+
+    enc = model.apply({"params": params}, x, method=RqVae.encode)
+    codebooks = jnp.stack(
+        [params[f"quantize_{l}"]["codebook"] for l in range(model.n_layers)]
+    )
+    ids, _ = rq_cascade_pallas(enc, codebooks)
+    return ids
+
+
 def compute_sem_ids(model, params, embeddings: np.ndarray, batch_size: int = 4096):
     """Semantic ids for every item (row i -> item id i+1). The jitted
     forward is cached on (model, shapes), so repeated evals don't
-    recompile."""
+    recompile. The fused Pallas cascade applies when the codebooks are
+    raw (no sim_vq projection / normalization — the shipped configs)."""
+    fused_ok = not (model.codebook_sim_vq or model.codebook_normalize)
+    fn = _sem_ids_of_pallas if fused_ok else _sem_ids_of
     chunks = []
     for s in range(0, len(embeddings), batch_size):
         chunk = {"x": embeddings[s : s + batch_size]}
         n_real = len(chunk["x"])
         padded, _ = pad_to_batch(chunk, batch_size)
-        chunks.append(np.asarray(_sem_ids_of(model, params, padded["x"]))[:n_real])
+        chunks.append(np.asarray(fn(model, params, padded["x"]))[:n_real])
     return np.concatenate(chunks)
 
 

@@ -48,6 +48,35 @@ def test_forward_matches_hf(setup):
     np.testing.assert_allclose(got[valid], ref[valid], atol=3e-4, rtol=1e-3)
 
 
+def test_remat_same_outputs_and_grads(setup):
+    """remat=True must be numerically identical (it only changes the
+    backward-pass memory/recompute tradeoff)."""
+    _, params, g = setup
+    m_plain = QwenLM(CFG)
+    m_remat = QwenLM(CFG, remat=True)
+    ids = jnp.asarray(g["ids"])[:, :6]
+    mask = jnp.ones_like(ids)
+
+    def loss(m):
+        def f(p):
+            out = m.apply({"params": p}, ids, attention_mask=mask)
+            return jnp.sum(out.astype(jnp.float32) ** 2) / ids.size
+
+        return f
+
+    l1 = loss(m_plain)(params)
+    l2 = loss(m_remat)(params)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+    g1 = jax.grad(loss(m_plain))(params)
+    g2 = jax.grad(loss(m_remat))(params)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-4
+        ),
+        g1, g2,
+    )
+
+
 def test_kv_cache_decode_matches_full_forward(setup):
     model, params, g = setup
     ids = jnp.asarray(g["ids"])[:, :6]

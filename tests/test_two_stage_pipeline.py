@@ -1,0 +1,84 @@
+"""End-to-end two-stage pipeline on a fabricated Amazon-format root:
+
+reviews gz -> load_sequences -> (fabricated item embeddings) ->
+rqvae_trainer.train() -> sem_ids.npz -> tiger_trainer.train() -> metrics.
+
+This is the cross-stage interface the reference wires through torch
+checkpoints inside dataset constructors (amazon.py:296-313); here the
+portable artifact is the contract, exercised trainer-to-trainer.
+"""
+
+import gzip
+import json
+import os
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def amazon_root(tmp_path_factory):
+    root = tmp_path_factory.mktemp("amazon")
+    raw = root / "raw" / "beauty"
+    raw.mkdir(parents=True)
+    rng = np.random.default_rng(0)
+    n_items = 40
+    with gzip.open(raw / "reviews_Beauty_5.json.gz", "wt") as f:
+        for u in range(120):
+            n = int(rng.integers(5, 10))
+            t0 = 1_400_000_000 + int(rng.integers(0, 1e6))
+            for j in range(n):
+                f.write(json.dumps({
+                    "reviewerID": f"U{u}",
+                    "asin": f"B{int(rng.integers(n_items)):04d}",
+                    "unixReviewTime": t0 + j * 86400,
+                }) + "\n")
+    return str(root)
+
+
+def test_rqvae_then_tiger(amazon_root, tmp_path):
+    from genrec_tpu.configlib import clear_bindings
+    from genrec_tpu.data.amazon import load_sequences
+    from genrec_tpu.data.items import SyntheticItemEmbeddings
+
+    clear_bindings()
+    _, _, num_items = load_sequences(amazon_root, "beauty", download=False)
+
+    # Fabricated item embeddings standing in for the sentence-T5 stage.
+    emb = SyntheticItemEmbeddings(num_items=num_items, dim=24, n_clusters=6,
+                                  seed=0).embeddings
+    proc = os.path.join(amazon_root, "processed")
+    np.save(os.path.join(proc, "beauty_item_emb.npy"), emb)
+
+    # Stage 1: RQ-VAE on the real 'amazon' path -> sem-id artifact.
+    from genrec_tpu.trainers import rqvae_trainer
+
+    sem_path = str(tmp_path / "sem_ids.npz")
+    rqvae_trainer.train(
+        epochs=3, batch_size=16, learning_rate=1e-3,
+        vae_input_dim=24, vae_hidden_dims=(32,), vae_embed_dim=8,
+        vae_codebook_size=8, vae_n_layers=3,
+        dataset="amazon", dataset_folder=amazon_root, split="beauty",
+        do_eval=False, save_dir_root=str(tmp_path / "rqvae"),
+        sem_ids_path=sem_path, kmeans_warmup_rows=200,
+    )
+    assert os.path.exists(sem_path)
+    from genrec_tpu.data.sem_ids import load_sem_ids
+
+    sem_ids, K = load_sem_ids(sem_path)
+    assert sem_ids.shape == (num_items, 3) and K == 8
+
+    # Stage 2: TIGER consumes the artifact through its 'amazon' path.
+    from genrec_tpu.trainers import tiger_trainer
+
+    valid_m, test_m = tiger_trainer.train(
+        epochs=1, batch_size=32, learning_rate=1e-3, num_warmup_steps=5,
+        embedding_dim=16, attn_dim=32, num_heads=4, n_layers=2,
+        max_items=6, num_user_embeddings=64,
+        dataset="amazon", dataset_folder=amazon_root, split="beauty",
+        sem_ids_path=sem_path,
+        do_eval=True, eval_every_epoch=1, eval_batch_size=32,
+        save_dir_root=str(tmp_path / "tiger"),
+    )
+    assert 0.0 <= test_m["Recall@10"] <= 1.0
+    assert os.path.isdir(tmp_path / "tiger" / "best_model")
