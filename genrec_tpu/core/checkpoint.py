@@ -63,6 +63,62 @@ def load_params(path: str, like: Any | None = None) -> Any:
     return ckptr.restore(_abs(path))
 
 
+def maybe_resume(ckpt: "CheckpointManager | None", state, replicate_fn=None):
+    """Shared resume logic for every trainer.
+
+    Checkpoints are keyed by EPOCH. Returns
+    ``(state, start_epoch, global_step)`` — fresh-start values when there
+    is nothing to restore. ``replicate_fn`` re-places the restored host
+    arrays on the mesh.
+    """
+    if ckpt is None or ckpt.latest_step() is None:
+        return state, 0, 0
+    restored = ckpt.restore(state)
+    if replicate_fn is not None:
+        restored = replicate_fn(restored)
+    start_epoch = ckpt.latest_step() + 1
+    return restored, start_epoch, int(restored.step)
+
+
+class BestTracker:
+    """Best-metric model snapshotting that SURVIVES resume.
+
+    The best params are written to ``<dir>/best_model`` the moment a new
+    best appears (not only at exit), with the metric value in a sidecar
+    json — so an interrupted run never loses an earlier, better model and
+    a resumed run competes against the true best-so-far.
+    """
+
+    def __init__(self, save_dir: str | None, metric: str = "Recall@10"):
+        self.dir = os.path.join(save_dir, "best_model") if save_dir else None
+        self.meta = self.dir + ".json" if self.dir else None
+        self.metric = metric
+        self.value = -1.0
+        if self.meta and os.path.exists(self.meta):
+            import json
+
+            with open(self.meta) as f:
+                self.value = float(json.load(f)["value"])
+
+    def update(self, value: float, params) -> bool:
+        if value <= self.value:
+            return False
+        self.value = value
+        if self.dir:
+            import json
+
+            save_params(self.dir, params)
+            with open(self.meta, "w") as f:
+                json.dump({"metric": self.metric, "value": value}, f)
+        return True
+
+    def best_params(self, like):
+        """Best params seen across ALL runs (disk), or None if none saved."""
+        if self.dir and os.path.exists(self.dir) and self.value > -1.0:
+            return load_params(self.dir, like=like)
+        return None
+
+
 class CheckpointManager:
     """Step-numbered training checkpoints with auto-resume.
 

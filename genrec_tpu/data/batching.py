@@ -14,6 +14,17 @@ from typing import Iterator, Mapping
 import numpy as np
 
 
+def cycle(iterable_factory):
+    """Infinite iterator over a re-creatable iterable (reference
+    genrec/data/utils.py:7-12, which cycles a DataLoader). Takes a
+    zero-arg factory so each pass re-shuffles:
+
+        for batch, valid in cycle(lambda: batch_iterator(arrays, 64)): ...
+    """
+    while True:
+        yield from iterable_factory()
+
+
 def pad_to_batch(arrays: Mapping[str, np.ndarray], batch_size: int):
     """Pad dict-of-arrays (same leading dim) up to batch_size; returns
     (padded, valid_mask)."""

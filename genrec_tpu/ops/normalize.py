@@ -27,6 +27,17 @@ def l2norm(x: jax.Array, axis: int = -1, eps: float = 1e-12) -> jax.Array:
     return x / jnp.maximum(n, eps)
 
 
+def swish_layer_norm(
+    x: jax.Array, scale: jax.Array, bias: jax.Array, eps: float = 1e-5
+) -> jax.Array:
+    """SiLU(LayerNorm(x)) (reference normalize.py:58-70; unused by the
+    reference trainers but part of the module surface)."""
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    normed = (x - mean) * jax.lax.rsqrt(var + eps) * scale + bias
+    return normed * jax.nn.sigmoid(normed)
+
+
 def rms_norm(x: jax.Array, weight: jax.Array, eps: float = 1e-6) -> jax.Array:
     """T5-style RMS norm: variance in float32, no mean subtraction, no bias.
 

@@ -119,6 +119,7 @@ def train(
     eval_batch_size=32,
     save_dir_root="out/cobra",
     save_every_epoch=50,
+    resume_from_checkpoint=False,
     wandb_logging=False,
     wandb_project="cobra_training",
     wandb_log_interval=100,
@@ -198,13 +199,18 @@ def train(
     state = replicate(mesh, TrainState.create(params, optimizer, state_rng))
     fusion_fn = make_fusion_fn(model, item_sem_ids, 10, n_beam, fusion_alpha)
 
-    from genrec_tpu.core.checkpoint import CheckpointManager, save_params
+    from genrec_tpu.core.checkpoint import BestTracker, CheckpointManager, maybe_resume, save_params
 
     ckpt = CheckpointManager(os.path.join(save_dir_root, "checkpoints")) if save_dir_root else None
-
-    global_step = 0
-    best_recall, best_params = -1.0, None
-    for epoch in range(epochs):
+    start_epoch, global_step = 0, 0
+    if resume_from_checkpoint:
+        state, start_epoch, global_step = maybe_resume(
+            ckpt, state, lambda s: replicate(mesh, s)
+        )
+        if start_epoch:
+            logger.info(f"resumed after epoch {start_epoch - 1} (step {global_step})")
+    best = BestTracker(save_dir_root)
+    for epoch in range(start_epoch, epochs):
         epoch_loss, n_batches = None, 0
         for batch, _ in batch_iterator(
             train_arrays, batch_size, shuffle=True, seed=seed, epoch=epoch, drop_last=True
@@ -237,11 +243,11 @@ def train(
                 f"epoch {epoch} valid " + ", ".join(f"{k}={v:.4f}" for k, v in m.items())
             )
             tracker.log({"epoch": epoch, **{f"eval/{k}": v for k, v in m.items()}})
-            if m["Recall@10"] > best_recall:
-                best_recall = m["Recall@10"]
-                best_params = jax.tree_util.tree_map(np.asarray, state.params)
+            best.update(m["Recall@10"], state.params)
 
-    final_params = state.params if best_params is None else best_params
+    final_params = best.best_params(like=state.params)
+    if final_params is None:
+        final_params = state.params
     item_vecs = compute_item_dense_vecs(model, final_params, data.item_texts)
     valid_metrics = evaluate(fusion_fn, final_params, valid_arrays, item_vecs,
                              eval_batch_size, mesh, n_codebooks)
@@ -249,7 +255,7 @@ def train(
                             eval_batch_size, mesh, n_codebooks)
     logger.info("test " + ", ".join(f"{k}={v:.4f}" for k, v in test_metrics.items()))
     tracker.log({f"test/{k}": v for k, v in test_metrics.items()})
-    if save_dir_root:
+    if save_dir_root and best.value < 0:  # no eval ran: snapshot final params
         save_params(os.path.join(save_dir_root, "best_model"), final_params)
     if ckpt is not None:
         ckpt.close()

@@ -198,18 +198,22 @@ def train(
         max_cache=max_text_len + num_codebooks + 1,
     )
 
-    from genrec_tpu.core.checkpoint import CheckpointManager, save_params
+    from genrec_tpu.core.checkpoint import BestTracker, CheckpointManager, maybe_resume, save_params
 
     ckpt = CheckpointManager(os.path.join(save_dir_root, "checkpoints")) if save_dir_root else None
 
     # eval_only restores the latest checkpoint (the reference loads a
     # trained model for eval_only, lcrec_trainer.py:358-364); resume picks
     # up mid-training.
-    if (eval_only or resume_from_checkpoint) and ckpt is not None and ckpt.latest_step() is not None:
-        state = replicate(mesh, ckpt.restore(state))
-        logger.info(f"restored checkpoint at step {int(state.step)}")
-    elif eval_only:
-        logger.warning("eval_only without a checkpoint: evaluating the INITIAL model")
+    start_epoch, global_step = 0, 0
+    if eval_only or resume_from_checkpoint:
+        state, start_epoch, global_step = maybe_resume(
+            ckpt, state, lambda s: replicate(mesh, s)
+        )
+        if start_epoch:
+            logger.info(f"resumed after epoch {start_epoch - 1} (step {global_step})")
+        elif eval_only:
+            logger.warning("eval_only without a checkpoint: evaluating the INITIAL model")
 
     if eval_only:
         m = evaluate(gen_fn, params_of(state.params), valid_arrays, eval_batch_size, mesh, num_codebooks)
@@ -217,9 +221,8 @@ def train(
         tracker.finish()
         return m, m
 
-    global_step = 0
-    best_recall, best_trainable = -1.0, None
-    for epoch in range(epochs):
+    best = BestTracker(save_dir_root)
+    for epoch in range(start_epoch, epochs):
         epoch_loss, n_batches = None, 0
         for batch, _ in batch_iterator(
             train_arrays, batch_size, shuffle=True, seed=seed, epoch=epoch, drop_last=True
@@ -241,18 +244,20 @@ def train(
                 f"epoch {epoch} valid " + ", ".join(f"{k}={v:.4f}" for k, v in m.items())
             )
             tracker.log({"epoch": epoch, **{f"eval/{k}": v for k, v in m.items()}})
-            if m["Recall@10"] > best_recall:
-                best_recall = m["Recall@10"]
-                best_trainable = jax.tree_util.tree_map(np.asarray, state.params)
+            best.update(m["Recall@10"], state.params)
 
-    final_trainable = state.params if best_trainable is None else best_trainable
+    final_trainable = best.best_params(like=state.params)
+    if final_trainable is None:
+        final_trainable = state.params
     final_params = params_of(final_trainable)
     valid_metrics = evaluate(gen_fn, final_params, valid_arrays, eval_batch_size, mesh, num_codebooks)
     test_metrics = evaluate(gen_fn, final_params, test_arrays, eval_batch_size, mesh, num_codebooks)
     logger.info("test " + ", ".join(f"{k}={v:.4f}" for k, v in test_metrics.items()))
     tracker.log({f"test/{k}": v for k, v in test_metrics.items()})
     if save_dir_root:
-        save_params(os.path.join(save_dir_root, "best_model"), final_params)
+        # Best tracker stores the TRAINABLE tree (lora or full); persist the
+        # merged model too for direct consumption.
+        save_params(os.path.join(save_dir_root, "final_model"), final_params)
     if ckpt is not None:
         ckpt.close()
     tracker.finish()

@@ -116,6 +116,7 @@ def train(
     eval_every=50,
     save_model_every=50,
     save_dir_root="out/rqvae",
+    resume_from_checkpoint=False,
     sem_ids_path=None,
     wandb_logging=False,
     wandb_project="rqvae_training",
@@ -197,12 +198,17 @@ def train(
         out = model.apply({"params": p}, x, gumbel_temperature, training=False)
         return out.loss, out.reconstruction_loss, out.rqvae_loss
 
-    from genrec_tpu.core.checkpoint import CheckpointManager
+    from genrec_tpu.core.checkpoint import CheckpointManager, maybe_resume
 
     ckpt = CheckpointManager(os.path.join(save_dir_root, "checkpoints")) if save_dir_root else None
-
-    global_step = 0
-    for epoch in range(epochs):
+    start_epoch, global_step = 0, 0
+    if resume_from_checkpoint:
+        state, start_epoch, global_step = maybe_resume(
+            ckpt, state, lambda s: replicate(mesh, s)
+        )
+        if start_epoch:
+            logger.info(f"resumed after epoch {start_epoch - 1} (step {global_step})")
+    for epoch in range(start_epoch, epochs):
         for batch, _ in batch_iterator(
             {"x": train_x}, batch_size, shuffle=True, seed=seed, epoch=epoch, drop_last=True
         ):

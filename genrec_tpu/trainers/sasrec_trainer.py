@@ -86,6 +86,7 @@ def train(
     eval_batch_size=256,
     save_dir_root="out/sasrec",
     save_every_epoch=50,
+    resume_from_checkpoint=False,
     wandb_logging=False,
     wandb_project="sasrec_training",
     wandb_log_interval=100,
@@ -154,14 +155,18 @@ def train(
     state = replicate(mesh, TrainState.create(params, optimizer, state_rng))
     eval_step = make_eval_step(model)  # one jit cache for every eval call
 
-    from genrec_tpu.core.checkpoint import CheckpointManager, save_params
+    from genrec_tpu.core.checkpoint import BestTracker, CheckpointManager, maybe_resume, save_params
 
     ckpt_mgr = CheckpointManager(os.path.join(save_dir_root, "checkpoints")) if save_dir_root else None
-
-    global_step = 0
-    best_recall = -1.0
-    best_params = None
-    for epoch in range(epochs):
+    start_epoch, global_step = 0, 0
+    if resume_from_checkpoint:
+        state, start_epoch, global_step = maybe_resume(
+            ckpt_mgr, state, lambda s: replicate(mesh, s)
+        )
+        if start_epoch:
+            logger.info(f"resumed after epoch {start_epoch - 1} (step {global_step})")
+    best = BestTracker(save_dir_root)
+    for epoch in range(start_epoch, epochs):
         # Device-scalar accumulation: float() only at logging boundaries so
         # the host never blocks on the jitted step (async dispatch).
         epoch_loss, n_batches = None, 0
@@ -187,17 +192,17 @@ def train(
                 f"epoch {epoch} valid " + ", ".join(f"{k}={v:.4f}" for k, v in m.items())
             )
             tracker.log({"epoch": epoch, **{f"eval/{k}": v for k, v in m.items()}})
-            if m["Recall@10"] > best_recall:
-                best_recall = m["Recall@10"]
-                best_params = jax.tree_util.tree_map(np.asarray, state.params)
+            best.update(m["Recall@10"], state.params)
 
-    final_params = state.params if best_params is None else best_params
+    final_params = best.best_params(like=state.params)
+    if final_params is None:
+        final_params = state.params
     valid_metrics = evaluate(eval_step, final_params, valid_arrays, eval_batch_size, mesh)
     test_metrics = evaluate(eval_step, final_params, test_arrays, eval_batch_size, mesh)
     logger.info("test " + ", ".join(f"{k}={v:.4f}" for k, v in test_metrics.items()))
     tracker.log({f"test/{k}": v for k, v in test_metrics.items()})
 
-    if save_dir_root:
+    if save_dir_root and best.value < 0:  # no eval ran: snapshot final params
         save_params(os.path.join(save_dir_root, "best_model"), final_params)
     if ckpt_mgr is not None:
         ckpt_mgr.close()

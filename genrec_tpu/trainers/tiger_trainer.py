@@ -176,17 +176,17 @@ def train(
     state = replicate(mesh, TrainState.create(params, optimizer, state_rng))
     gen_fn = make_generate_fn(model, trie, generate_temperature, 10)
 
-    from genrec_tpu.core.checkpoint import CheckpointManager, save_params
+    from genrec_tpu.core.checkpoint import BestTracker, CheckpointManager, maybe_resume, save_params
 
     ckpt = CheckpointManager(os.path.join(save_dir_root, "checkpoints")) if save_dir_root else None
-    start_epoch = 0
-    if resume_from_checkpoint and ckpt is not None and ckpt.latest_step() is not None:
-        state = replicate(mesh, ckpt.restore(state))
-        start_epoch = int(state.step) // opt_steps_per_epoch
-        logger.info(f"resumed from step {int(state.step)} (epoch {start_epoch})")
-
-    global_step = 0
-    best_recall, best_params = -1.0, None
+    start_epoch, global_step = 0, 0
+    if resume_from_checkpoint:
+        state, start_epoch, global_step = maybe_resume(
+            ckpt, state, lambda s: replicate(mesh, s)
+        )
+        if start_epoch:
+            logger.info(f"resumed after epoch {start_epoch - 1} (step {global_step})")
+    best = BestTracker(save_dir_root)
     for epoch in range(start_epoch, epochs):
         # Accumulate the device scalar; float() only at logging boundaries
         # so host dispatch never blocks on the step (async dispatch).
@@ -212,20 +212,20 @@ def train(
                 f"epoch {epoch} valid " + ", ".join(f"{k}={v:.4f}" for k, v in metrics.items())
             )
             tracker.log({"epoch": epoch, **{f"eval/{k}": v for k, v in metrics.items()}})
-            if metrics["Recall@10"] > best_recall:
-                best_recall = metrics["Recall@10"]
-                best_params = jax.tree_util.tree_map(np.asarray, state.params)
+            best.update(metrics["Recall@10"], state.params)
 
         if ckpt is not None and (epoch + 1) % save_every_epoch == 0:
-            ckpt.save(int(state.step), state)
+            ckpt.save(epoch, state)  # epoch-keyed: uniform across trainers
 
-    final_params = state.params if best_params is None else best_params
+    final_params = best.best_params(like=state.params)
+    if final_params is None:
+        final_params = state.params
     eval_rng, s1, s2 = jax.random.split(eval_rng, 3)
     valid_metrics = evaluate(gen_fn, final_params, valid_arrays, eval_batch_size, mesh, s1)
     test_metrics = evaluate(gen_fn, final_params, test_arrays, eval_batch_size, mesh, s2)
     logger.info("test " + ", ".join(f"{k}={v:.4f}" for k, v in test_metrics.items()))
     tracker.log({f"test/{k}": v for k, v in test_metrics.items()})
-    if save_dir_root:
+    if save_dir_root and best.value < 0:  # no eval ran: snapshot final params
         save_params(os.path.join(save_dir_root, "best_model"), final_params)
     if ckpt is not None:
         ckpt.close()
