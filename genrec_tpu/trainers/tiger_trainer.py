@@ -77,6 +77,7 @@ def train(
     dataset_folder="dataset/amazon",
     split="beauty",
     sem_ids_path=None,
+    add_disambiguation=False,
     generate_temperature=0.2,
     do_eval=True,
     eval_every_epoch=10,
@@ -109,6 +110,13 @@ def train(
         if sem_ids_path is None:
             raise ValueError("amazon dataset needs sem_ids_path (RQ-VAE artifact)")
         sem_ids, codebook_size = load_sem_ids(sem_ids_path)
+        if add_disambiguation:
+            # Optional 4th code resolving sem-id collisions (reference
+            # amazon.py:323-353; disabled in its shipped configs). The
+            # rank-based PackedTrie handles the deeper id space.
+            from genrec_tpu.data.sem_ids import dedup_sem_ids
+
+            sem_ids = dedup_sem_ids(sem_ids, codebook_size)
         data = TigerSeqData(seqs, sem_ids, max_items=max_items,
                             user_hash_size=num_user_embeddings)
         sem_id_dim = data.D

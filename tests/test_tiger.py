@@ -301,3 +301,35 @@ def test_training_reduces_loss_on_mesh():
             state, m = step(state, shard_batch(mesh, batch))
             losses.append(float(m["loss"]))
     assert losses[-1] < losses[0] * 0.9, (losses[0], losses[-1])
+
+
+def test_generate_with_disambiguation_depth4():
+    """sem_id_dim=4 via the dedup column: PackedTrie-backed generation
+    must emit only valid 4-tuples."""
+    from genrec_tpu.data.sem_ids import dedup_sem_ids
+
+    rng = np.random.default_rng(4)
+    base = rng.integers(0, 6, (40, 3))
+    valid = dedup_sem_ids(base.astype(np.int32), 6)
+    trie = build_trie(valid, 6, dense_max_bits=10)  # force PackedTrie
+    assert isinstance(trie, PackedTrie)
+    model = Tiger(embedding_dim=16, attn_dim=32, dropout=0.0, num_heads=4,
+                  n_layers=2, num_item_embeddings=6, num_user_embeddings=10,
+                  sem_id_dim=4, max_pos=64)
+    B, L = 2, 8
+    user = jnp.asarray(rng.integers(0, 10, (B,)), jnp.int32)
+    items = jnp.asarray(rng.integers(0, 6, (B, L)), jnp.int32)
+    types = jnp.asarray(np.tile(np.arange(4), (B, 2)), jnp.int32)
+    mask = jnp.ones((B, L), jnp.int32)
+    params = model.init(
+        jax.random.key(0), user, items, types,
+        jnp.zeros((B, 4), jnp.int32), jnp.zeros((B, 4), jnp.int32), mask,
+    )["params"]
+    out = tiger_generate(model, params, trie, user, items, types, mask,
+                         jax.random.key(1), n_top_k_candidates=4)
+    valid_set = {tuple(v) for v in valid.tolist()}
+    lp = np.asarray(out.log_probas)
+    for b in range(B):
+        for k in range(4):
+            if lp[b, k] > -1e30:
+                assert tuple(np.asarray(out.sem_ids)[b, k].tolist()) in valid_set
