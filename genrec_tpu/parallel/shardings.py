@@ -40,9 +40,15 @@ def qwen_rules(model_axis: str = "model") -> Sequence[Rule]:
     )
 
 
-def param_specs(params, rules: Sequence[Rule], mesh: Mesh):
+def param_specs(params, rules: Sequence[Rule], mesh: Mesh, log_fn=None):
     """PartitionSpec tree for ``params`` under ``rules`` (replicated where
-    no rule matches or the axis doesn't divide the mesh axis size)."""
+    no rule matches or the axis doesn't divide the mesh axis size).
+
+    ``log_fn`` (e.g. logger.info) reports every rule-matched leaf that had
+    to FALL BACK to replication because of divisibility — silent fallback
+    otherwise hides that "tensor parallelism" sharded nothing (TIGER's
+    default flat vocab 256*3+1 = 769 is odd, so the vocab rules skip at
+    any even tp degree)."""
 
     def spec_of(path, leaf):
         p = "/".join(str(getattr(k, "key", k)) for k in path)
@@ -52,13 +58,19 @@ def param_specs(params, rules: Sequence[Rule], mesh: Mesh):
                     out = [None] * leaf.ndim
                     out[axis] = mesh_axis
                     return P(*out)
+                if log_fn is not None:
+                    log_fn(
+                        f"sharding rule matched {p} but dim {axis} "
+                        f"({leaf.shape[axis]}) is not divisible by "
+                        f"{mesh_axis}={mesh.shape[mesh_axis]}; replicating"
+                    )
         return P()
 
     return jax.tree_util.tree_map_with_path(spec_of, params)
 
 
-def shard_params(mesh: Mesh, params, rules: Sequence[Rule]):
-    specs = param_specs(params, rules, mesh)
+def shard_params(mesh: Mesh, params, rules: Sequence[Rule], log_fn=None):
+    specs = param_specs(params, rules, mesh, log_fn=log_fn)
     return jax.tree_util.tree_map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs
     )

@@ -28,7 +28,7 @@ from genrec_tpu.models.tiger import Tiger, tiger_generate
 from genrec_tpu.ops.metrics import TopKAccumulator
 from genrec_tpu.ops.schedules import cosine_schedule_with_warmup
 from genrec_tpu.ops.trie import build_trie
-from genrec_tpu.parallel import distributed_init, get_mesh, replicate, shard_batch
+from genrec_tpu.parallel import distributed_init, get_mesh, make_mesh, replicate, shard_batch
 
 
 def make_generate_fn(model, trie, temperature, n_candidates):
@@ -78,6 +78,7 @@ def train(
     split="beauty",
     sem_ids_path=None,
     add_disambiguation=False,
+    tensor_parallel=1,
     generate_temperature=0.2,
     do_eval=True,
     eval_every_epoch=10,
@@ -95,7 +96,13 @@ def train(
     distributed_init()
     logger = setup_logger(save_dir_root)
     tracker = Tracker(wandb_logging, wandb_project, save_dir=save_dir_root)
-    mesh = get_mesh()
+    if tensor_parallel > 1:
+        # 2-D mesh: batch on "data", vocab/embedding/FFN weights on "model"
+        # (parallel/shardings.tiger_rules). XLA inserts the tp collectives.
+        mesh = make_mesh({"data": -1, "model": tensor_parallel})
+        logger.info(f"mesh {dict(zip(mesh.axis_names, mesh.devices.shape))}")
+    else:
+        mesh = get_mesh()
 
     if dataset == "synthetic":
         data = synthetic_tiger_data(
@@ -181,7 +188,17 @@ def train(
         ),
         donate_argnums=0,
     )
-    state = replicate(mesh, TrainState.create(params, optimizer, state_rng))
+    # One placement function used at creation AND on resume, so a restored
+    # run keeps the exact same layout (sharded rules apply to the whole
+    # TrainState — adam mu/nu mirror the param paths, so the substring
+    # rules place them identically).
+    if tensor_parallel > 1:
+        from genrec_tpu.parallel.shardings import shard_params, tiger_rules
+
+        place_state = lambda s: shard_params(mesh, s, tiger_rules(), log_fn=logger.info)
+    else:
+        place_state = lambda s: replicate(mesh, s)
+    state = place_state(TrainState.create(params, optimizer, state_rng))
     gen_fn = make_generate_fn(model, trie, generate_temperature, 10)
 
     from genrec_tpu.core.checkpoint import BestTracker, CheckpointManager, maybe_resume, save_params
@@ -189,9 +206,8 @@ def train(
     ckpt = CheckpointManager(os.path.join(save_dir_root, "checkpoints")) if save_dir_root else None
     start_epoch, global_step = 0, 0
     if resume_from_checkpoint:
-        state, start_epoch, global_step = maybe_resume(
-            ckpt, state, lambda s: replicate(mesh, s)
-        )
+        # place_state preserves the tensor-parallel layout on restore.
+        state, start_epoch, global_step = maybe_resume(ckpt, state, place_state)
         if start_epoch:
             logger.info(f"resumed after epoch {start_epoch - 1} (step {global_step})")
     best = BestTracker(save_dir_root)
