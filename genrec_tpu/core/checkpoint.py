@@ -27,11 +27,21 @@ def _is_prng_key(x) -> bool:
 
 
 def to_savable(tree: Any) -> Any:
-    """Host numpy copy of a pytree; typed PRNG keys become their uint32 data."""
+    """Checkpoint-ready copy of a pytree.
+
+    Typed PRNG keys become their uint32 data. Fully-addressable arrays are
+    materialized as host numpy; arrays sharded across NON-addressable
+    devices (multi-host tensor parallelism) are passed through as
+    jax.Arrays — orbax writes distributed arrays natively, where
+    np.asarray would raise. Restore goes through the trainer's
+    place_state, which re-applies the target sharding.
+    """
 
     def conv(x):
         if _is_prng_key(x):
-            return np.asarray(jax.random.key_data(x))
+            x = jax.random.key_data(x)
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            return x
         return np.asarray(x)
 
     return jax.tree_util.tree_map(conv, tree)

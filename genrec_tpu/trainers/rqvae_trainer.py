@@ -125,6 +125,7 @@ def train(
 ):
     if (epochs is None) == (iterations is None):
         raise ValueError("specify exactly one of 'epochs' or 'iterations'")
+    use_epochs = epochs is not None
 
     distributed_init()
     logger = setup_logger(save_dir_root)
@@ -133,10 +134,21 @@ def train(
 
     if dataset == "synthetic":
         src = SyntheticItemEmbeddings(dim=vae_input_dim, seed=seed)
+        train_x, eval_x = src.arrays()
+        all_x = src.embeddings
+    elif dataset == "p5":
+        # Reference default source (P5AmazonReviewsItemDataset): items
+        # filtered by the seed-42 train mask (p5_amazon.py:365-367).
+        from genrec_tpu.data.p5_amazon import P5AmazonData, item_train_mask
+
+        p5 = P5AmazonData(dataset_folder, split)
+        all_x = p5.item_embeddings()  # one disk read
+        mask = item_train_mask(len(all_x))
+        train_x, eval_x = all_x[mask], all_x[~mask]
     else:
         src = ItemEmbeddingData(root=dataset_folder, split=split)
-    train_x, eval_x = src.arrays()
-    all_x = src.embeddings
+        train_x, eval_x = src.arrays()
+        all_x = src.embeddings
 
     model = RqVae(
         input_dim=vae_input_dim,
@@ -216,6 +228,18 @@ def train(
                 break
             state, m = step_fn(state, shard_batch(mesh, batch))
             global_step += 1
+            if not use_epochs:
+                # Iteration mode gates eval/save on ITERATIONS (reference
+                # rqvae_trainer.py:393,419), not derived epochs.
+                if do_eval and global_step % eval_every == 0:
+                    le = eval_losses(state.params, jnp.asarray(eval_x))
+                    cr, n, uniq = compute_collision_rate(model, state.params, all_x)
+                    logger.info(
+                        f"iter {global_step} eval loss {float(le[0]):.4f} "
+                        f"collision {cr:.4f} ({uniq}/{n})"
+                    )
+                if ckpt is not None and global_step % save_model_every == 0:
+                    ckpt.save(epoch, state)
             if global_step % wandb_log_interval == 0:
                 tracker.log(
                     {
@@ -228,7 +252,7 @@ def train(
                     }
                 )
 
-        if do_eval and ((epoch + 1) % eval_every == 0 or epoch + 1 == epochs):
+        if use_epochs and do_eval and ((epoch + 1) % eval_every == 0 or epoch + 1 == epochs):
             le = eval_losses(state.params, jnp.asarray(eval_x))
             cr, n, uniq = compute_collision_rate(model, state.params, all_x)
             logger.info(
@@ -245,7 +269,10 @@ def train(
                 }
             )
 
-        if ckpt is not None and ((epoch + 1) % save_model_every == 0 or epoch + 1 == epochs):
+        if ckpt is not None and (
+            (use_epochs and ((epoch + 1) % save_model_every == 0 or epoch + 1 == epochs))
+            or (not use_epochs and epoch + 1 == epochs)
+        ):
             ckpt.save(epoch, state)  # full TrainState: one resumable format everywhere
 
     # Export the portable sem-id artifact for downstream stages.

@@ -83,3 +83,47 @@ def test_random_crop_subsample_bounds():
 def test_missing_files_clear_error(tmp_path):
     with pytest.raises(FileNotFoundError):
         P5AmazonData(str(tmp_path), "beauty")
+
+
+def test_rqvae_trainer_p5_path(tmp_path):
+    """rqvae_trainer dataset='p5' end-to-end over fabricated P5 files.
+
+    Batch size must divide the 8-device test mesh, so this builds a
+    larger root than the parsing fixture (64 items)."""
+    import os
+
+    from genrec_tpu.configlib import clear_bindings
+    from genrec_tpu.data.p5_amazon import P5AmazonData
+    from genrec_tpu.trainers import rqvae_trainer
+
+    clear_bindings()
+    root = tmp_path / "p5"
+    raw = root / "raw" / "beauty"
+    raw.mkdir(parents=True)
+    rng = np.random.default_rng(0)
+    n_items = 64
+    lines = []
+    for u in range(30):
+        items = rng.choice(n_items, size=8, replace=False) + 1  # 1-based
+        lines.append(" ".join(map(str, [u + 1] + list(items))))
+    (raw / "sequential_data.txt").write_text("\n".join(lines) + "\n")
+
+    data = P5AmazonData(str(root), "beauty")
+    emb = rng.normal(size=(data.num_items, 12)).astype(np.float32)
+    proc = os.path.join(str(root), "processed")
+    os.makedirs(proc, exist_ok=True)
+    np.save(os.path.join(proc, "beauty_item_emb.npy"), emb)
+
+    sem_path = str(tmp_path / "sem_ids.npz")
+    rqvae_trainer.train(
+        epochs=2, batch_size=16, learning_rate=1e-3,
+        vae_input_dim=12, vae_hidden_dims=(16,), vae_embed_dim=8,
+        vae_codebook_size=4, vae_n_layers=2,
+        dataset="p5", dataset_folder=str(root), split="beauty",
+        do_eval=False, save_dir_root=str(tmp_path / "rq"),
+        sem_ids_path=sem_path, kmeans_warmup_rows=32,
+    )
+    from genrec_tpu.data.sem_ids import load_sem_ids
+
+    ids, K = load_sem_ids(sem_path)
+    assert ids.shape == (data.num_items, 2) and K == 4
