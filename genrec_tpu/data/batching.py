@@ -14,6 +14,39 @@ from typing import Iterator, Mapping
 import numpy as np
 
 
+def prefetch_to_device(iterator, mesh, size: int = 2, axis: str = "data"):
+    """Overlap host batching/placement with device compute.
+
+    Wraps a (batch, valid) iterator: a background thread shards batches
+    onto the mesh ``size`` steps ahead, so the accelerator never waits on
+    the host input pipeline (the reference leans on torch DataLoader
+    worker processes for this; here a single thread + jax async dispatch
+    suffices because batches are pre-materialized numpy).
+    """
+    import queue
+    import threading
+
+    from genrec_tpu.parallel.mesh import shard_batch
+
+    q: "queue.Queue" = queue.Queue(maxsize=size)
+    _END = object()
+
+    def producer():
+        try:
+            for batch, valid in iterator:
+                q.put((shard_batch(mesh, batch, axis=axis), valid))
+        finally:
+            q.put(_END)
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    while True:
+        item = q.get()
+        if item is _END:
+            return
+        yield item
+
+
 def cycle(iterable_factory):
     """Infinite iterator over a re-creatable iterable (reference
     genrec/data/utils.py:7-12, which cycles a DataLoader). Takes a

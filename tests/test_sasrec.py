@@ -240,3 +240,19 @@ def test_cycle_restarts_iterable():
     batches = [next(it)[0]["x"] for _ in range(5)]
     # 2 batches per pass -> 5 draws span 3 passes without raising.
     assert all(b.shape == (4, 1) for b in batches)
+
+
+def test_prefetch_to_device_matches_direct():
+    from genrec_tpu.data.batching import batch_iterator, prefetch_to_device
+    from genrec_tpu.parallel import get_mesh
+
+    mesh = get_mesh()
+    arrays = {"x": np.arange(64, dtype=np.int32)[:, None]}
+    direct = [b["x"] for b, _ in batch_iterator(arrays, 8)]
+    pre = [
+        np.asarray(b["x"])
+        for b, _ in prefetch_to_device(batch_iterator(arrays, 8), mesh)
+    ]
+    assert len(direct) == len(pre)
+    for a, b in zip(direct, pre):
+        np.testing.assert_array_equal(a, b)

@@ -82,3 +82,30 @@ def test_rqvae_then_tiger(amazon_root, tmp_path):
     )
     assert 0.0 <= test_m["Recall@10"] <= 1.0
     assert os.path.isdir(tmp_path / "tiger" / "best_model")
+
+
+def test_pipeline_runner_cli(tmp_path):
+    """python -m genrec_tpu.pipelines tiger ... on synthetic configs."""
+    from genrec_tpu import pipelines
+    from genrec_tpu.configlib import clear_bindings
+
+    clear_bindings()
+    valid_m, test_m = pipelines.main([
+        "tiger",
+        "--rqvae-config", "config/rqvae/synthetic.gin",
+        "--model-config", "config/tiger/synthetic.gin",
+        "--split", "beauty",
+        "--workdir", str(tmp_path / "wd"),
+        "--rqvae-gin", "train.epochs=2",
+        "--rqvae-gin", "train.do_eval=False",
+        "--rqvae-gin", f"train.save_dir_root='{tmp_path}/rq'",
+        "--rqvae-gin", "train.vae_codebook_size=32",
+        "--model-gin", "train.epochs=1",
+        "--model-gin", "train.dataset='synthetic'",
+        "--model-gin", "train.do_eval=False",
+        "--model-gin", f"train.save_dir_root='{tmp_path}/tg'",
+    ])
+    import os
+
+    assert os.path.exists(tmp_path / "wd" / "beauty" / "sem_ids.npz")
+    assert isinstance(test_m, dict)
