@@ -28,7 +28,23 @@ def parse_config(argv: Sequence[str] | None = None) -> argparse.Namespace:
         metavar="BINDING",
         help='override binding, e.g. --gin "train.epochs=1" (repeatable)',
     )
+    ap.add_argument(
+        "--platform",
+        default=None,
+        choices=("cpu", "tpu"),
+        help=(
+            "pin the JAX platform. NOTE: on hosts whose sitecustomize "
+            "pre-imports jax with a pinned platform, the JAX_PLATFORMS env "
+            "var is overridden at interpreter start — this flag applies "
+            "jax.config.update, which always wins"
+        ),
+    )
     args = ap.parse_args(argv)
+
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
 
     _parser.parse_file(args.config, substitutions={"split": args.split})
     for binding in args.gin:

@@ -3,6 +3,18 @@
 Semantics follow gin: a binding ``target.param = value`` supplies the value
 of ``param`` whenever the configurable ``target`` is called *without* an
 explicit ``param`` argument. Explicit call-site arguments always win.
+
+Binding resolution is gin's module-path suffix rule (reference
+genrec/modules/utils.py:85-117 drives six different ``train()`` functions
+from one gin file this way): a binding target matches a configurable when
+it equals, or is a trailing dot-delimited suffix of, the configurable's
+canonical ``module.qualname`` path.  ``train.epochs = 3`` therefore applies
+to *every* imported ``train`` configurable, while
+``tiger_trainer.train.epochs = 3`` applies only to TIGER's; when several
+bindings supply the same parameter the most specific target (most dot
+components) wins, later bindings breaking ties.  This is what lets one
+process import many trainers (pipelines.py) while shipped configs keep
+writing plain ``train.x = y``.
 """
 
 from __future__ import annotations
@@ -15,20 +27,15 @@ from typing import Any, Callable
 
 _LOCK = threading.RLock()
 
-# name -> wrapped callable. Both the short name ("train", "AmazonItemDataset")
-# and the fully-qualified "module.qualname" are registered.
+# canonical "module.qualname" -> wrapped callable.
 _REGISTRY: dict[str, Callable] = {}
 
-# (configurable key, param) -> value. Keyed by the canonical (full) name.
+# short/leaf name -> set of canonical paths claiming it (for @Name lookup).
+_SHORT: dict[str, set[str]] = {}
+
+# (target string as written, param) -> value. Insertion-ordered; later
+# bindings win among equally specific targets.
 _BINDINGS: dict[tuple[str, str], Any] = {}
-
-# short name -> canonical name (for binding resolution before/after import).
-_ALIASES: dict[str, str] = {}
-
-# Short names claimed by more than one distinct configurable. Using such a
-# name in a binding or lookup is an error (gin's ambiguity rule); bindings
-# stored under it stop applying.
-_AMBIGUOUS: set[str] = set()
 
 # dotted path -> enum class, for %module.Enum.MEMBER constants.
 _ENUMS: dict[str, type[enum.Enum]] = {}
@@ -70,10 +77,20 @@ class ConfigurableRef(Ref):
         return hash((self.name, self.evaluate))
 
 
-def _canonical(fn: Callable, name: str | None) -> tuple[str, str]:
-    short = name or fn.__name__
+def _paths_for(fn: Callable, name: str | None) -> tuple[str, ...]:
+    """Every dotted path the configurable answers to: the canonical
+    ``module.qualname`` and, for a custom registration name, the same path
+    with the leaf swapped for that name."""
     full = f"{fn.__module__}.{fn.__qualname__}"
-    return short, full
+    if name and name != fn.__name__:
+        return (full, f"{fn.__module__}.{name}")
+    return (full,)
+
+
+def _matches(target: str, path: str) -> bool:
+    """gin suffix rule: target matches path when equal or a trailing
+    dot-component suffix."""
+    return path == target or path.endswith("." + target)
 
 
 def configurable(fn_or_name: Callable | str | None = None, *, name: str | None = None):
@@ -88,29 +105,21 @@ def configurable(fn_or_name: Callable | str | None = None, *, name: str | None =
         return functools.partial(configurable, name=name)
 
     fn = fn_or_name
-    short, full = _canonical(fn, name)
+    paths = _paths_for(fn, name)
 
-    names = (full, short)
     if inspect.isclass(fn):
         sig = inspect.signature(fn.__init__)
         sig = sig.replace(parameters=list(sig.parameters.values())[1:])  # drop self
-        wrapped = _wrap_class(fn, names)
+        wrapped = _wrap_class(fn, paths)
     else:
         sig = inspect.signature(fn)
-        wrapped = _wrap_function(fn, names)
+        wrapped = _wrap_function(fn, paths)
 
     wrapped.__signature__ = sig  # type: ignore[attr-defined]
     with _LOCK:
-        _REGISTRY[full] = wrapped
-        if short in _ALIASES and _ALIASES[short] != full:
-            # Two distinct configurables claim the same short name: the
-            # short name becomes ambiguous (gin errors on ambiguous use).
-            _AMBIGUOUS.add(short)
-            _REGISTRY.pop(short, None)
-            _ALIASES.pop(short, None)
-        elif short not in _AMBIGUOUS:
-            _REGISTRY[short] = wrapped
-            _ALIASES[short] = full
+        for p in paths:
+            _REGISTRY[p] = wrapped
+            _SHORT.setdefault(p.rsplit(".", 1)[-1], set()).add(p)
     return wrapped
 
 
@@ -130,18 +139,26 @@ def _positional_params(fn: Callable) -> list[str]:
     ]
 
 
-def _merge_kwargs(
-    names: tuple[str, ...], pos_params: list[str], args: tuple, kwargs: dict
-) -> dict:
-    """Compute binding-supplied kwargs not covered by explicit arguments.
-
-    ``names`` holds every name the configurable answers to (full dotted path
-    and short name) so bindings parsed before the module was imported still
-    apply. Ambiguous short names are excluded.
-    """
+def _effective_bindings(paths: tuple[str, ...]) -> dict[str, Any]:
+    """Bindings applying to a configurable answering to ``paths``, resolved
+    by most-specific-suffix (ties: later binding wins)."""
     with _LOCK:
-        live = [n for n in names if n not in _AMBIGUOUS]
-        bound = {p: v for (k, p), v in _BINDINGS.items() if k in live}
+        picked: dict[str, tuple[int, Any]] = {}
+        for (target, param), value in _BINDINGS.items():
+            if not any(_matches(target, p) for p in paths):
+                continue
+            spec = target.count(".")
+            # >= : equal specificity resolves to the later binding.
+            if param not in picked or spec >= picked[param][0]:
+                picked[param] = (spec, value)
+    return {p: v for p, (_, v) in picked.items()}
+
+
+def _merge_kwargs(
+    paths: tuple[str, ...], pos_params: list[str], args: tuple, kwargs: dict
+) -> dict:
+    """Compute binding-supplied kwargs not covered by explicit arguments."""
+    bound = _effective_bindings(paths)
     if not bound:
         return kwargs
     # Parameters consumed positionally cannot also come from bindings.
@@ -167,27 +184,27 @@ def _materialize(value):
     return value
 
 
-def _wrap_function(fn: Callable, names: tuple[str, ...]) -> Callable:
+def _wrap_function(fn: Callable, paths: tuple[str, ...]) -> Callable:
     pos_params = _positional_params(fn)
 
     @functools.wraps(fn)
     def wrapper(*args, **kwargs):
-        return fn(*args, **_merge_kwargs(names, pos_params, args, kwargs))
+        return fn(*args, **_merge_kwargs(paths, pos_params, args, kwargs))
 
-    wrapper.__gin_name__ = names[0]  # type: ignore[attr-defined]
+    wrapper.__gin_name__ = paths[0]  # type: ignore[attr-defined]
     return wrapper
 
 
-def _wrap_class(cls: type, names: tuple[str, ...]) -> type:
+def _wrap_class(cls: type, paths: tuple[str, ...]) -> type:
     orig_init = cls.__init__
     pos_params = _positional_params(orig_init)
 
     @functools.wraps(orig_init)
     def __init__(self, *args, **kwargs):
-        orig_init(self, *args, **_merge_kwargs(names, pos_params, args, kwargs))
+        orig_init(self, *args, **_merge_kwargs(paths, pos_params, args, kwargs))
 
     cls.__init__ = __init__
-    cls.__gin_name__ = names[0]  # type: ignore[attr-defined]
+    cls.__gin_name__ = paths[0]  # type: ignore[attr-defined]
     return cls
 
 
@@ -225,58 +242,63 @@ def resolve_enum(dotted: str):
 
 
 def lookup(name: str) -> Callable | None:
+    """Resolve a configurable by path suffix.
+
+    Exact canonical paths hit directly; otherwise the dotted suffix must
+    identify exactly ONE registered configurable — `@train` with two
+    trainer modules imported is an error (gin's ambiguity rule applies to
+    *references*, which need a single callable, not to bindings)."""
     with _LOCK:
-        if name in _AMBIGUOUS:
+        if name in _REGISTRY:
+            return _REGISTRY[name]
+        leaf = name.rsplit(".", 1)[-1]
+        cands = sorted(p for p in _SHORT.get(leaf, ()) if _matches(name, p))
+        if not cands:
+            return None
+        distinct = {id(_REGISTRY[p]) for p in cands}
+        if len(distinct) > 1:
             raise KeyError(
-                f"{name!r} is ambiguous (registered by multiple modules); "
-                "use the full module.qualname path"
+                f"{name!r} is ambiguous — it suffix-matches {cands}; "
+                "use a longer module-path suffix"
             )
-        return _REGISTRY.get(name)
-
-
-def _binding_key(target: str) -> str:
-    with _LOCK:
-        return _ALIASES.get(target, target)
+        return _REGISTRY[cands[0]]
 
 
 def bind(target: str, param: str, value: Any) -> None:
+    """Store a binding under its literal target; resolution against
+    configurables happens lazily at call time (suffix rule), so binding an
+    ambiguous or not-yet-imported name is legal, exactly as in gin files
+    parsed before their imports."""
     with _LOCK:
-        if target in _AMBIGUOUS:
-            raise KeyError(
-                f"binding target {target!r} is ambiguous; use the full "
-                "module.qualname path"
-            )
-        _BINDINGS[(_binding_key(target), param)] = value
-
-
-def _target_names(target: str) -> set[str]:
-    names = {target, _binding_key(target)}
-    # A full dotted path also answers to its trailing qualname.
-    if "." in target:
-        names.add(target.rsplit(".", 1)[-1])
-    return names
+        # Re-insert so "later binding wins" holds for repeated targets.
+        _BINDINGS.pop((target, param), None)
+        _BINDINGS[(target, param)] = value
 
 
 def get_binding(target: str, param: str, default: Any = None) -> Any:
-    names = _target_names(target)
+    """The value ``param`` would receive if the configurable named by
+    ``target`` were called now (suffix resolution included)."""
     with _LOCK:
-        # Scan in insertion order and keep the LAST match so get_binding
-        # agrees with call-time injection, where later bindings win.
-        found, value = False, None
-        for (k, p), v in _BINDINGS.items():
-            if p == param and k in names:
-                found, value = True, v
-        if found:
-            return _materialize(value)
+        paths = [p for ps in _SHORT.values() for p in ps if _matches(target, p)]
+    if not paths:
+        # Target not imported/registered: fall back to literal-target scan
+        # so bindings can be queried before their module exists.
+        paths = [target]
+    eff = _effective_bindings(tuple(dict.fromkeys(paths)))
+    if param in eff:
+        return _materialize(eff[param])
     return default
 
 
 def get_bindings(target: str) -> dict[str, Any]:
-    names = _target_names(target)
     with _LOCK:
-        return {
-            p: _materialize(v) for (k, p), v in _BINDINGS.items() if k in names
-        }
+        paths = [p for ps in _SHORT.values() for p in ps if _matches(target, p)]
+    if not paths:
+        paths = [target]
+    return {
+        p: _materialize(v)
+        for p, v in _effective_bindings(tuple(dict.fromkeys(paths))).items()
+    }
 
 
 def query(target_dot_param: str, default: Any = None) -> Any:

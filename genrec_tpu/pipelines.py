@@ -70,7 +70,16 @@ def main(argv=None):
     ap.add_argument("--rqvae-gin", action="append", default=[])
     ap.add_argument("--model-gin", action="append", default=[])
     ap.add_argument("--workdir", default="out/pipeline")
+    ap.add_argument(
+        "--platform", default=None, choices=("cpu", "tpu"),
+        help="pin the JAX platform via jax.config (env vars are overridden "
+             "by sitecustomize hooks on some hosts)",
+    )
     args = ap.parse_args(argv)
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
     return run_two_stage(
         f"{args.pipeline}_trainer",
         args.rqvae_config,

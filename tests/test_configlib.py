@@ -171,18 +171,36 @@ def test_clear_macros_exported():
     assert callable(configlib.clear_macros)
 
 
-def test_short_name_collision_becomes_ambiguous():
-    @configlib.configurable(name="_collide_me")
-    def a(x=1):
-        return x
+def test_suffix_resolution_with_colliding_leaf_names():
+    """gin's module-path suffix rule: `train.x` applies to EVERY imported
+    `train`; a longer suffix narrows to one; `@train` refs stay ambiguous."""
 
-    @configlib.configurable(name="_collide_me")
-    def b(x=2):
-        return x
+    def make(mod):
+        def _collide_train(x=1):
+            return (mod, x)
 
-    with pytest.raises(KeyError):
-        registry.bind("_collide_me", "x", 3)
-    # Full paths still work.
-    full = f"{b.__module__}.{b.__qualname__}"
-    registry.bind(full, "x", 5)
-    assert b() == 5
+        _collide_train.__module__ = mod  # simulate two trainer modules
+        _collide_train.__qualname__ = "_collide_train"
+        return configlib.configurable(_collide_train)
+
+    a = make("fakepkg.a_trainer")
+    b = make("fakepkg.b_trainer")
+    try:
+        # Plain leaf binding is legal and applies to both (pipelines.py
+        # imports several trainers in one process; shipped configs write
+        # `train.x = y`).
+        registry.bind("_collide_train", "x", 3)
+        assert a() == ("fakepkg.a_trainer", 3)
+        assert b() == ("fakepkg.b_trainer", 3)
+        # A more specific suffix wins for its configurable only.
+        registry.bind("b_trainer._collide_train", "x", 5)
+        assert a() == ("fakepkg.a_trainer", 3)
+        assert b() == ("fakepkg.b_trainer", 5)
+        # References (need ONE callable) still error on ambiguity.
+        with pytest.raises(KeyError):
+            registry.lookup("_collide_train")
+        assert registry.lookup("a_trainer._collide_train") is a
+        assert registry.query("b_trainer._collide_train.x") == 5
+        assert registry.query("a_trainer._collide_train.x") == 3
+    finally:
+        configlib.clear_bindings()
