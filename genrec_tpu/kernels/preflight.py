@@ -1,0 +1,158 @@
+"""Compiled-path (Mosaic) validation + microbench for the Pallas kernels.
+
+CI runs the kernels in ``interpret=True`` mode on CPU; this module is the
+place where the actual TPU lowering is exercised. Runnable standalone:
+
+    python -m genrec_tpu.kernels.preflight
+
+On a TPU backend it compiles both kernels with ``interpret=False``,
+checks them against their XLA references, and times both paths. On any
+other backend it reports ``skipped``. Results go to stdout as one JSON
+object so bench.py (and humans) can consume them.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def _bench(fn, *args, n=20):
+    """Median wall-time (ms) of a jitted call, post-warmup."""
+    import jax
+
+    out = fn(*args)
+    jax.block_until_ready(out)
+    times = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        times.append((time.perf_counter() - t0) * 1e3)
+    return float(np.median(times))
+
+
+def _rq_cascade_xla(x, codebooks):
+    """Plain-XLA residual-quantization cascade (reference for the kernel)."""
+    import jax
+    import jax.numpy as jnp
+
+    def layer(resid, cb):
+        d2 = (
+            jnp.sum(resid**2, -1, keepdims=True)
+            - 2.0 * resid @ cb.T
+            + jnp.sum(cb**2, -1)
+        )
+        ids = jnp.argmin(d2, -1)
+        return resid - cb[ids], ids
+
+    def scan_fn(resid, cb):
+        resid, ids = layer(resid, cb)
+        return resid, ids
+
+    resid, ids = jax.lax.scan(scan_fn, x, codebooks)
+    return ids.T, x - resid
+
+
+def run(interpret: bool = False) -> dict:
+    """Validate + time both kernels. Returns a JSON-able result dict."""
+    import jax
+    import jax.numpy as jnp
+
+    from genrec_tpu.kernels.hstu_attention import (
+        hstu_attention_pallas,
+        hstu_attention_xla,
+    )
+    from genrec_tpu.kernels.rq_cascade import rq_cascade_pallas
+
+    backend = jax.default_backend()
+    res: dict = {"backend": backend, "kernels": {}}
+    if backend != "tpu" and not interpret:
+        res["skipped"] = "not on TPU; rerun with --interpret to smoke-test"
+        return res
+
+    rng = np.random.default_rng(0)
+
+    # --- HSTU fused attention (bench-scale shapes: B4 H4 L200 D64;
+    # tiny shapes in interpret mode, where pallas is ~1000x slower) ---
+    try:
+        B, H, L, D = (2, 2, 50, 32) if interpret else (4, 4, 200, 64)
+        q, k, v = (
+            jnp.asarray(rng.normal(size=(B, H, L, D)), jnp.float32)
+            for _ in range(3)
+        )
+        ts = jnp.asarray(
+            np.cumsum(rng.integers(3600, 2e5, (B, L)), 1), jnp.int32
+        )
+        pad = jnp.zeros((B, L), bool)
+        pt = jnp.asarray(rng.normal(size=(H, 32)) * 0.1, jnp.float32)  # (H, pos buckets)
+        tt = jnp.asarray(rng.normal(size=(H, 64)) * 0.1, jnp.float32)  # (H, time buckets)
+        pallas_fn = jax.jit(
+            lambda *a: hstu_attention_pallas(*a, interpret=interpret)
+        )
+        xla_fn = jax.jit(hstu_attention_xla)
+        got = np.asarray(pallas_fn(q, k, v, ts, pad, pt, tt))
+        ref = np.asarray(xla_fn(q, k, v, ts, pad, pt, tt))
+        err = float(np.max(np.abs(got - ref)))
+        entry = {"max_abs_err": err, "ok": bool(err < 2e-3)}
+        if not interpret:
+            entry["pallas_ms"] = _bench(pallas_fn, q, k, v, ts, pad, pt, tt)
+            entry["xla_ms"] = _bench(xla_fn, q, k, v, ts, pad, pt, tt)
+        res["kernels"]["hstu_attention"] = entry
+    except Exception as e:  # noqa: BLE001 - report, don't crash bench
+        res["kernels"]["hstu_attention"] = {"ok": False, "error": repr(e)}
+
+    # --- RQ cascade (rqvae-scale: B2048 D32 L3 K256) ---
+    try:
+        Bq, Dq, Lq, Kq = (128, 16, 3, 20) if interpret else (2048, 32, 3, 256)
+        x = jnp.asarray(rng.normal(size=(Bq, Dq)), jnp.float32)
+        cbs = jnp.asarray(rng.normal(size=(Lq, Kq, Dq)), jnp.float32)
+        pallas_fn = jax.jit(
+            lambda *a: rq_cascade_pallas(*a, blk_b=256, interpret=interpret)
+        )
+        xla_fn = jax.jit(_rq_cascade_xla)
+        ids, qsum = pallas_fn(x, cbs)
+        rids, rqsum = xla_fn(x, cbs)
+        ids_match = bool(np.array_equal(np.asarray(ids), np.asarray(rids)))
+        qerr = float(np.max(np.abs(np.asarray(qsum) - np.asarray(rqsum))))
+        entry = {
+            "ids_match": ids_match,
+            "qsum_max_abs_err": qerr,
+            "ok": bool(ids_match and qerr < 1e-3),
+        }
+        if not interpret:
+            entry["pallas_ms"] = _bench(pallas_fn, x, cbs)
+            entry["xla_ms"] = _bench(xla_fn, x, cbs)
+        res["kernels"]["rq_cascade"] = entry
+    except Exception as e:  # noqa: BLE001
+        res["kernels"]["rq_cascade"] = {"ok": False, "error": repr(e)}
+
+    res["ok"] = all(k.get("ok") for k in res["kernels"].values())
+    return res
+
+
+def main(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser(description="Pallas kernel preflight")
+    ap.add_argument(
+        "--interpret",
+        action="store_true",
+        help="run in interpreter mode (works off-TPU; no timings)",
+    )
+    args = ap.parse_args(argv)
+    if args.interpret:
+        # Interpret mode is a CPU smoke test; do not touch (or hang on)
+        # a TPU backend for it. Must run before first device use.
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    res = run(interpret=args.interpret)
+    print(json.dumps(res))
+    return 0 if res.get("ok") or "skipped" in res else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
