@@ -17,17 +17,28 @@ class SemIdEmbedding(nn.Module):
     sem_ids_dim: int
     embeddings_dim: int
     dtype: jnp.dtype = jnp.float32
+    # Pad the row count up to a multiple (tensor parallelism shards rows on
+    # the "model" mesh axis; the natural count num_emb*dim+1 is odd, so
+    # without padding every even tp degree silently fell back to
+    # replication). Padded rows are never indexed.
+    rows_multiple: int = 1
 
     @property
     def padding_idx(self) -> int:
         return self.num_embeddings * self.sem_ids_dim
+
+    @property
+    def num_rows(self) -> int:
+        rows = self.num_embeddings * self.sem_ids_dim + 1
+        m = max(self.rows_multiple, 1)
+        return -(-rows // m) * m
 
     @nn.compact
     def __call__(self, input_ids, token_type_ids):
         table = self.param(
             "embedding",
             nn.initializers.normal(stddev=1.0),
-            (self.num_embeddings * self.sem_ids_dim + 1, self.embeddings_dim),
+            (self.num_rows, self.embeddings_dim),
         )
         idx = token_type_ids * self.num_embeddings + input_ids
         emb = table[idx].astype(self.dtype)

@@ -52,10 +52,28 @@ class Tiger(nn.Module):
     sem_id_dim: int
     max_pos: int = 2048
     dtype: jnp.dtype = jnp.float32
+    # Round the output-head vocab (and sem-id table rows) up to a multiple
+    # so tensor parallelism can shard them: the natural flat vocab
+    # num_item_embeddings*sem_id_dim + 1 is odd, which at any even tp
+    # degree forced the headline sharding rules into replication fallback.
+    # Padded logit slots are masked to -1e9 so softmax/decode never see
+    # them; padded embedding rows are never indexed.
+    pad_vocab_to: int = 1
 
     @property
     def vocab_size(self) -> int:
         return self.num_item_embeddings * self.sem_id_dim + 1
+
+    @property
+    def padded_vocab_size(self) -> int:
+        m = max(self.pad_vocab_to, 1)
+        return -(-self.vocab_size // m) * m
+
+    def _mask_pad_logits(self, logits):
+        if self.padded_vocab_size == self.vocab_size:
+            return logits
+        live = jnp.arange(self.padded_vocab_size) < self.vocab_size
+        return jnp.where(live, logits, -1e9)
 
     def setup(self):
         normal = nn.initializers.normal(stddev=1.0)
@@ -65,7 +83,8 @@ class Tiger(nn.Module):
         self.drop = nn.Dropout(self.dropout)
         self.sem_id_embedding = SemIdEmbedding(
             self.num_item_embeddings, self.sem_id_dim, self.embedding_dim,
-            dtype=self.dtype, name="sem_id_embedding",
+            dtype=self.dtype, rows_multiple=self.pad_vocab_to,
+            name="sem_id_embedding",
         )
         self.user_id_embedding = UserIdEmbedding(
             self.num_user_embeddings, self.embedding_dim,
@@ -91,7 +110,7 @@ class Tiger(nn.Module):
             dtype=self.dtype,
             name="transformer",
         )
-        self.output_head = dense(self.vocab_size, "output_head")
+        self.output_head = dense(self.padded_vocab_size, "output_head")
 
     # ---- shared pieces -----------------------------------------------------
 
@@ -141,7 +160,7 @@ class Tiger(nn.Module):
             memory_key_padding_mask=pad,
             deterministic=deterministic,
         )
-        logits = self.output_head(out)  # (B, T+1, V)
+        logits = self._mask_pad_logits(self.output_head(out))  # (B, T+1, V)
         loss = None
         if target_input_ids is not None and target_input_ids.shape[1] == self.sem_id_dim:
             target_vocab = target_token_type_ids * self.num_item_embeddings + target_input_ids
@@ -172,7 +191,8 @@ class Tiger(nn.Module):
             memory_key_padding_mask=memory_pad,
             deterministic=True,
         )
-        return self.output_head(out)[:, -1, :].astype(jnp.float32)
+        logits = self._mask_pad_logits(self.output_head(out))
+        return logits[:, -1, :].astype(jnp.float32)
 
 
 def _dedup_top_k(scores, keys, k):
