@@ -30,6 +30,66 @@ def annotate(name: str):
     return jax.profiler.TraceAnnotation(name)
 
 
+class ProfileWindow:
+    """Capture a jax.profiler trace of n_steps training steps, starting
+    after ``start`` steps have completed (default 1: skip the compile
+    step).
+
+    Trainers construct one unconditionally (n_steps=0 or an empty logdir
+    disables) and call ``tick(n_finished)`` after each optimizer step with
+    the RUNNING COUNT of finished steps; ``close()`` stops a still-open
+    trace when the run ends early.
+    """
+
+    def __init__(self, logdir: str, n_steps: int = 0, start: int = 1):
+        self.logdir = logdir
+        self.n_steps = n_steps
+        self.start = start
+        self._state = "idle" if (n_steps > 0 and logdir) else "done"
+
+    def tick(self, n_finished: int) -> None:
+        """Call after each step with the 1-based count of finished steps."""
+        if self._state == "idle" and n_finished >= self.start:
+            jax.profiler.start_trace(self.logdir)
+            self._state = "on"
+        elif self._state == "on" and n_finished >= self.start + self.n_steps:
+            jax.profiler.stop_trace()
+            self._state = "done"
+
+    def close(self) -> None:
+        if self._state == "on":
+            jax.profiler.stop_trace()
+        self._state = "done"
+
+
+def perf_summary(timer: "StepTimer") -> dict:
+    """StepTimer summary extended with the per-chip north-star metric
+    (BASELINE.md: seq/sec/chip)."""
+    s = timer.summary()
+    s["seq_per_sec_per_chip"] = s["seq_per_sec"] / max(jax.device_count(), 1)
+    return s
+
+
+def log_epoch_perf(logger, tracker, epoch, epoch_loss, n_batches, timer) -> float:
+    """Shared epoch-end summary used by every trainer: block once on the
+    chained loss scalar (closing the async-dispatch timing window), log
+    loss + throughput, feed the Tracker. Returns the mean loss."""
+    if epoch_loss is not None:
+        jax.block_until_ready(epoch_loss)
+    perf = perf_summary(timer)
+    mean_loss = float(epoch_loss) / n_batches if n_batches else 0.0
+    logger.info(
+        f"epoch {epoch} loss {mean_loss:.4f} "
+        f"[{perf['seq_per_sec']:.1f} seq/s, "
+        f"{perf['seq_per_sec_per_chip']:.1f} seq/s/chip]"
+    )
+    tracker.log({
+        "epoch": epoch, "train/loss": mean_loss,
+        **{f"perf/{k}": v for k, v in perf.items()},
+    })
+    return mean_loss
+
+
 class StepTimer:
     """Throughput meter that ignores the first (compile) step.
 

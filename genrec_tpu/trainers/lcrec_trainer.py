@@ -23,6 +23,7 @@ import optax
 from genrec_tpu import configlib
 from genrec_tpu.core.harness import make_train_step
 from genrec_tpu.core.logging import Tracker, setup_logger
+from genrec_tpu.core.profiling import ProfileWindow, StepTimer, log_epoch_perf
 from genrec_tpu.core.lora import lora_init, lora_merge, lora_param_count
 from genrec_tpu.core.state import TrainState
 from genrec_tpu.data.batching import batch_iterator
@@ -30,6 +31,7 @@ from genrec_tpu.data.lcrec_tasks import synthetic_lcrec_data
 from genrec_tpu.models.backbones.qwen import QwenConfig, QwenLM
 from genrec_tpu.models.lcrec import (
     extend_vocab,
+    generate_greedy,
     generate_topk_constrained,
     sft_loss,
 )
@@ -49,6 +51,57 @@ def make_generate_fn(model, base_vocab, num_codebooks, codebook_size, beam_width
         return out.sem_ids
 
     return gen
+
+
+def evaluate_item2index(gen_fn, params, arrays, batch_size, mesh, num_codebooks):
+    """Greedy constrained item->index over the item set: exact-match +
+    per-codebook accuracy (reference lcrec_trainer.py:193-213)."""
+    from genrec_tpu.parallel import metric_allreduce
+
+    correct = np.zeros(num_codebooks)
+    exact = 0
+    total = 0
+    for batch, valid in batch_iterator(arrays, batch_size):
+        top = np.asarray(gen_fn(params, shard_batch(mesh, batch)))  # (B, W, C)
+        n = int(valid.sum())
+        pred = top[:n, 0, :]
+        target = batch["target_ids"][:n]
+        correct += (pred == target).sum(axis=0)
+        exact += int((pred == target).all(axis=1).sum())
+        total += n
+    s = metric_allreduce(
+        {"correct": list(correct), "exact": float(exact), "total": float(total)}
+    )
+    out = {"item2index_exact": s["exact"] / max(s["total"], 1)}
+    out.update(
+        {
+            f"item2index_c{c}": s["correct"][c] / max(s["total"], 1)
+            for c in range(num_codebooks)
+        }
+    )
+    return out
+
+
+def evaluate_index2item(free_fn, params, arrays, target_texts, batch_size, mesh, tok):
+    """Unconstrained index->item: generated text must contain the target
+    title (reference lcrec_trainer.py:215-227)."""
+    from genrec_tpu.parallel import metric_allreduce
+
+    match = 0
+    total = 0
+    offset = 0
+    for batch, valid in batch_iterator(arrays, batch_size):
+        toks = np.asarray(free_fn(params, shard_batch(mesh, batch)))  # (B, T)
+        n = int(valid.sum())
+        for i in range(n):
+            tgt = target_texts[offset + i].strip().lower()
+            gen = tok.decode(toks[i]).strip().lower()
+            if tgt and gen and tgt in gen:
+                match += 1
+        total += n
+        offset += n
+    s = metric_allreduce({"match": float(match), "total": float(total)})
+    return {"index2item_match": s["match"] / max(s["total"], 1)}
 
 
 def evaluate(gen_fn, params, arrays, batch_size, mesh, num_codebooks):
@@ -106,6 +159,9 @@ def train(
     dataset_folder="dataset/amazon",
     split="beauty",
     sem_ids_path=None,
+    eval_item_tasks=True,
+    eval_items_limit=256,
+    index2item_max_new=16,
     do_eval=True,
     eval_only=False,
     resume_from_checkpoint=False,
@@ -118,6 +174,7 @@ def train(
     wandb_log_interval=50,
     amp=True,
     mixed_precision_type="bf16",
+    profile_steps=0,
     seed=0,
 ):
     distributed_init()
@@ -146,19 +203,85 @@ def train(
         model0 = QwenLM(cfg, dtype=compute_dtype, remat=gradient_checkpointing)
         params = model0.init(init_rng, jnp.zeros((1, 4), jnp.int32))["params"]
     else:
-        # Checkpoint conversion exists (backbones.qwen.params_from_hf_state_dict
-        # + a local HF AutoModelForCausalLM load), but the data side still
-        # needs the HF tokenizer + sem-id artifact wiring — fail BEFORE
-        # loading a multi-GB checkpoint.
-        raise NotImplementedError(
-            "amazon LCRec needs the HF tokenizer + sem-id artifact wiring "
-            "(data/lcrec_tasks.LCRecTaskData with an HF tokenizer); convert "
-            "the backbone with backbones.qwen.params_from_hf_state_dict "
-            "once a local Qwen checkpoint exists."
+        # Real-data path (reference amazon_lcrec.py:164-676): sequences +
+        # meta text from the Amazon dump, sem ids from the RQ-VAE artifact,
+        # HF tokenizer when pretrained_path provides one (WordTokenizer
+        # fallback otherwise).
+        from genrec_tpu.data.lcrec_tasks import amazon_lcrec_data
+
+        if sem_ids_path is None:
+            raise ValueError("amazon LCRec needs sem_ids_path (RQ-VAE artifact)")
+        hf_tok = None
+        if pretrained_path:
+            from transformers import AutoTokenizer
+
+            hf_tok = AutoTokenizer.from_pretrained(pretrained_path)
+        data, tok = amazon_lcrec_data(
+            dataset_folder, split, sem_ids_path,
+            tokenizer=hf_tok, max_len=max_text_len, seed=seed,
+        )
+        num_codebooks = int(data.sem_ids.shape[1])
+        codebook_size = int(tok.codebook_size)
+        max_pos = max_text_len + max(num_codebooks, index2item_max_new) + 1
+
+        hf_config = os.path.join(pretrained_path or "", "config.json")
+        if pretrained_path and os.path.exists(hf_config):
+            # Full local checkpoint: convert torch weights into the flax
+            # tree (backbones.qwen.params_from_hf_state_dict).
+            import json as _json
+
+            with open(hf_config) as f:
+                hc = _json.load(f)
+            cfg = QwenConfig(
+                vocab_size=hc["vocab_size"],
+                hidden_size=hc["hidden_size"],
+                intermediate_size=hc["intermediate_size"],
+                num_hidden_layers=hc["num_hidden_layers"],
+                num_attention_heads=hc["num_attention_heads"],
+                num_key_value_heads=hc.get(
+                    "num_key_value_heads", hc["num_attention_heads"]
+                ),
+                max_position_embeddings=max(
+                    max_pos, hc.get("max_position_embeddings", max_pos)
+                ),
+                rope_theta=hc.get("rope_theta", 1e6),
+                rms_norm_eps=hc.get("rms_norm_eps", 1e-6),
+                tie_word_embeddings=hc.get("tie_word_embeddings", True),
+            )
+            from transformers import AutoModelForCausalLM
+
+            from genrec_tpu.models.backbones.qwen import params_from_hf_state_dict
+
+            hf_model = AutoModelForCausalLM.from_pretrained(pretrained_path)
+            sd = {k: v.numpy() for k, v in hf_model.state_dict().items()}
+            del hf_model
+            params = params_from_hf_state_dict(sd, cfg)
+            params = jax.tree_util.tree_map(jnp.asarray, params)
+            logger.info(f"loaded HF backbone from {pretrained_path}")
+        else:
+            # Tokenizer-only dir (or none): random-init backbone at the
+            # configured dims, vocab sized to the tokenizer.
+            cfg = QwenConfig(
+                vocab_size=tok.base_vocab, hidden_size=hidden_size,
+                intermediate_size=intermediate_size, num_hidden_layers=n_layers,
+                num_attention_heads=num_heads, num_key_value_heads=num_kv_heads,
+                max_position_embeddings=max_pos,
+                rope_theta=10000.0, tie_word_embeddings=False,
+            )
+        model0 = QwenLM(cfg, dtype=compute_dtype, remat=gradient_checkpointing)
+        params = (
+            params
+            if pretrained_path and os.path.exists(hf_config)
+            else model0.init(init_rng, jnp.zeros((1, 4), jnp.int32))["params"]
         )
 
     # Append codebook special tokens (resize_token_embeddings equivalent).
-    cfg, params, base_vocab = extend_vocab(cfg, params, num_codebooks, codebook_size, vocab_rng)
+    # base = first codebook-token id: the tokenizer's, when it has one (HF
+    # models pad vocab past len(tokenizer), so cfg.vocab_size can differ).
+    cfg, params, base_vocab = extend_vocab(
+        cfg, params, num_codebooks, codebook_size, vocab_rng,
+        base=getattr(tok, "base_vocab", None),
+    )
     # remat mirrors the reference's gradient_checkpointing_enable (lcrec.py:42-46).
     model = QwenLM(cfg, dtype=compute_dtype, remat=gradient_checkpointing)
     logger.info(f"vocab {base_vocab} + {num_codebooks * codebook_size} codebook tokens")
@@ -197,6 +320,25 @@ def train(
         model, base_vocab, num_codebooks, codebook_size, beam_width,
         max_cache=max_text_len + num_codebooks + 1,
     )
+    if eval_item_tasks:
+        # item2index (greedy constrained) + index2item (unconstrained)
+        # evaluation over the item set (reference lcrec_trainer.py:193-227).
+        i2i_arrays = data.item2index_eval_arrays(eval_items_limit)
+        idx2i_arrays, idx2i_texts = data.index2item_eval_arrays(eval_items_limit)
+        greedy_fn = make_generate_fn(
+            model, base_vocab, num_codebooks, codebook_size, 1,
+            max_cache=max_text_len + num_codebooks + 1,
+        )
+        free_fn = jax.jit(
+            lambda p, b: generate_greedy(
+                model, p, b["input_ids"], b["attention_mask"],
+                index2item_max_new, tok.eos_id,
+                max_cache=max_text_len + index2item_max_new,
+                # Keep argmax off live HF vocab-padding rows the tokenizer
+                # cannot decode.
+                valid_vocab=tok.vocab_size,
+            )
+        )
 
     from genrec_tpu.core.checkpoint import BestTracker, CheckpointManager, maybe_resume, save_params
 
@@ -222,18 +364,25 @@ def train(
         return m, m
 
     best = BestTracker(save_dir_root)
+    prof = ProfileWindow(
+        os.path.join(save_dir_root, "profile") if save_dir_root else "",
+        profile_steps,
+    )
     for epoch in range(start_epoch, epochs):
         epoch_loss, n_batches = None, 0
+        timer = StepTimer(batch_size, skip_first=1 if epoch == start_epoch else 0)
         for batch, _ in batch_iterator(
             train_arrays, batch_size, shuffle=True, seed=seed, epoch=epoch, drop_last=True
         ):
             state, m = step_fn(state, shard_batch(mesh, batch))
             epoch_loss = m["loss"] if epoch_loss is None else epoch_loss + m["loss"]
+            timer.tick()
             n_batches += 1
             global_step += 1
+            prof.tick(global_step)
             if global_step % wandb_log_interval == 0:
                 tracker.log({"global_step": global_step, "train/loss": float(m["loss"])})
-        logger.info(f"epoch {epoch} loss {float(epoch_loss) / n_batches if n_batches else 0.0:.4f}")
+        log_epoch_perf(logger, tracker, epoch, epoch_loss, n_batches, timer)
 
         if ckpt is not None and (epoch + 1) % save_every_epoch == 0:
             ckpt.save(epoch, state)
@@ -252,6 +401,19 @@ def train(
     final_params = params_of(final_trainable)
     valid_metrics = evaluate(gen_fn, final_params, valid_arrays, eval_batch_size, mesh, num_codebooks)
     test_metrics = evaluate(gen_fn, final_params, test_arrays, eval_batch_size, mesh, num_codebooks)
+    if eval_item_tasks:
+        test_metrics.update(
+            evaluate_item2index(
+                greedy_fn, final_params, i2i_arrays, eval_batch_size, mesh,
+                num_codebooks,
+            )
+        )
+        test_metrics.update(
+            evaluate_index2item(
+                free_fn, final_params, idx2i_arrays, idx2i_texts,
+                eval_batch_size, mesh, tok,
+            )
+        )
     logger.info("test " + ", ".join(f"{k}={v:.4f}" for k, v in test_metrics.items()))
     tracker.log({f"test/{k}": v for k, v in test_metrics.items()})
     if save_dir_root:
@@ -260,6 +422,7 @@ def train(
         save_params(os.path.join(save_dir_root, "final_model"), final_params)
     if ckpt is not None:
         ckpt.close()
+    prof.close()
     tracker.finish()
     return valid_metrics, test_metrics
 

@@ -27,6 +27,7 @@ import optax
 from genrec_tpu import configlib
 from genrec_tpu.core.harness import make_train_step
 from genrec_tpu.core.logging import Tracker, setup_logger
+from genrec_tpu.core.profiling import ProfileWindow, StepTimer, log_epoch_perf
 from genrec_tpu.core.state import TrainState
 from genrec_tpu.data.batching import batch_iterator, pad_to_batch
 from genrec_tpu.data.items import ItemEmbeddingData, SyntheticItemEmbeddings
@@ -121,6 +122,7 @@ def train(
     wandb_logging=False,
     wandb_project="rqvae_training",
     wandb_log_interval=100,
+    profile_steps=0,
     seed=0,
 ):
     if (epochs is None) == (iterations is None):
@@ -220,14 +222,24 @@ def train(
         )
         if start_epoch:
             logger.info(f"resumed after epoch {start_epoch - 1} (step {global_step})")
+    prof = ProfileWindow(
+        os.path.join(save_dir_root, "profile") if save_dir_root else "",
+        profile_steps,
+    )
     for epoch in range(start_epoch, epochs):
+        epoch_loss, n_batches = None, 0
+        timer = StepTimer(batch_size, skip_first=1 if epoch == start_epoch else 0)
         for batch, _ in batch_iterator(
             {"x": train_x}, batch_size, shuffle=True, seed=seed, epoch=epoch, drop_last=True
         ):
             if global_step >= total_steps:
                 break
             state, m = step_fn(state, shard_batch(mesh, batch))
+            epoch_loss = m["loss"] if epoch_loss is None else epoch_loss + m["loss"]
+            timer.tick()
+            n_batches += 1
             global_step += 1
+            prof.tick(global_step)
             if not use_epochs:
                 # Iteration mode gates eval/save on ITERATIONS (reference
                 # rqvae_trainer.py:393,419), not derived epochs.
@@ -251,6 +263,8 @@ def train(
                         "learning_rate": float(schedule(global_step)),
                     }
                 )
+
+        log_epoch_perf(logger, tracker, epoch, epoch_loss, n_batches, timer)
 
         if use_epochs and do_eval and ((epoch + 1) % eval_every == 0 or epoch + 1 == epochs):
             le = eval_losses(state.params, jnp.asarray(eval_x))
@@ -282,6 +296,7 @@ def train(
     logger.info(f"exported semantic ids -> {out_path}")
     if ckpt is not None:
         ckpt.close()
+    prof.close()
     tracker.finish()
     return state.params, sem_ids
 

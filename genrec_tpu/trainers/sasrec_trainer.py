@@ -20,6 +20,7 @@ import optax
 from genrec_tpu import configlib
 from genrec_tpu.core.harness import make_train_step
 from genrec_tpu.core.logging import Tracker, setup_logger
+from genrec_tpu.core.profiling import ProfileWindow, StepTimer, log_epoch_perf
 from genrec_tpu.core.state import TrainState
 from genrec_tpu.data.batching import batch_iterator
 from genrec_tpu.data.synthetic import SyntheticSeqDataset
@@ -92,6 +93,7 @@ def train(
     wandb_log_interval=100,
     amp=True,
     mixed_precision_type="bf16",
+    profile_steps=0,
     seed=0,
 ):
     """Returns final (valid_metrics, test_metrics) for programmatic use."""
@@ -166,22 +168,29 @@ def train(
         if start_epoch:
             logger.info(f"resumed after epoch {start_epoch - 1} (step {global_step})")
     best = BestTracker(save_dir_root)
+    prof = ProfileWindow(
+        os.path.join(save_dir_root, "profile") if save_dir_root else "",
+        profile_steps,
+    )
     for epoch in range(start_epoch, epochs):
         # Device-scalar accumulation: float() only at logging boundaries so
         # the host never blocks on the jitted step (async dispatch).
         epoch_loss, n_batches = None, 0
+        timer = StepTimer(batch_size, skip_first=1 if epoch == start_epoch else 0)
         for batch, _ in batch_iterator(
             train_arrays, batch_size, shuffle=True, seed=seed, epoch=epoch, drop_last=True
         ):
             state, metrics = step_fn(state, shard_batch(mesh, batch))
             epoch_loss = metrics["loss"] if epoch_loss is None else epoch_loss + metrics["loss"]
+            timer.tick()
             n_batches += 1
             global_step += 1
+            prof.tick(global_step)
             if global_step % wandb_log_interval == 0:
                 tracker.log(
                     {"global_step": global_step, "train/loss": float(metrics["loss"])}
                 )
-        logger.info(f"epoch {epoch} loss {float(epoch_loss) / n_batches if n_batches else 0.0:.4f}")
+        log_epoch_perf(logger, tracker, epoch, epoch_loss, n_batches, timer)
 
         if ckpt_mgr is not None and (epoch + 1) % save_every_epoch == 0:
             ckpt_mgr.save(epoch, state)  # full TrainState: one resumable format everywhere
@@ -206,6 +215,7 @@ def train(
         save_params(os.path.join(save_dir_root, "best_model"), final_params)
     if ckpt_mgr is not None:
         ckpt_mgr.close()
+    prof.close()
     tracker.finish()
     return valid_metrics, test_metrics
 
