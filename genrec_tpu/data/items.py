@@ -84,14 +84,26 @@ def load_item_texts(root: str, split: str) -> list[str]:
 
 def format_item_text(meta: dict) -> str:
     """Item text template — byte-for-byte the reference's layout
-    (amazon.py:198-204): newline-joined, all five keys always present,
-    missing values rendered as empty strings."""
+    (amazon.py:199-205): newline-joined, all five keys always present.
+
+    Subtlety: the reference stages ``{'title': meta.get('title'), ...}``
+    (amazon.py:181-187) and then formats ``info.get('title', '')`` — the
+    key EXISTS with value None, so a missing field renders as the literal
+    string ``None`` (and lists/dicts render via str()), not as ''.
+    Items absent from the meta dump get NO row at all in the reference
+    (it iterates item_info.keys(), silently misaligning embeddings with
+    item ids); we instead keep an all-None row so ids stay aligned —
+    deliberate deviation, same text shape."""
+    info = {
+        k: meta.get(k)
+        for k in ("title", "price", "salesRank", "brand", "categories")
+    }
     return (
-        f"'title':{meta.get('title', '')}\n"
-        f" 'price':{meta.get('price', '')}\n"
-        f" 'salesRank':{meta.get('salesRank', '')}\n"
-        f" 'brand':{meta.get('brand', '')}\n"
-        f" 'categories':{meta.get('categories', '')}"
+        f"'title':{info['title']}\n"
+        f" 'price':{info['price']}\n"
+        f" 'salesRank':{info['salesRank']}\n"
+        f" 'brand':{info['brand']}\n"
+        f" 'categories':{info['categories']}"
     )
 
 

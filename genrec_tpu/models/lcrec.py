@@ -31,28 +31,51 @@ class LCRecGenerationOutput(NamedTuple):
     log_probas: jax.Array  # (B, W)
 
 
-def extend_vocab(cfg: QwenConfig, params, num_codebooks: int, codebook_size: int, key):
+def extend_vocab(
+    cfg: QwenConfig,
+    params,
+    num_codebooks: int,
+    codebook_size: int,
+    key,
+    base: int | None = None,
+):
     """Append num_codebooks*codebook_size codebook tokens to the vocab.
 
     Mirrors `add_codebook_tokens` + `resize_token_embeddings`
     (lcrec.py:48-60): new embedding rows are drawn from the backbone's
-    init distribution; token id of <Cc_k> = base_vocab + c*K + k.
-    Returns (new_cfg, new_params, base_vocab).
+    init distribution; token id of <Cc_k> = base + c*K + k.
+
+    ``base`` defaults to cfg.vocab_size (append at the end). HF
+    checkpoints often PAD the model vocab past len(tokenizer); their
+    added-token ids start at len(tokenizer) < vocab_size, so the caller
+    passes that id as ``base`` — rows in [base, base+n) are (re)initialized
+    in place and the table only grows by what doesn't already fit.
+    Returns (new_cfg, new_params, base).
     """
     import dataclasses
 
     n_new = num_codebooks * codebook_size
-    base = cfg.vocab_size
-    new_cfg = dataclasses.replace(cfg, vocab_size=base + n_new)
+    if base is None:
+        base = cfg.vocab_size
+    if base > cfg.vocab_size:
+        raise ValueError(f"base {base} beyond model vocab {cfg.vocab_size}")
+    need = base + n_new
+    grow = max(0, need - cfg.vocab_size)
+    new_cfg = dataclasses.replace(cfg, vocab_size=max(cfg.vocab_size, need))
     k1, k2 = jax.random.split(key)
     params = dict(params)
-    emb = params["embed_tokens"]
-    new_rows = 0.02 * jax.random.normal(k1, (n_new, emb.shape[1]), emb.dtype)
-    params["embed_tokens"] = jnp.concatenate([emb, new_rows], axis=0)
+
+    def extended(table, k):
+        rows = 0.02 * jax.random.normal(k, (n_new, table.shape[1]), table.dtype)
+        if grow:
+            table = jnp.concatenate(
+                [table, jnp.zeros((grow, table.shape[1]), table.dtype)], axis=0
+            )
+        return jax.lax.dynamic_update_slice(table, rows, (base, 0))
+
+    params["embed_tokens"] = extended(params["embed_tokens"], k1)
     if not cfg.tie_word_embeddings:
-        head = params["lm_head"]
-        new_head = 0.02 * jax.random.normal(k2, (n_new, head.shape[1]), head.dtype)
-        params["lm_head"] = jnp.concatenate([head, new_head], axis=0)
+        params["lm_head"] = extended(params["lm_head"], k2)
     return new_cfg, params, base
 
 
@@ -66,6 +89,69 @@ def sft_loss(model: QwenLM, params, input_ids, attention_mask, labels):
         logits[:, :-1, :], labels[:, 1:], ignore_index=-100
     )
     return per_tok.sum() / jnp.maximum(valid.sum(), 1)
+
+
+def generate_greedy(
+    model: QwenLM,
+    params,
+    input_ids,
+    attention_mask,
+    max_new_tokens: int,
+    eos_id: int,
+    max_cache: int | None = None,
+    valid_vocab: int | None = None,
+):
+    """Unconstrained greedy decode with a KV cache (the reference's
+    index2item eval path: `generate(..., do_sample=False)` without the
+    prefix constraint, lcrec_trainer.py:215-227).
+
+    ``valid_vocab`` masks logits at ids >= it: HF checkpoints pad the
+    MODEL vocab past the tokenizer, and those live padding rows would
+    otherwise be argmax-able ids the tokenizer cannot decode.
+
+    Fully jittable: the decode loop is a lax.scan over max_new_tokens
+    steps; rows that emit EOS keep emitting EOS. Returns (B, max_new)
+    token ids."""
+    B, L = input_ids.shape
+    S = max_cache or (L + max_new_tokens)
+    positions = jnp.maximum(jnp.cumsum(attention_mask, axis=1) - 1, 0)
+
+    caches = model.apply({"params": params}, B, S, method=QwenLM.init_cache)
+    pad = jnp.concatenate(
+        [attention_mask, jnp.zeros((B, S - L), attention_mask.dtype)], axis=1
+    )
+    logits, caches = model.apply(
+        {"params": params}, input_ids, positions, caches, pad,
+        method=QwenLM.decode_step,
+    )
+    next_pos = positions[:, -1] + 1  # (B,)
+
+    vocab_mask = None
+    if valid_vocab is not None:
+        vocab_mask = jnp.arange(logits.shape[-1]) < valid_vocab
+
+    def body(carry, step):
+        logits, caches, pad, done = carry
+        logits = logits.astype(jnp.float32)
+        if vocab_mask is not None:
+            logits = jnp.where(vocab_mask, logits, -jnp.inf)
+        tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        tok = jnp.where(done, eos_id, tok)
+        done = done | (tok == eos_id)
+        slot = jnp.arange(S)[None, :]
+        write_at = caches[0]["idx"].astype(jnp.int32)
+        pad = jnp.where(slot == write_at, 1, pad)
+        logits, caches = model.apply(
+            {"params": params}, tok[:, None], (next_pos + step)[:, None],
+            caches, pad, method=QwenLM.decode_step,
+        )
+        return (logits, caches, pad, done), tok
+
+    done0 = jnp.zeros((B,), bool)
+    _, toks = jax.lax.scan(
+        body, (logits, caches, pad, done0), jnp.arange(max_new_tokens)
+    )
+    return toks.T  # (B, max_new)
 
 
 def generate_topk_constrained(

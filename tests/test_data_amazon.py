@@ -105,6 +105,80 @@ def test_native_parser_matches_python(fake_root):
     )
 
 
+def test_meta_2014_pathologies_and_text_byte_parity(tmp_path):
+    """The 2014 meta dumps mix JSON lines with python-repr lines (single
+    quotes), floats, nested category lists, salesRank dicts, non-ASCII and
+    missing fields. Parsing must survive all of them, and the item text
+    must match the reference's f-string template BYTE-FOR-BYTE (reference
+    amazon.py:181-205: staged dict of meta.get(k) -> None for missing, so
+    absent fields render as the literal 'None')."""
+    root = tmp_path / "amazon"
+    raw = root / "raw" / "beauty"
+    raw.mkdir(parents=True)
+    with gzip.open(raw / "reviews_Beauty_5.json.gz", "wt") as f:
+        for u in ("u0", "u1"):
+            for t in range(5):
+                f.write(json.dumps({
+                    "reviewerID": u, "asin": f"a{t}", "unixReviewTime": t,
+                }) + "\n")
+    metas = [
+        # python-repr line (how the 2014 dumps actually ship), full fields
+        "{'asin': 'a0', 'title': 'Crème brûlée kit — №1', 'price': 12.99, "
+        "'salesRank': {'Beauty': 4231}, 'brand': \"L'Or\\u00e9al\", "
+        "'categories': [['Beauty', 'Skin Care']]}",
+        # JSON line with missing price/brand/salesRank
+        json.dumps({"asin": "a1", "title": "Plain soap",
+                    "categories": [["Beauty"]]}),
+        # all fields absent except asin
+        json.dumps({"asin": "a2"}),
+        # garbage line that must be skipped
+        "not parseable at all {{{",
+        # python-repr with trailing noise fields
+        "{'asin': 'a3', 'title': 'Täglich Öl', 'price': 7.5, "
+        "'brand': '', 'categories': [['Beauty', 'Öle', 'Bio']]}",
+    ]
+    with gzip.open(raw / "meta_Beauty.json.gz", "wt") as f:
+        f.write("\n".join(metas) + "\n")
+
+    from genrec_tpu.data.amazon import load_sequences, parse_gzip_json
+    from genrec_tpu.data.items import load_item_texts
+
+    load_sequences(str(root), "beauty", download=False)
+    texts = load_item_texts(str(root), "beauty")
+    assert len(texts) == 5  # a0..a4 (a4 has no meta at all)
+
+    # Independent re-statement of the reference expression, applied to the
+    # parsed fixture rows.
+    parsed = {
+        r["asin"]: r
+        for r in parse_gzip_json(str(raw / "meta_Beauty.json.gz"))
+        if r.get("asin")
+    }
+    for i, asin in enumerate(["a0", "a1", "a2", "a3"]):
+        info = {k: parsed[asin].get(k)
+                for k in ("title", "price", "salesRank", "brand", "categories")}
+        expected = (
+            f"'title':{info.get('title', '')}\n"
+            f" 'price':{info.get('price', '')}\n"
+            f" 'salesRank':{info.get('salesRank', '')}\n"
+            f" 'brand':{info.get('brand', '')}\n"
+            f" 'categories':{info.get('categories', '')}"
+        )
+        assert texts[i] == expected, asin
+    assert "Crème brûlée" in texts[0] and "{'Beauty': 4231}" in texts[0]
+    assert "'price':None" in texts[1]  # missing field -> literal None
+    assert "Täglich Öl" in texts[3]
+
+    # LCRec meta assembly over the same pathological rows.
+    from genrec_tpu.data.lcrec_tasks import load_lcrec_item_meta
+
+    titles, lc_texts, cats = load_lcrec_item_meta(str(root), "beauty")
+    assert titles[0].startswith("Crème")
+    assert cats[0] == "Beauty, Skin Care"
+    assert lc_texts[2] == "item_2"  # fields absent -> placeholder
+    assert titles[4] == "item_4"  # item with no meta row at all
+
+
 def test_native_parser_adversarial_lines(tmp_path):
     """reviewText containing the literal timestamp key, empty asin, and
     non-object lines must not diverge from the Python path."""

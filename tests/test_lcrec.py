@@ -19,6 +19,8 @@ from genrec_tpu.models.lcrec import (
 )
 
 
+pytestmark = pytest.mark.slow  # heavy: excluded from the fast pass
+
 @pytest.fixture(scope="module")
 def tiny():
     cfg = QwenConfig(
