@@ -13,6 +13,8 @@ from genrec_tpu.models.backbones.qwen import (
     params_from_hf_state_dict,
 )
 
+pytestmark = pytest.mark.slow  # heavy: excluded from the fast pass
+
 GOLDEN = os.path.join(os.path.dirname(__file__), "data", "qwen_golden.npz")
 
 CFG = QwenConfig(

@@ -15,6 +15,8 @@ import os
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # heavy: excluded from the fast pass
+
 
 @pytest.fixture(scope="module")
 def amazon_root(tmp_path_factory):
