@@ -64,9 +64,16 @@ def _rope(x, positions, theta):
 class QwenAttention(nn.Module):
     cfg: QwenConfig
     dtype: jnp.dtype = jnp.float32
+    # Sequence parallelism: when ring_axis is set and this forward is traced
+    # inside a shard_map over that mesh axis, attention runs as ring
+    # attention (parallel/ring_attention.py) — K/V shards rotate via
+    # ppermute, O(L_local^2) score tiles, exact result. Incompatible with
+    # the decode cache (generation is not sequence-sharded).
+    ring_axis: Optional[str] = None
+    ring_size: int = 1
 
     @nn.compact
-    def __call__(self, x, positions, attn_bias, cache=None):
+    def __call__(self, x, positions, attn_bias, cache=None, ring_kv_valid=None):
         cfg = self.cfg
         B, L, _ = x.shape
         H, KV, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
@@ -89,15 +96,23 @@ class QwenAttention(nn.Module):
             k, v = ck, cv
             new_cache = {"k": ck, "v": cv, "idx": idx + L}
 
-        # GQA: repeat kv heads.
-        rep = H // KV
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
+        rep = H // KV  # GQA expansion factor
+        if self.ring_axis is not None and cache is None:
+            from genrec_tpu.parallel.ring_attention import ring_attention
 
-        scores = jnp.einsum("blhd,bshd->bhls", q, k).astype(jnp.float32) * (hd**-0.5)
-        scores = scores + attn_bias  # (B or 1, 1, L, S) additive
-        attn = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
-        out = jnp.einsum("bhls,bshd->blhd", attn, v).reshape(B, L, H * hd)
+            # K/V rotate UNREPEATED (kv_rep expands on the local tile), so
+            # ring ppermute traffic scales with KV heads, not query heads.
+            out = ring_attention(
+                q, k, v, axis_name=self.ring_axis, axis_size=self.ring_size,
+                causal=True, kv_valid=ring_kv_valid, kv_rep=rep,
+            ).reshape(B, L, H * hd)
+        else:
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+            scores = jnp.einsum("blhd,bshd->bhls", q, k).astype(jnp.float32) * (hd**-0.5)
+            scores = scores + attn_bias  # (B or 1, 1, L, S) additive
+            attn = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+            out = jnp.einsum("bhls,bshd->blhd", attn, v).reshape(B, L, H * hd)
         out = nn.Dense(cfg.hidden_size, use_bias=False, dtype=self.dtype, name="o_proj")(out)
         return out, new_cache
 
@@ -119,13 +134,15 @@ class QwenMLP(nn.Module):
 class QwenBlock(nn.Module):
     cfg: QwenConfig
     dtype: jnp.dtype = jnp.float32
+    ring_axis: Optional[str] = None
+    ring_size: int = 1
 
     @nn.compact
-    def __call__(self, x, positions, attn_bias, cache=None):
+    def __call__(self, x, positions, attn_bias, cache=None, ring_kv_valid=None):
         h = RMSNorm(self.cfg.hidden_size, self.cfg.rms_norm_eps, name="input_layernorm")(x)
-        h, new_cache = QwenAttention(self.cfg, self.dtype, name="self_attn")(
-            h.astype(self.dtype), positions, attn_bias, cache
-        )
+        h, new_cache = QwenAttention(
+            self.cfg, self.dtype, self.ring_axis, self.ring_size, name="self_attn"
+        )(h.astype(self.dtype), positions, attn_bias, cache, ring_kv_valid)
         x = x + h
         h = RMSNorm(self.cfg.hidden_size, self.cfg.rms_norm_eps, name="post_attention_layernorm")(x)
         x = x + QwenMLP(self.cfg, self.dtype, name="mlp")(h.astype(self.dtype))
@@ -139,6 +156,11 @@ class QwenLM(nn.Module):
     # FLOPs for HBM, the standard lever for 1.5B-scale training on one
     # chip (reference: gradient_checkpointing_enable, lcrec.py:42-46).
     remat: bool = False
+    # Sequence parallelism: set to a mesh axis name (+ its size) and trace
+    # __call__ inside a shard_map over that axis — attention becomes ring
+    # attention, everything else stays local. See models/lcrec.sp_sft_loss.
+    ring_axis: Optional[str] = None
+    ring_size: int = 1
 
     def setup(self):
         self.embed_tokens = self.param(
@@ -147,7 +169,10 @@ class QwenLM(nn.Module):
         )
         block_cls = nn.remat(QwenBlock, static_argnums=()) if self.remat else QwenBlock
         self.blocks = [
-            block_cls(self.cfg, self.dtype, name=f"layer_{i}")
+            block_cls(
+                self.cfg, self.dtype, self.ring_axis, self.ring_size,
+                name=f"layer_{i}",
+            )
             for i in range(self.cfg.num_hidden_layers)
         ]
         self.norm = RMSNorm(self.cfg.hidden_size, self.cfg.rms_norm_eps, name="norm")
@@ -171,15 +196,28 @@ class QwenLM(nn.Module):
         """
         B, L = input_ids.shape
         if positions is None:
+            # NOTE: inside a shard_map (ring_axis set) this default is the
+            # LOCAL arange — sequence-parallel callers must pass global
+            # positions explicitly (models/lcrec.sp_sft_loss does).
             positions = jnp.broadcast_to(jnp.arange(L), (B, L))
-        causal = jnp.where(jnp.triu(jnp.ones((L, L), bool), k=1), -1e9, 0.0)
-        bias = causal[None, None]
-        if attention_mask is not None:
-            bias = bias + jnp.where(attention_mask[:, None, None, :] == 0, -1e9, 0.0)
+        if self.ring_axis is not None:
+            # Causality + padding are handled inside ring attention (global
+            # positions from the ring indices; kv validity rotates with the
+            # blocks) — no L x L bias is ever materialized.
+            bias = None
+            ring_valid = (
+                None if attention_mask is None else attention_mask.astype(bool)
+            )
+        else:
+            causal = jnp.where(jnp.triu(jnp.ones((L, L), bool), k=1), -1e9, 0.0)
+            bias = causal[None, None]
+            if attention_mask is not None:
+                bias = bias + jnp.where(attention_mask[:, None, None, :] == 0, -1e9, 0.0)
+            ring_valid = None
 
         x = self.embed_tokens[input_ids].astype(self.dtype)
         for block in self.blocks:
-            x, _ = block(x, positions, bias)
+            x, _ = block(x, positions, bias, ring_kv_valid=ring_valid)
         h = self.norm(x).astype(self.dtype)
         logits = self._head(h) if compute_logits else None
         if return_hidden:

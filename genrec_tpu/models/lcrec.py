@@ -91,6 +91,75 @@ def sft_loss(model: QwenLM, params, input_ids, attention_mask, labels):
     return per_tok.sum() / jnp.maximum(valid.sum(), 1)
 
 
+def make_sp_sft_loss(
+    cfg: QwenConfig,
+    mesh,
+    sp_axis: str = "sp",
+    dtype=jnp.float32,
+    remat: bool = False,
+):
+    """Sequence-parallel SFT: the token dim is sharded over ``sp_axis`` and
+    attention runs as ring attention (parallel/ring_attention.py) inside a
+    shard_map — each device holds L/N tokens, K/V shards rotate over ICI,
+    no L x L score matrix ever materializes. This is the long-context
+    training path the reference lacks entirely (SURVEY.md §5.7).
+
+    Labels are pre-shifted on the host (labels[t] <- labels[t+1]) so the
+    next-token alignment never crosses a shard boundary; the masked-CE
+    sum/count reduce with psum over (sp, data).
+
+    Returns (model, loss_fn) where loss_fn(params, batch) -> scalar and
+    batch carries input_ids / attention_mask / labels of shape (B, L) with
+    L divisible by the sp size (and B by the data size).
+    """
+    import functools
+
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from genrec_tpu.ops.losses import cross_entropy_with_ignore
+
+    n = mesh.shape[sp_axis]
+    batch_axis = "data" if "data" in mesh.axis_names else None
+    model = QwenLM(cfg, dtype=dtype, remat=remat, ring_axis=sp_axis, ring_size=n)
+    spec = P(batch_axis, sp_axis)
+    reduce_axes = (sp_axis,) + ((batch_axis,) if batch_axis else ())
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(), spec, spec, spec, spec),
+        out_specs=P(),
+    )
+    def _body(params, input_ids, attention_mask, positions, shifted_labels):
+        logits = model.apply(
+            {"params": params}, input_ids,
+            attention_mask=attention_mask, positions=positions,
+        )
+        per_tok, valid = cross_entropy_with_ignore(
+            logits, shifted_labels, ignore_index=-100
+        )
+        s = jax.lax.psum(jnp.sum(per_tok), reduce_axes)
+        v = jax.lax.psum(jnp.sum(valid), reduce_axes)
+        return s / jnp.maximum(v, 1)
+
+    def loss_fn(params, batch):
+        ids = batch["input_ids"]
+        am = batch["attention_mask"]
+        labels = batch["labels"]
+        B, L = ids.shape
+        if L % n:
+            raise ValueError(f"sequence length {L} not divisible by sp={n}")
+        # Global left-pad-aware positions, computed BEFORE sharding.
+        positions = jnp.maximum(jnp.cumsum(am, axis=1) - 1, 0)
+        shifted = jnp.concatenate(
+            [labels[:, 1:], jnp.full((B, 1), -100, labels.dtype)], axis=1
+        )
+        return _body(params, ids, am, positions, shifted)
+
+    return model, loss_fn
+
+
 def generate_greedy(
     model: QwenLM,
     params,

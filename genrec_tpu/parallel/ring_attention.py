@@ -23,16 +23,25 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
-def _block_attn(q, k, v, q_pos, k_pos, m, l, acc, scale, causal):
+def _block_attn(q, k, v, q_pos, k_pos, m, l, acc, scale, causal, kv_valid, kv_rep):
     """Fold one K/V block into the running (m, l, acc) accumulators.
 
-    q: (B, Lq, H, d); k/v: (B, Lk, H, d); positions: (Lq,), (Lk,).
+    q: (B, Lq, H, d); k/v: (B, Lk, H/kv_rep, d); positions: (Lq,), (Lk,).
+    kv_valid: (B, Lk) bool or None — False keys (padding) never attended.
+    kv_rep > 1 is GQA: K/V ride the ring UNREPEATED (kv-head count only)
+    and are expanded here on the local tile, so ppermute traffic stays
+    proportional to the kv heads.
     m, l: (B, H, Lq); acc: (B, Lq, H, d). All accumulators fp32.
     """
+    if kv_rep > 1:
+        k = jnp.repeat(k, kv_rep, axis=2)
+        v = jnp.repeat(v, kv_rep, axis=2)
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
     if causal:
         mask = k_pos[None, :] > q_pos[:, None]  # (Lq, Lk), True = illegal
         s = jnp.where(mask[None, None], -jnp.inf, s)
+    if kv_valid is not None:
+        s = jnp.where(kv_valid[:, None, None, :], s, -jnp.inf)
     m_new = jnp.maximum(m, s.max(axis=-1))
     # Guard fully-masked rows (m_new = -inf): exp(-inf - -inf) would be NaN.
     m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
@@ -48,12 +57,16 @@ def _block_attn(q, k, v, q_pos, k_pos, m, l, acc, scale, causal):
 
 def ring_attention(
     q, k, v, axis_name: str, axis_size: int, causal: bool = False,
-    scale: float | None = None
+    scale: float | None = None, kv_valid=None, kv_rep: int = 1,
 ):
-    """shard_map body: q/k/v are the LOCAL sequence shards (B, L_local, H, d).
+    """shard_map body: q is the LOCAL sequence shard (B, L_local, H, d);
+    k/v are (B, L_local, H/kv_rep, d) — pass GQA K/V unrepeated with
+    ``kv_rep`` = query-heads/kv-heads so only kv-head-count bytes rotate.
 
     ``axis_size`` is the (static) ring size; the block loop unrolls so the
     final iteration skips its ppermute — n-1 rotations, not n.
+    ``kv_valid`` (B, L_local) marks valid (non-padding) keys; it rotates
+    around the ring with its K/V block.
     """
     B, Lq, H, d = q.shape
     n = axis_size
@@ -67,15 +80,20 @@ def ring_attention(
     l = jnp.zeros((B, H, Lq), jnp.float32)
     acc = jnp.zeros((B, Lq, H, d), jnp.float32)
 
-    k_blk, v_blk = k, v
+    k_blk, v_blk, valid_blk = k, v, kv_valid
     perm = [(i, (i + 1) % n) for i in range(n)]
     for step in range(n):
         src = (my - step) % n  # which shard this block came from
         k_pos = src * Lq + local_pos
-        m, l, acc = _block_attn(q, k_blk, v_blk, q_pos, k_pos, m, l, acc, scale, causal)
+        m, l, acc = _block_attn(
+            q, k_blk, v_blk, q_pos, k_pos, m, l, acc, scale, causal,
+            valid_blk, kv_rep,
+        )
         if step < n - 1:  # the last block's rotation would be discarded
             k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
             v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+            if valid_blk is not None:
+                valid_blk = jax.lax.ppermute(valid_blk, axis_name, perm)
 
     l = jnp.maximum(l, 1e-20)  # fully-masked rows produce zeros, not NaN
     out = acc / l.transpose(0, 2, 1)[..., None]

@@ -145,6 +145,9 @@ def train(
     max_text_len=96,
     use_lora=False,
     gradient_checkpointing=False,
+    # >1: shard the token dim over an "sp" mesh axis and train with ring
+    # attention (long-context path; max_text_len must divide by it).
+    sequence_parallel=1,
     lora_rank=8,
     lora_alpha=16.0,
     lora_targets=("q_proj", "v_proj"),
@@ -180,7 +183,13 @@ def train(
     distributed_init()
     logger = setup_logger(save_dir_root)
     tracker = Tracker(wandb_logging, wandb_project, save_dir=save_dir_root)
-    mesh = get_mesh()
+    if sequence_parallel > 1:
+        from genrec_tpu.parallel import make_mesh
+
+        mesh = make_mesh({"data": -1, "sp": sequence_parallel})
+        logger.info(f"mesh {dict(zip(mesh.axis_names, mesh.devices.shape))}")
+    else:
+        mesh = get_mesh()
     compute_dtype = jnp.bfloat16 if (amp and mixed_precision_type == "bf16") else jnp.float32
 
     rng = jax.random.key(seed)
@@ -296,6 +305,24 @@ def train(
     )
     optimizer = optax.adamw(schedule, weight_decay=weight_decay)
 
+    if sequence_parallel > 1:
+        # Ring-attention loss over the sp-sharded token dim; generation
+        # (KV-cache decode) stays on the plain model — same param tree.
+        from genrec_tpu.models.lcrec import make_sp_sft_loss
+
+        if max_text_len % sequence_parallel:
+            raise ValueError(
+                f"max_text_len {max_text_len} must divide by "
+                f"sequence_parallel {sequence_parallel}"
+            )
+        _, base_loss = make_sp_sft_loss(
+            cfg, mesh, dtype=compute_dtype, remat=gradient_checkpointing
+        )
+    else:
+        base_loss = lambda p, batch: sft_loss(
+            model, p, batch["input_ids"], batch["attention_mask"], batch["labels"]
+        )
+
     if use_lora:
         lora = lora_init(params, jax.random.fold_in(rng, 7), lora_rank, tuple(lora_targets))
         logger.info(f"LoRA: {lora_param_count(lora)} trainable params")
@@ -303,13 +330,13 @@ def train(
 
         def loss_fn(lp, batch, step_rng):
             merged = lora_merge(base_params, lp, lora_alpha, lora_rank)
-            return sft_loss(model, merged, batch["input_ids"], batch["attention_mask"], batch["labels"]), {}
+            return base_loss(merged, batch), {}
 
         trainable = lora
         params_of = lambda tp: lora_merge(base_params, tp, lora_alpha, lora_rank)
     else:
         def loss_fn(p, batch, step_rng):
-            return sft_loss(model, p, batch["input_ids"], batch["attention_mask"], batch["labels"]), {}
+            return base_loss(p, batch), {}
 
         trainable = params
         params_of = lambda tp: tp
