@@ -17,20 +17,32 @@ from typing import Any, Mapping
 
 
 def setup_logger(save_dir: str | None = None, name: str = "genrec_tpu") -> logging.Logger:
+    """Process-wide logger; safe to call once per trainer stage.
+
+    A multi-stage pipeline calls this with a DIFFERENT save_dir per stage
+    (pipelines.py runs rqvae then the generator in one process) — each new
+    save_dir gets its own train.log file handler, while duplicate calls
+    for an already-attached path are no-ops."""
     logger = logging.getLogger(name)
     logger.propagate = False  # avoid duplicate lines via the root logger
-    if logger.handlers:
-        return logger
-    logger.setLevel(logging.INFO)
     fmt = logging.Formatter("%(asctime)s %(levelname)s %(message)s")
-    sh = logging.StreamHandler(sys.stdout)
-    sh.setFormatter(fmt)
-    logger.addHandler(sh)
+    if not logger.handlers:
+        logger.setLevel(logging.INFO)
+        sh = logging.StreamHandler(sys.stdout)
+        sh.setFormatter(fmt)
+        logger.addHandler(sh)
     if save_dir:
         os.makedirs(save_dir, exist_ok=True)
-        fh = logging.FileHandler(os.path.join(save_dir, "train.log"))
-        fh.setFormatter(fmt)
-        logger.addHandler(fh)
+        path = os.path.abspath(os.path.join(save_dir, "train.log"))
+        attached = {
+            getattr(h, "baseFilename", None)
+            for h in logger.handlers
+            if isinstance(h, logging.FileHandler)
+        }
+        if path not in attached:
+            fh = logging.FileHandler(path)
+            fh.setFormatter(fmt)
+            logger.addHandler(fh)
     return logger
 
 

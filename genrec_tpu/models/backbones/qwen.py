@@ -46,6 +46,17 @@ class QwenConfig:
         return self.hidden_size // self.num_attention_heads
 
 
+def causal_pad_bias(L: int, attention_mask=None):
+    """Additive attention bias: causal triu mask plus key-padding mask
+    (-1e9, never -inf: fully-masked rows would NaN-poison gradients).
+    Shared by the dense forward and the pipeline-parallel stage body so
+    the two paths can never drift apart."""
+    bias = jnp.where(jnp.triu(jnp.ones((L, L), bool), k=1), -1e9, 0.0)[None, None]
+    if attention_mask is not None:
+        bias = bias + jnp.where(attention_mask[:, None, None, :] == 0, -1e9, 0.0)
+    return bias
+
+
 def _rope(x, positions, theta):
     """NeoX-style half-rotation RoPE. x: (B, L, H, hd), positions: (B, L)."""
     hd = x.shape[-1]
@@ -209,10 +220,7 @@ class QwenLM(nn.Module):
                 None if attention_mask is None else attention_mask.astype(bool)
             )
         else:
-            causal = jnp.where(jnp.triu(jnp.ones((L, L), bool), k=1), -1e9, 0.0)
-            bias = causal[None, None]
-            if attention_mask is not None:
-                bias = bias + jnp.where(attention_mask[:, None, None, :] == 0, -1e9, 0.0)
+            bias = causal_pad_bias(L, attention_mask)
             ring_valid = None
 
         x = self.embed_tokens[input_ids].astype(self.dtype)

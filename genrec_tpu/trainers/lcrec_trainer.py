@@ -148,6 +148,11 @@ def train(
     # >1: shard the token dim over an "sp" mesh axis and train with ring
     # attention (long-context path; max_text_len must divide by it).
     sequence_parallel=1,
+    # >1: GPipe pipeline parallelism over a "pipe" mesh axis — the block
+    # stack is stage-sharded, activations ppermute between stages
+    # (parallel/pipeline.py). n_layers must divide by it.
+    pipeline_parallel=1,
+    pp_microbatches=None,
     lora_rank=8,
     lora_alpha=16.0,
     lora_targets=("q_proj", "v_proj"),
@@ -183,10 +188,15 @@ def train(
     distributed_init()
     logger = setup_logger(save_dir_root)
     tracker = Tracker(wandb_logging, wandb_project, save_dir=save_dir_root)
-    if sequence_parallel > 1:
+    if sequence_parallel > 1 and pipeline_parallel > 1:
+        raise ValueError("combine sequence_parallel with pipeline_parallel "
+                         "is not supported yet; pick one")
+    if sequence_parallel > 1 or pipeline_parallel > 1:
         from genrec_tpu.parallel import make_mesh
 
-        mesh = make_mesh({"data": -1, "sp": sequence_parallel})
+        axis = ("sp", sequence_parallel) if sequence_parallel > 1 else (
+            "pipe", pipeline_parallel)
+        mesh = make_mesh({"data": -1, axis[0]: axis[1]})
         logger.info(f"mesh {dict(zip(mesh.axis_names, mesh.devices.shape))}")
     else:
         mesh = get_mesh()
@@ -317,6 +327,13 @@ def train(
             )
         _, base_loss = make_sp_sft_loss(
             cfg, mesh, dtype=compute_dtype, remat=gradient_checkpointing
+        )
+    elif pipeline_parallel > 1:
+        from genrec_tpu.parallel.pipeline import make_pp_sft_loss
+
+        base_loss = make_pp_sft_loss(
+            cfg, mesh, n_micro=pp_microbatches, dtype=compute_dtype,
+            remat=gradient_checkpointing,
         )
     else:
         base_loss = lambda p, batch: sft_loss(
