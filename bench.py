@@ -28,6 +28,22 @@ import time
 
 A100_REF_SEQ_PER_SEC = 25.0 * 256  # steps/s * batch -> seq/s (estimate)
 
+# Single source of truth for the benchmarked architecture/shapes — the
+# torch-reference measurement (scripts/bench_torch_ref.py) imports these
+# so the same-host comparison can never drift out of shape.
+TIGER_BENCH_ARCH = dict(
+    embedding_dim=128, attn_dim=384, dropout=0.1, num_heads=6, n_layers=8,
+    num_item_embeddings=256, num_user_embeddings=10_000, sem_id_dim=3,
+)
+BENCH_ITEMS = 20
+CPU_BATCH, TPU_BATCH = 32, 256
+
+
+def host_fingerprint() -> str:
+    import platform
+
+    return f"{platform.node()}/cpus={os.cpu_count()}"
+
 
 def _measure(platform: str) -> None:
     """Child: run the TIGER train-step benchmark (and, on TPU, the Pallas
@@ -55,15 +71,15 @@ def _measure(platform: str) -> None:
     from genrec_tpu.models.tiger import Tiger
 
     # Reference TIGER architecture (config/tiger/amazon/tiger.gin). The CPU
-    # fallback shrinks batch so one core finishes inside the timeout;
-    # seq/sec stays an honest per-chip number either way.
-    B = 256 if backend == "tpu" else 32
-    items, D = 20, 3
+    # fallback shrinks batch so one core finishes inside the timeout, and
+    # runs fp32 (bf16 is emulated on CPU; fp32 is also what the torch
+    # reference runs there, so the same-host ratio stays fair).
+    B = TPU_BATCH if backend == "tpu" else CPU_BATCH
+    items, D = BENCH_ITEMS, TIGER_BENCH_ARCH["sem_id_dim"]
     L = items * D
     model = Tiger(
-        embedding_dim=128, attn_dim=384, dropout=0.1, num_heads=6, n_layers=8,
-        num_item_embeddings=256, num_user_embeddings=10_000, sem_id_dim=D,
-        dtype=jnp.bfloat16,
+        **TIGER_BENCH_ARCH,
+        dtype=jnp.bfloat16 if backend == "tpu" else jnp.float32,
     )
     rng = np.random.default_rng(0)
     batch = dict(
@@ -199,6 +215,27 @@ def main():
         )
         if "kernel_preflight" in result:
             line["kernel_preflight"] = result["kernel_preflight"]
+        # MEASURED baseline: scripts/bench_torch_ref.py times the torch
+        # reference on this host's CPU and writes BASELINE_MEASURED.json.
+        # Guarded end-to-end: a corrupt artifact must never break the
+        # always-print-one-line contract.
+        try:
+            measured = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "BASELINE_MEASURED.json",
+            )
+            with open(measured) as f:
+                ref = json.load(f)
+            if ref.get("torch_cpu_seq_per_sec"):
+                same_host = ref.get("host") == host_fingerprint()
+                key = (
+                    ("vs_torch_cpu_same_host" if same_host else "vs_torch_cpu_other_host")
+                    if line.get("backend") == "cpu"
+                    else "tpu_vs_torch_cpu"
+                )
+                line[key] = round(value / ref["torch_cpu_seq_per_sec"], 3)
+        except (OSError, ValueError):
+            pass
     if error:
         line["error"] = error
     print(json.dumps(line))
