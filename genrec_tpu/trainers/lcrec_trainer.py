@@ -153,6 +153,10 @@ def train(
     # (parallel/pipeline.py). n_layers must divide by it.
     pipeline_parallel=1,
     pp_microbatches=None,
+    # >1: Megatron-style tensor parallelism over a "model" mesh axis
+    # (parallel/shardings.qwen_rules: column q/k/v/gate/up, row o/down,
+    # vocab-sharded embedding/head where divisible).
+    tensor_parallel=1,
     lora_rank=8,
     lora_alpha=16.0,
     lora_targets=("q_proj", "v_proj"),
@@ -188,14 +192,19 @@ def train(
     distributed_init()
     logger = setup_logger(save_dir_root)
     tracker = Tracker(wandb_logging, wandb_project, save_dir=save_dir_root)
-    if sequence_parallel > 1 and pipeline_parallel > 1:
-        raise ValueError("combine sequence_parallel with pipeline_parallel "
-                         "is not supported yet; pick one")
-    if sequence_parallel > 1 or pipeline_parallel > 1:
+    chosen = [n for n in (sequence_parallel, pipeline_parallel, tensor_parallel)
+              if n > 1]
+    if len(chosen) > 1:
+        raise ValueError("pick ONE of sequence_parallel / pipeline_parallel / "
+                         "tensor_parallel per run (composition not wired yet)")
+    if chosen:
         from genrec_tpu.parallel import make_mesh
 
-        axis = ("sp", sequence_parallel) if sequence_parallel > 1 else (
-            "pipe", pipeline_parallel)
+        axis = (
+            ("sp", sequence_parallel) if sequence_parallel > 1
+            else ("pipe", pipeline_parallel) if pipeline_parallel > 1
+            else ("model", tensor_parallel)
+        )
         mesh = make_mesh({"data": -1, axis[0]: axis[1]})
         logger.info(f"mesh {dict(zip(mesh.axis_names, mesh.devices.shape))}")
     else:
@@ -359,7 +368,16 @@ def train(
         params_of = lambda tp: tp
 
     step_fn = jax.jit(make_train_step(loss_fn, optimizer, clip_norm=1.0), donate_argnums=0)
-    state = replicate(mesh, TrainState.create(trainable, optimizer, state_rng))
+    if tensor_parallel > 1 and not use_lora:
+        # Megatron-style placement; opt state mirrors the param paths so
+        # the substring rules place it identically. (LoRA keeps replication:
+        # the merged tree is rebuilt per step.)
+        from genrec_tpu.parallel.shardings import qwen_rules, shard_params
+
+        place_state = lambda s: shard_params(mesh, s, qwen_rules(), log_fn=logger.info)
+    else:
+        place_state = lambda s: replicate(mesh, s)
+    state = place_state(TrainState.create(trainable, optimizer, state_rng))
     gen_fn = make_generate_fn(
         model, base_vocab, num_codebooks, codebook_size, beam_width,
         max_cache=max_text_len + num_codebooks + 1,
@@ -394,7 +412,7 @@ def train(
     start_epoch, global_step = 0, 0
     if eval_only or resume_from_checkpoint:
         state, start_epoch, global_step = maybe_resume(
-            ckpt, state, lambda s: replicate(mesh, s)
+            ckpt, state, place_state  # restored runs keep the TP layout
         )
         if start_epoch:
             logger.info(f"resumed after epoch {start_epoch - 1} (step {global_step})")

@@ -115,3 +115,40 @@ def test_tp2_matches_replicated():
     sb = shard_batch(mesh, b)
     loss_tp = float(jax.jit(lambda p, bb: _forward(m, p, bb).loss)(sp, sb))
     assert loss_plain == pytest.approx(loss_tp, abs=1e-5)
+
+
+def test_qwen_tp2_matches_replicated():
+    """Megatron rules (parallel/shardings.qwen_rules) on the Qwen backbone:
+    TP-sharded SFT loss equals the replicated one, and the attention/MLP
+    kernels plus the (even) vocab tables all shard at tp=2."""
+    from genrec_tpu.models.backbones.qwen import QwenConfig, QwenLM
+    from genrec_tpu.models.lcrec import sft_loss
+    from genrec_tpu.parallel.shardings import qwen_rules
+
+    cfg = QwenConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=2, num_key_value_heads=2,
+        max_position_embeddings=32, rope_theta=10000.0,
+        tie_word_embeddings=False,
+    )
+    model = QwenLM(cfg)
+    rng = np.random.default_rng(7)
+    B, L = 8, 16
+    ids = jnp.asarray(rng.integers(0, 64, (B, L)), jnp.int32)
+    am = jnp.ones((B, L), jnp.int32)
+    labels = jnp.asarray(rng.integers(0, 64, (B, L)), jnp.int32)
+    params = model.init(jax.random.key(0), jnp.zeros((1, 4), jnp.int32))["params"]
+
+    plain = float(sft_loss(model, params, ids, am, labels))
+
+    mesh = make_mesh({"data": len(jax.devices()) // 2, "model": 2})
+    fallbacks = []
+    sp = shard_params(mesh, params, qwen_rules(), log_fn=fallbacks.append)
+    assert not fallbacks, fallbacks
+    from genrec_tpu.parallel import shard_batch
+
+    b = shard_batch(mesh, {"ids": ids, "am": am, "labels": labels})
+    tp = float(jax.jit(
+        lambda p, bb: sft_loss(model, p, bb["ids"], bb["am"], bb["labels"])
+    )(sp, b))
+    assert plain == pytest.approx(tp, abs=1e-5)
