@@ -1,0 +1,139 @@
+"""Offline exercise of the pretrained-text-encoder preprocessing stage.
+
+Zero egress: we construct tiny HF-format checkpoints locally (BertModel +
+WordPiece tokenizer; a sentence-transformers pipeline of
+Transformer->Pooling->Dense->Normalize) and run the real wrappers against
+them, so the code paths the reference drives with sentence-t5-xl /
+ernie / bge weights (encoder.py:108-377) are executed end to end —
+tokenize, encode, pool, project, normalize, cache.
+"""
+
+import gzip
+import json
+import os
+
+import numpy as np
+import pytest
+
+pytest.importorskip("torch")
+pytest.importorskip("transformers")
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture(scope="module")
+def tiny_hf_dir(tmp_path_factory):
+    """A tiny BERT encoder + WordPiece tokenizer saved in HF format."""
+    from transformers import BertConfig, BertModel, BertTokenizerFast
+
+    d = str(tmp_path_factory.mktemp("tiny_bert"))
+    vocab = [
+        "[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]",
+        "the", "a", "cat", "dog", "price", "title", "beauty", "'", ":",
+        "##s", "##ing",
+    ]
+    with open(os.path.join(d, "vocab.txt"), "w") as f:
+        f.write("\n".join(vocab))
+    tok = BertTokenizerFast(vocab_file=os.path.join(d, "vocab.txt"))
+    import torch
+
+    torch.manual_seed(0)
+    cfg = BertConfig(
+        vocab_size=len(vocab), hidden_size=16, num_hidden_layers=1,
+        num_attention_heads=2, intermediate_size=32,
+        max_position_embeddings=64,
+    )
+    BertModel(cfg).save_pretrained(d)
+    tok.save_pretrained(d)
+    return d
+
+
+@pytest.fixture(scope="module")
+def tiny_st_dir(tmp_path_factory, tiny_hf_dir):
+    """A sentence-transformers pipeline dir: the same 4-module layout as
+    sentence-t5 (Transformer -> mean Pooling -> Dense -> Normalize)."""
+    st_models = pytest.importorskip("sentence_transformers.models")
+    from sentence_transformers import SentenceTransformer
+
+    t = st_models.Transformer(tiny_hf_dir, max_seq_length=32)
+    p = st_models.Pooling(16, pooling_mode="mean")
+    dense = st_models.Dense(16, 8)
+    norm = st_models.Normalize()
+    d = str(tmp_path_factory.mktemp("tiny_st"))
+    SentenceTransformer(modules=[t, p, dense, norm]).save(d)
+    return d
+
+
+def test_hf_meanpool_encoder(tiny_hf_dir):
+    """ErnieEncoder/BgeEncoder path: mean-pool over the attention mask,
+    L2-normalized, deterministic, batch-size independent."""
+    from genrec_tpu.data.text_encoders import ErnieEncoder
+
+    enc = ErnieEncoder(model_name=tiny_hf_dir)
+    texts = ["the cat", "a dog", "title price beauty", "the the the cats"]
+    e1 = enc.encode(texts, batch_size=2)
+    assert e1.shape == (4, 16) and e1.dtype == np.float32
+    np.testing.assert_allclose(np.linalg.norm(e1, axis=-1), 1.0, rtol=1e-5)
+    # Padding within a batch must not change a row's embedding.
+    e2 = enc.encode(texts, batch_size=1)
+    np.testing.assert_allclose(e1, e2, atol=1e-5)
+
+
+def test_hf_encoder_unnormalized(tiny_hf_dir):
+    from genrec_tpu.data.text_encoders import BgeEncoder
+
+    enc = BgeEncoder(model_name=tiny_hf_dir, normalize=False)
+    e = enc.encode(["the cat sat"], batch_size=8)
+    assert e.shape == (1, 16)
+    assert abs(np.linalg.norm(e[0]) - 1.0) > 1e-4  # genuinely unnormalized
+
+
+def test_sentence_t5_encoder_pipeline(tiny_st_dir):
+    """SentenceT5Encoder must run the FULL st pipeline: output dim is the
+    Dense projection's (8), not the transformer's (16) — the exact property
+    that makes raw-T5 pooling wrong for parity (items.py:123-127)."""
+    from genrec_tpu.data.text_encoders import SentenceT5Encoder
+
+    enc = SentenceT5Encoder(model_name=tiny_st_dir)
+    e = enc.encode(["the cat", "a dog"], batch_size=2)
+    assert e.shape == (2, 8) and e.dtype == np.float32
+    np.testing.assert_allclose(np.linalg.norm(e, axis=-1), 1.0, rtol=1e-5)
+
+
+def test_encode_item_texts_end_to_end(tmp_path, tiny_st_dir):
+    """Raw gz dump -> formatted item text -> ST encode -> cached .npy ->
+    ItemEmbeddingData: the complete preprocessing contract of
+    amazon.py:84-239, on a locally built model."""
+    root = tmp_path / "amazon"
+    raw = root / "raw" / "beauty"
+    raw.mkdir(parents=True)
+    rows = []
+    for u in range(3):
+        for t in range(5):
+            rows.append(
+                {"reviewerID": f"u{u}", "asin": f"a{(u + t) % 4}",
+                 "unixReviewTime": 1000 + t}
+            )
+    with gzip.open(raw / "reviews_Beauty_5.json.gz", "wt") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    metas = [
+        {"asin": f"a{i}", "title": f"the cat {i}", "price": 1.5 + i,
+         "brand": "dog", "categories": [["beauty"]]}
+        for i in range(4)
+    ]
+    with gzip.open(raw / "meta_Beauty.json.gz", "wt") as f:
+        for m in metas:
+            f.write(json.dumps(m) + "\n")
+
+    from genrec_tpu.data.items import ItemEmbeddingData, encode_item_texts
+
+    out = encode_item_texts(str(root), "beauty", model_name=tiny_st_dir)
+    emb = np.load(out)
+    from genrec_tpu.data.amazon import load_item_asins
+
+    assert emb.shape == (len(load_item_asins(str(root), "beauty")), 8)
+    np.testing.assert_allclose(np.linalg.norm(emb, axis=-1), 1.0, rtol=1e-5)
+    data = ItemEmbeddingData(str(root), "beauty")
+    tr, ev = data.arrays()
+    assert len(tr) + len(ev) == len(emb)
