@@ -53,7 +53,7 @@ def _time_bucket_f(diff, num_buckets):
 
 
 def _kernel(
-    q_ref, k_ref, v_ref, ts_ref, mask_ref, ptab_ref, ttab_ref, out_ref,
+    q_ref, k_ref, v_ref, ts_ref, tsq_ref, mask_ref, ptab_ref, ttab_ref, out_ref,
     *, blk_q: int, num_pos_buckets: int, num_time_buckets: int,
     max_position_distance: int, use_time: bool,
 ):
@@ -72,20 +72,22 @@ def _kernel(
     pbucket = _pos_bucket_f(k_pos - q_pos, num_pos_buckets, max_position_distance)
     pbias = jnp.zeros_like(scores)
     for b in range(num_pos_buckets):
-        pbias = pbias + jnp.where(pbucket == b, ptab_ref[0, b], 0.0)
+        pbias = pbias + jnp.where(pbucket == b, ptab_ref[0, 0, b], 0.0)
     scores = scores + pbias
 
     if use_time:
-        ts = ts_ref[...]  # (1, L) int32
-        t_q = jax.lax.dynamic_slice(ts, (0, j * blk_q), (1, blk_q))  # (1, blk_q)
+        ts = ts_ref[0]  # (1, L) int32
+        # The q-tile timestamps arrive as their own blocked operand —
+        # dynamic_slice on a ref is not lowerable in Mosaic TC kernels.
+        t_q = tsq_ref[0]  # (1, blk_q)
         tdiff = t_q.T - ts[0][None, :]  # (blk_q, L)
         tbucket = _time_bucket_f(tdiff, num_time_buckets)
         tbias = jnp.zeros_like(scores)
         for b in range(num_time_buckets):
-            tbias = tbias + jnp.where(tbucket == b, ttab_ref[0, b], 0.0)
+            tbias = tbias + jnp.where(tbucket == b, ttab_ref[0, 0, b], 0.0)
         scores = scores + tbias
 
-    causal_or_pad = jnp.logical_or(k_pos > q_pos, mask_ref[0][None, :] != 0)
+    causal_or_pad = jnp.logical_or(k_pos > q_pos, mask_ref[0, 0][None, :] != 0)
     scores = jnp.where(causal_or_pad, NEG, scores)
     attn = scores * jax.nn.sigmoid(scores)  # silu
     out_ref[0] = jnp.dot(
@@ -155,14 +157,21 @@ def hstu_attention_pallas(
             pl.BlockSpec((1, blk_q, hp), lambda i, j: (i, j, 0)),  # q block
             pl.BlockSpec((1, Lp, hp), lambda i, j: (i, 0, 0)),  # full k
             pl.BlockSpec((1, Lp, hp), lambda i, j: (i, 0, 0)),  # full v
-            pl.BlockSpec((1, Lp), lambda i, j: (i // H, 0)),  # timestamps (per batch)
-            pl.BlockSpec((1, Lp), lambda i, j: (i // H, 0)),  # padding mask
-            pl.BlockSpec((1, pos_table.shape[1]), lambda i, j: (i % H, 0)),
-            pl.BlockSpec((1, time_table.shape[1]), lambda i, j: (i % H, 0)),
+            # Small per-batch/per-head operands carry a leading select dim:
+            # Mosaic requires the last two BLOCK dims to be (8,128)-aligned
+            # or equal to the full array dims, and leading dims are free —
+            # a (1, Lp) block over (B, Lp) is illegal when B != 1 (the
+            # round-1 compiled-path failure).
+            pl.BlockSpec((1, 1, Lp), lambda i, j: (i // H, 0, 0)),  # timestamps (keys)
+            pl.BlockSpec((1, 1, blk_q), lambda i, j: (i // H, 0, j)),  # ts q-tile
+            pl.BlockSpec((1, 1, Lp), lambda i, j: (i // H, 0, 0)),  # padding mask
+            pl.BlockSpec((1, 1, pos_table.shape[1]), lambda i, j: (i % H, 0, 0)),
+            pl.BlockSpec((1, 1, time_table.shape[1]), lambda i, j: (i % H, 0, 0)),
         ],
         out_specs=pl.BlockSpec((1, blk_q, hp), lambda i, j: (i, j, 0)),
         interpret=interpret,
-    )(qf, kf, vf, tsf, maskf, pos_table, time_table)
+    )(qf, kf, vf, tsf[:, None], tsf[:, None], maskf[:, None],
+      pos_table[:, None], time_table[:, None])
     return out.reshape(B, H, Lp, hp)[:, :, :L, :hd]
 
 

@@ -23,17 +23,22 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 
 def _kernel(x_ref, cb_ref, ids_ref, qsum_ref, *, n_layers: int, K: int):
-    x = x_ref[0].astype(jnp.float32)  # (blk_b, D)
+    x = x_ref[...].astype(jnp.float32)  # (blk_b, D)
     res = x
     qsum = jnp.zeros_like(x)
     for l in range(n_layers):
         cb = cb_ref[l].astype(jnp.float32)  # (Kp, D)
         c2 = jnp.sum(cb * cb, axis=1)  # (Kp,)
+        # HIGHEST: the MXU's default single-pass bf16 rounds distances
+        # enough to flip near-tie argmins, and one flipped id at level 0
+        # cascades through every later level (seen on v5e).
         dist = c2[None, :] - 2.0 * jnp.dot(
-            res, cb.T, preferred_element_type=jnp.float32
+            res, cb.T, preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
         )  # (blk_b, Kp)
         # Padded codeword columns (>= K) can never win the argmin.
         col = jax.lax.broadcasted_iota(jnp.int32, dist.shape, 1)
@@ -42,11 +47,14 @@ def _kernel(x_ref, cb_ref, ids_ref, qsum_ref, *, n_layers: int, K: int):
         onehot = (
             jax.lax.broadcasted_iota(jnp.int32, dist.shape, 1) == ids[:, None]
         ).astype(jnp.float32)
-        chosen = jnp.dot(onehot, cb, preferred_element_type=jnp.float32)
+        chosen = jnp.dot(
+            onehot, cb, preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
         res = res - chosen
         qsum = qsum + chosen
-        ids_ref[0, :, l] = ids.astype(jnp.int32)
-    qsum_ref[0] = qsum.astype(qsum_ref.dtype)
+        ids_ref[l, :] = ids.astype(jnp.int32)
+    qsum_ref[...] = qsum.astype(qsum_ref.dtype)
 
 
 def _round_up(x, m):
@@ -73,24 +81,33 @@ def rq_cascade_pallas(
     cbf = jnp.pad(codebooks, ((0, 0), (0, Kp - K), (0, Dp - D)))
 
     kernel = functools.partial(_kernel, n_layers=L, K=K)
+    # ids come out as (L, B): with B on the lane dim the int32 output tiles
+    # cleanly, whereas (B, L) pads the L=3 lane to 128 and (together with
+    # 3-D blocked outputs) blew the 16MB scoped-vmem stack limit on v5e —
+    # the round-1 compiled-path failure.
     ids, qsum = pl.pallas_call(
         kernel,
         out_shape=(
-            jax.ShapeDtypeStruct((Bp // blk_b, blk_b, L), jnp.int32),
-            jax.ShapeDtypeStruct((Bp // blk_b, blk_b, Dp), x.dtype),
+            jax.ShapeDtypeStruct((L, Bp), jnp.int32),
+            jax.ShapeDtypeStruct((Bp, Dp), x.dtype),
         ),
         grid=(Bp // blk_b,),
         in_specs=[
-            pl.BlockSpec((1, blk_b, Dp), lambda i: (i, 0, 0)),
+            pl.BlockSpec((blk_b, Dp), lambda i: (i, 0)),
             pl.BlockSpec((L, Kp, Dp), lambda i: (0, 0, 0)),
         ],
         out_specs=(
-            pl.BlockSpec((1, blk_b, L), lambda i: (i, 0, 0)),
-            pl.BlockSpec((1, blk_b, Dp), lambda i: (i, 0, 0)),
+            pl.BlockSpec((L, blk_b), lambda i: (0, i)),
+            pl.BlockSpec((blk_b, Dp), lambda i: (i, 0)),
         ),
+        # The unrolled cascade keeps ~(5 + 4*L) live (blk_b, Kp) fp32
+        # temporaries; Mosaic's conservative liveness puts that at ~32MB
+        # for blk_b=256/L=3 — over the 16MB default scoped-vmem stack.
+        # v5e has 128MB VMEM; 64MB headroom measured OK on hardware.
+        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=64 * 2**20),
         interpret=interpret,
-    )(xf.reshape(Bp // blk_b, blk_b, Dp), cbf)
+    )(xf, cbf)
     return (
-        ids.reshape(Bp, L)[:B],
-        qsum.reshape(Bp, Dp)[:B, :D],
+        ids.T[:B],
+        qsum[:B, :D],
     )

@@ -171,14 +171,18 @@ class Quantize(nn.Module):
 
     def __call__(self, x, temperature: float, training: bool = False) -> QuantizeOutput:
         codebook = self.effective_codebook()
+        # HIGHEST: id assignment must be bit-stable — the TPU MXU's default
+        # single-pass bf16 rounding flips near-tie argmins, which would make
+        # sem-ids differ between runs/paths (kernels/rq_cascade.py matches).
+        hi = jax.lax.Precision.HIGHEST
         if self.distance_mode == QuantizeDistance.L2:
             dist = (
                 jnp.sum(x**2, axis=1, keepdims=True)
                 + jnp.sum(codebook**2, axis=1)[None, :]
-                - 2.0 * x @ codebook.T
+                - 2.0 * jnp.matmul(x, codebook.T, precision=hi)
             )
         else:
-            dist = -(l2norm(x) @ l2norm(codebook).T)
+            dist = -jnp.matmul(l2norm(x), l2norm(codebook).T, precision=hi)
         ids = jnp.argmin(jax.lax.stop_gradient(dist), axis=1)
 
         if not training:
