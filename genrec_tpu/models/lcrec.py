@@ -38,6 +38,7 @@ def extend_vocab(
     codebook_size: int,
     key,
     base: int | None = None,
+    pad_to: int = 1,
 ):
     """Append num_codebooks*codebook_size codebook tokens to the vocab.
 
@@ -50,7 +51,11 @@ def extend_vocab(
     added-token ids start at len(tokenizer) < vocab_size, so the caller
     passes that id as ``base`` — rows in [base, base+n) are (re)initialized
     in place and the table only grows by what doesn't already fit.
-    Returns (new_cfg, new_params, base).
+
+    ``pad_to`` rounds the final vocab up to a multiple (tensor-parallel
+    degree), so the embedding/lm_head rows stay shardable; the zero pad
+    rows are never tokenizer-reachable and generation masks them via
+    ``valid_vocab``. Returns (new_cfg, new_params, base).
     """
     import dataclasses
 
@@ -60,8 +65,10 @@ def extend_vocab(
     if base > cfg.vocab_size:
         raise ValueError(f"base {base} beyond model vocab {cfg.vocab_size}")
     need = base + n_new
-    grow = max(0, need - cfg.vocab_size)
-    new_cfg = dataclasses.replace(cfg, vocab_size=max(cfg.vocab_size, need))
+    total = max(cfg.vocab_size, need)
+    total = -(-total // pad_to) * pad_to
+    grow = max(0, total - cfg.vocab_size)
+    new_cfg = dataclasses.replace(cfg, vocab_size=total)
     k1, k2 = jax.random.split(key)
     params = dict(params)
 
@@ -79,12 +86,15 @@ def extend_vocab(
     return new_cfg, params, base
 
 
-def sft_loss(model: QwenLM, params, input_ids, attention_mask, labels):
+def sft_loss(model: QwenLM, params, input_ids, attention_mask, labels,
+             valid_vocab: int | None = None):
     """Causal-LM CE with -100-masked labels (HF convention: logits at t
-    predict labels at t+1; reference lcrec_trainer.py uses model(labels=...))."""
-    from genrec_tpu.ops.losses import cross_entropy_with_ignore
+    predict labels at t+1; reference lcrec_trainer.py uses model(labels=...)).
+    ``valid_vocab`` masks vocab pad rows out of the softmax (TP padding)."""
+    from genrec_tpu.ops.losses import cross_entropy_with_ignore, mask_vocab_logits
 
     logits = model.apply({"params": params}, input_ids, attention_mask=attention_mask)
+    logits = mask_vocab_logits(logits, valid_vocab)
     per_tok, valid = cross_entropy_with_ignore(
         logits[:, :-1, :], labels[:, 1:], ignore_index=-100
     )
@@ -97,6 +107,7 @@ def make_sp_sft_loss(
     sp_axis: str = "sp",
     dtype=jnp.float32,
     remat: bool = False,
+    valid_vocab: int | None = None,
 ):
     """Sequence-parallel SFT: the token dim is sharded over ``sp_axis`` and
     attention runs as ring attention (parallel/ring_attention.py) inside a
@@ -132,10 +143,13 @@ def make_sp_sft_loss(
         out_specs=P(),
     )
     def _body(params, input_ids, attention_mask, positions, shifted_labels):
+        from genrec_tpu.ops.losses import mask_vocab_logits
+
         logits = model.apply(
             {"params": params}, input_ids,
             attention_mask=attention_mask, positions=positions,
         )
+        logits = mask_vocab_logits(logits, valid_vocab)
         per_tok, valid = cross_entropy_with_ignore(
             logits, shifted_labels, ignore_index=-100
         )

@@ -45,6 +45,17 @@ def quantize_loss(
     return emb_loss + commitment_weight * commit_loss
 
 
+def mask_vocab_logits(logits: jax.Array, valid_vocab: int | None) -> jax.Array:
+    """Push logits for vocab ids >= ``valid_vocab`` to -1e9 so pad rows
+    (e.g. TP vocab padding, HF resize padding) contribute nothing to the
+    softmax partition function and receive no gradient — keeping a tp>1
+    run loss-equivalent to tp=1 and pad rows inert."""
+    if valid_vocab is None or valid_vocab >= logits.shape[-1]:
+        return logits
+    col = jnp.arange(logits.shape[-1])
+    return jnp.where(col >= valid_vocab, -1e9, logits)
+
+
 def cross_entropy_with_ignore(
     logits: jax.Array,
     targets: jax.Array,

@@ -52,6 +52,7 @@ def make_pp_sft_loss(
     n_micro: int | None = None,
     dtype=jnp.float32,
     remat: bool = False,
+    valid_vocab: int | None = None,
 ):
     """Pipeline-parallel causal-LM SFT loss for the Qwen backbone.
 
@@ -166,7 +167,9 @@ def make_pp_sft_loss(
             if cfg.tie_word_embeddings
             else rest["lm_head"]
         )
-        logits = h @ w.T.astype(dtype)
+        from genrec_tpu.ops.losses import mask_vocab_logits
+
+        logits = mask_vocab_logits(h @ w.T.astype(dtype), valid_vocab)
         per_tok, valid = cross_entropy_with_ignore(
             logits[:, :-1, :], labels[:, 1:], ignore_index=-100
         )

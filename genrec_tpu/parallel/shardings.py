@@ -74,3 +74,15 @@ def shard_params(mesh: Mesh, params, rules: Sequence[Rule], log_fn=None):
     return jax.tree_util.tree_map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs
     )
+
+
+def make_place_state(mesh: Mesh, rules: Sequence[Rule] | None, log_fn=None):
+    """One placement function used at TrainState creation AND on resume, so
+    a restored run keeps the same layout. With ``rules`` it shards (adam
+    mu/nu mirror the param paths, so the substring rules place them
+    identically); with ``rules=None`` it replicates."""
+    from genrec_tpu.parallel.mesh import replicate
+
+    if rules is None:
+        return lambda s: replicate(mesh, s)
+    return lambda s: shard_params(mesh, s, rules, log_fn=log_fn)

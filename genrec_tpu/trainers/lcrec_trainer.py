@@ -37,7 +37,7 @@ from genrec_tpu.models.lcrec import (
 )
 from genrec_tpu.ops.metrics import TopKAccumulator
 from genrec_tpu.ops.schedules import cosine_schedule_with_warmup
-from genrec_tpu.parallel import distributed_init, get_mesh, replicate, shard_batch
+from genrec_tpu.parallel import distributed_init, get_mesh, shard_batch
 
 
 def make_generate_fn(model, base_vocab, num_codebooks, codebook_size, beam_width, max_cache):
@@ -197,6 +197,13 @@ def train(
     if len(chosen) > 1:
         raise ValueError("pick ONE of sequence_parallel / pipeline_parallel / "
                          "tensor_parallel per run (composition not wired yet)")
+    if tensor_parallel > 1 and use_lora:
+        # The LoRA step rebuilds the merged tree per step from replicated
+        # base_params, so TP would shard nothing (no memory benefit) while
+        # the model axis still eats devices from data parallelism. Refuse
+        # rather than silently run at 1/tp throughput.
+        raise ValueError("tensor_parallel with use_lora is not wired; "
+                         "run LoRA data-parallel (it is already memory-light)")
     if chosen:
         from genrec_tpu.parallel import make_mesh
 
@@ -306,13 +313,24 @@ def train(
     # Append codebook special tokens (resize_token_embeddings equivalent).
     # base = first codebook-token id: the tokenizer's, when it has one (HF
     # models pad vocab past len(tokenizer), so cfg.vocab_size can differ).
+    # pad_to keeps embed_tokens/lm_head rows divisible by the TP degree so
+    # the qwen_rules vocab sharding never silently falls back to
+    # replication (tiger_trainer solves the same problem with
+    # pad_vocab_to; pad rows are masked out of generation by valid_vocab).
     cfg, params, base_vocab = extend_vocab(
         cfg, params, num_codebooks, codebook_size, vocab_rng,
-        base=getattr(tok, "base_vocab", None),
+        base=getattr(tok, "base_vocab", None), pad_to=max(tensor_parallel, 1),
     )
     # remat mirrors the reference's gradient_checkpointing_enable (lcrec.py:42-46).
     model = QwenLM(cfg, dtype=compute_dtype, remat=gradient_checkpointing)
-    logger.info(f"vocab {base_vocab} + {num_codebooks * codebook_size} codebook tokens")
+    # Ids >= live_vocab are pad rows (TP padding / HF resize padding):
+    # masked out of the SFT softmax and of generation argmax, so they stay
+    # inert and tp>1 losses match tp=1 exactly.
+    live_vocab = base_vocab + num_codebooks * codebook_size
+    logger.info(
+        f"vocab {base_vocab} + {num_codebooks * codebook_size} codebook tokens"
+        + (f" (+{cfg.vocab_size - live_vocab} pad)" if cfg.vocab_size > live_vocab else "")
+    )
 
     train_arrays = data.train_arrays()
     valid_arrays = data.eval_arrays("valid")
@@ -335,18 +353,20 @@ def train(
                 f"sequence_parallel {sequence_parallel}"
             )
         _, base_loss = make_sp_sft_loss(
-            cfg, mesh, dtype=compute_dtype, remat=gradient_checkpointing
+            cfg, mesh, dtype=compute_dtype, remat=gradient_checkpointing,
+            valid_vocab=live_vocab,
         )
     elif pipeline_parallel > 1:
         from genrec_tpu.parallel.pipeline import make_pp_sft_loss
 
         base_loss = make_pp_sft_loss(
             cfg, mesh, n_micro=pp_microbatches, dtype=compute_dtype,
-            remat=gradient_checkpointing,
+            remat=gradient_checkpointing, valid_vocab=live_vocab,
         )
     else:
         base_loss = lambda p, batch: sft_loss(
-            model, p, batch["input_ids"], batch["attention_mask"], batch["labels"]
+            model, p, batch["input_ids"], batch["attention_mask"], batch["labels"],
+            valid_vocab=live_vocab,
         )
 
     if use_lora:
@@ -368,15 +388,11 @@ def train(
         params_of = lambda tp: tp
 
     step_fn = jax.jit(make_train_step(loss_fn, optimizer, clip_norm=1.0), donate_argnums=0)
-    if tensor_parallel > 1 and not use_lora:
-        # Megatron-style placement; opt state mirrors the param paths so
-        # the substring rules place it identically. (LoRA keeps replication:
-        # the merged tree is rebuilt per step.)
-        from genrec_tpu.parallel.shardings import qwen_rules, shard_params
+    from genrec_tpu.parallel.shardings import make_place_state, qwen_rules
 
-        place_state = lambda s: shard_params(mesh, s, qwen_rules(), log_fn=logger.info)
-    else:
-        place_state = lambda s: replicate(mesh, s)
+    place_state = make_place_state(
+        mesh, qwen_rules() if tensor_parallel > 1 else None, log_fn=logger.info
+    )
     state = place_state(TrainState.create(trainable, optimizer, state_rng))
     gen_fn = make_generate_fn(
         model, base_vocab, num_codebooks, codebook_size, beam_width,

@@ -29,7 +29,7 @@ from genrec_tpu.models.tiger import Tiger, tiger_generate
 from genrec_tpu.ops.metrics import TopKAccumulator
 from genrec_tpu.ops.schedules import cosine_schedule_with_warmup
 from genrec_tpu.ops.trie import build_trie
-from genrec_tpu.parallel import distributed_init, get_mesh, make_mesh, replicate, shard_batch
+from genrec_tpu.parallel import distributed_init, get_mesh, make_mesh, shard_batch
 
 
 def make_generate_fn(model, trie, temperature, n_candidates):
@@ -193,16 +193,11 @@ def train(
         ),
         donate_argnums=0,
     )
-    # One placement function used at creation AND on resume, so a restored
-    # run keeps the exact same layout (sharded rules apply to the whole
-    # TrainState — adam mu/nu mirror the param paths, so the substring
-    # rules place them identically).
-    if tensor_parallel > 1:
-        from genrec_tpu.parallel.shardings import shard_params, tiger_rules
+    from genrec_tpu.parallel.shardings import make_place_state, tiger_rules
 
-        place_state = lambda s: shard_params(mesh, s, tiger_rules(), log_fn=logger.info)
-    else:
-        place_state = lambda s: replicate(mesh, s)
+    place_state = make_place_state(
+        mesh, tiger_rules() if tensor_parallel > 1 else None, log_fn=logger.info
+    )
     state = place_state(TrainState.create(params, optimizer, state_rng))
     gen_fn = make_generate_fn(model, trie, generate_temperature, 10)
 

@@ -117,6 +117,38 @@ def test_tp2_matches_replicated():
     assert loss_plain == pytest.approx(loss_tp, abs=1e-5)
 
 
+def test_qwen_padded_vocab_loss_is_inert():
+    """extend_vocab(pad_to=8) + valid_vocab masking: the padded model's SFT
+    loss equals the unpadded one (pad rows contribute nothing to the
+    softmax), so tp>1 runs are loss-equivalent to tp=1."""
+    from genrec_tpu.models.backbones.qwen import QwenConfig, QwenLM
+    from genrec_tpu.models.lcrec import extend_vocab, sft_loss
+
+    cfg = QwenConfig(
+        vocab_size=37, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=1, num_attention_heads=2, num_key_value_heads=2,
+        max_position_embeddings=32, rope_theta=10000.0,
+        tie_word_embeddings=False,
+    )
+    params0 = QwenLM(cfg).init(jax.random.key(0), jnp.zeros((1, 4), jnp.int32))["params"]
+    key = jax.random.key(3)
+    cfg1, p1, base = extend_vocab(cfg, dict(params0), 2, 3, key)  # 43, odd
+    cfg8, p8, _ = extend_vocab(cfg, dict(params0), 2, 3, key, pad_to=8)  # 48
+    assert cfg1.vocab_size == 43 and cfg8.vocab_size == 48
+    live = base + 6
+
+    rng = np.random.default_rng(5)
+    ids = jnp.asarray(rng.integers(0, live, (4, 12)), jnp.int32)
+    am = jnp.ones((4, 12), jnp.int32)
+    labels = jnp.asarray(rng.integers(0, live, (4, 12)), jnp.int32)
+    l1 = float(sft_loss(QwenLM(cfg1), p1, ids, am, labels, valid_vocab=live))
+    l8 = float(sft_loss(QwenLM(cfg8), p8, ids, am, labels, valid_vocab=live))
+    assert l1 == pytest.approx(l8, abs=1e-5)
+    # Without the mask the pad rows leak into the partition function.
+    l8_unmasked = float(sft_loss(QwenLM(cfg8), p8, ids, am, labels))
+    assert abs(l8_unmasked - l1) > 1e-4
+
+
 def test_qwen_tp2_matches_replicated():
     """Megatron rules (parallel/shardings.qwen_rules) on the Qwen backbone:
     TP-sharded SFT loss equals the replicated one, and the attention/MLP
@@ -145,6 +177,17 @@ def test_qwen_tp2_matches_replicated():
     fallbacks = []
     sp = shard_params(mesh, params, qwen_rules(), log_fn=fallbacks.append)
     assert not fallbacks, fallbacks
+    # Fallback-free is necessary but not sufficient: a predicate that no
+    # longer MATCHES (param rename) reports nothing. Assert the intended
+    # leaves actually got non-replicated specs.
+    specs = param_specs(params, qwen_rules(), mesh)
+    sharded = {
+        "/".join(str(getattr(k, "key", k)) for k in path)
+        for path, s in jax.tree_util.tree_leaves_with_path(specs)
+        if s != jax.sharding.PartitionSpec()
+    }
+    for want in ("q_proj", "o_proj", "gate_proj", "embed_tokens", "lm_head"):
+        assert any(want in p for p in sharded), (want, sorted(sharded))
     from genrec_tpu.parallel import shard_batch
 
     b = shard_batch(mesh, {"ids": ids, "am": am, "labels": labels})
