@@ -19,20 +19,29 @@ import time
 import numpy as np
 
 
-def _bench_chained(make_chain, *args, n=32, reps=5):
-    """Per-iteration wall-time (ms) of ``n`` data-dependent applications
-    inside ONE jit. A remote-tunnel TPU (axon) adds ~60ms of RPC latency
-    per dispatch, which buries sub-ms kernels; chaining amortizes it so
-    the number reflects device time."""
+def _bench_chained(f, x0, *rest, n=512, reps=3):
+    """Per-iteration wall-time (ms) of ``n`` data-dependent applications of
+    ``f`` looped ON DEVICE (lax.scan carries f's output back as its first
+    argument). A remote-tunnel TPU (axon) adds ~60ms of RPC latency per
+    dispatch — enough to bury a sub-ms kernel even when unrolled a few
+    dozen times — so the loop must be long and live device-side; scan
+    compiles the kernel once regardless of n."""
     import jax
 
-    fn = jax.jit(make_chain(n))
-    out = fn(*args)
+    @jax.jit
+    def chained(x0, *rest):
+        def body(x, _):
+            return f(x, *rest), None
+
+        out, _ = jax.lax.scan(body, x0, None, length=n)
+        return out
+
+    out = chained(x0, *rest)
     jax.block_until_ready(out)
     times = []
     for _ in range(reps):
         t0 = time.perf_counter()
-        out = fn(*args)
+        out = chained(x0, *rest)
         jax.block_until_ready(out)
         times.append((time.perf_counter() - t0) * 1e3 / n)
     return float(np.median(times))
@@ -102,23 +111,12 @@ def run(interpret: bool = False) -> dict:
         err = float(np.max(np.abs(got - ref)))
         entry = {"max_abs_err": err, "ok": bool(err < 2e-3)}
         if not interpret:
-            # Chain by feeding the output back as q (same shape) so one
-            # dispatch covers n kernels — see _bench_chained.
-            def chain_of(f):
-                def make(n):
-                    def chained(q0, *rest):
-                        x = q0
-                        for _ in range(n):
-                            x = f(x, *rest)
-                        return x
-                    return chained
-                return make
-
+            # The output has q's shape, so it scan-carries back as q.
             entry["pallas_ms"] = _bench_chained(
-                chain_of(hstu_attention_pallas), q, k, v, ts, pad, pt, tt
+                hstu_attention_pallas, q, k, v, ts, pad, pt, tt
             )
             entry["xla_ms"] = _bench_chained(
-                chain_of(hstu_attention_xla), q, k, v, ts, pad, pt, tt
+                hstu_attention_xla, q, k, v, ts, pad, pt, tt
             )
         res["kernels"]["hstu_attention"] = entry
     except Exception as e:  # noqa: BLE001 - report, don't crash bench
@@ -143,21 +141,13 @@ def run(interpret: bool = False) -> dict:
             "ok": bool(ids_match and qerr < 1e-3),
         }
         if not interpret:
-            # Chain by feeding qsum back as x (same shape).
-            def rq_chain(f):
-                def make(n):
-                    def chained(x0, cb):
-                        xx = x0
-                        for _ in range(n):
-                            _, xx = f(xx, cb)
-                        return xx
-                    return chained
-                return make
-
+            # qsum has x's shape, so it scan-carries back as x.
             entry["pallas_ms"] = _bench_chained(
-                rq_chain(lambda a, b: rq_cascade_pallas(a, b, blk_b=256)), x, cbs
+                lambda a, b: rq_cascade_pallas(a, b, blk_b=256)[1], x, cbs
             )
-            entry["xla_ms"] = _bench_chained(rq_chain(_rq_cascade_xla), x, cbs)
+            entry["xla_ms"] = _bench_chained(
+                lambda a, b: _rq_cascade_xla(a, b)[1], x, cbs
+            )
         res["kernels"]["rq_cascade"] = entry
     except Exception as e:  # noqa: BLE001
         res["kernels"]["rq_cascade"] = {"ok": False, "error": repr(e)}

@@ -43,10 +43,12 @@ def _kernel(x_ref, cb_ref, ids_ref, qsum_ref, *, n_layers: int, K: int):
         # Padded codeword columns (>= K) can never win the argmin.
         col = jax.lax.broadcasted_iota(jnp.int32, dist.shape, 1)
         dist = jnp.where(col >= K, jnp.inf, dist)
-        ids = jnp.argmin(dist, axis=1)  # (blk_b,)
-        onehot = (
-            jax.lax.broadcasted_iota(jnp.int32, dist.shape, 1) == ids[:, None]
-        ).astype(jnp.float32)
+        # First-occurrence argmin via min-reductions only: jnp.argmin's
+        # lowering hits a Mosaic f32->i32 vector legalization bug at some
+        # padded-lane shapes (seen at K=32 -> Kp=128 on v5e).
+        row_min = jnp.min(dist, axis=1, keepdims=True)
+        ids = jnp.min(jnp.where(dist == row_min, col, dist.shape[1]), axis=1)
+        onehot = (col == ids[:, None]).astype(jnp.float32)
         chosen = jnp.dot(
             onehot, cb, preferred_element_type=jnp.float32,
             precision=jax.lax.Precision.HIGHEST,

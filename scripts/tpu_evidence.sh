@@ -1,0 +1,22 @@
+#!/bin/bash
+# Real-TPU evidence runs: the synthetic configs trained to convergence on
+# the v5e chip (default backend), metrics + throughput into results/tpu/.
+# Each trainer logs seq/s/chip per epoch (core/profiling.log_epoch_perf).
+set -u
+cd "$(dirname "$0")/.."
+for spec in \
+  "sasrec 20" \
+  "hstu 20" \
+  "rqvae 30" \
+  "tiger 30" \
+  "cobra 30" \
+  "lcrec 4" \
+  ; do
+  name=${spec% *}; ep=${spec#* }
+  echo "=== $name ($ep epochs) ==="
+  timeout 900 python -m genrec_tpu.trainers.${name}_trainer \
+    config/${name}/synthetic.gin \
+    --gin "train.epochs=${ep}" \
+    --gin "train.save_dir_root='results/tpu/${name}'" \
+    2>&1 | tail -4
+done

@@ -1,0 +1,29 @@
+"""One-off hardware check: rq_cascade at the trainer's failing shapes
+(B4096 D16 K32 — the Mosaic argmin legalization bug) + full preflight."""
+import json
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from genrec_tpu.kernels.preflight import _rq_cascade_xla, run
+from genrec_tpu.kernels.rq_cascade import rq_cascade_pallas
+
+rng = np.random.default_rng(0)
+for (B, D, L, K) in [(4096, 16, 3, 32), (2000, 16, 3, 32)]:
+    x = jnp.asarray(rng.normal(size=(B, D)), jnp.float32)
+    cbs = jnp.asarray(rng.normal(size=(L, K, D)), jnp.float32)
+    ids, qsum = jax.jit(rq_cascade_pallas)(x, cbs)
+    rids, rqsum = jax.jit(_rq_cascade_xla)(x, cbs)
+    print(
+        f"B{B} D{D} K{K}: ids_match={np.array_equal(np.asarray(ids), np.asarray(rids))} "
+        f"qerr={float(np.max(np.abs(np.asarray(qsum) - np.asarray(rqsum)))):.2e}"
+    )
+
+# bf16 inputs (the trainer's amp path feeds bf16 encodings).
+x16 = jnp.asarray(rng.normal(size=(512, 16)), jnp.bfloat16)
+cbs16 = jnp.asarray(rng.normal(size=(3, 32, 16)), jnp.bfloat16)
+ids, qsum = jax.jit(rq_cascade_pallas)(x16, cbs16)
+print("bf16 path ok:", ids.shape, qsum.dtype)
+
+print(json.dumps(run(interpret=False)))
