@@ -36,13 +36,18 @@ def _bench_chained(f, x0, *rest, n=512, reps=3):
         out, _ = jax.lax.scan(body, x0, None, length=n)
         return out
 
-    out = chained(x0, *rest)
-    jax.block_until_ready(out)
+    import jax.numpy as jnp
+
+    def sync(o):
+        # Host pull, not block_until_ready: the latter has been observed
+        # returning early over the axon tunnel (see bench.py).
+        return float(jnp.sum(o))
+
+    sync(chained(x0, *rest))
     times = []
     for _ in range(reps):
         t0 = time.perf_counter()
-        out = chained(x0, *rest)
-        jax.block_until_ready(out)
+        sync(chained(x0, *rest))
         times.append((time.perf_counter() - t0) * 1e3 / n)
     return float(np.median(times))
 

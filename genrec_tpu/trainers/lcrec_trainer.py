@@ -313,16 +313,19 @@ def train(
     # Append codebook special tokens (resize_token_embeddings equivalent).
     # base = first codebook-token id: the tokenizer's, when it has one (HF
     # models pad vocab past len(tokenizer), so cfg.vocab_size can differ).
-    # Pad embed_tokens/lm_head rows to a multiple of max(8, tp): divisible
-    # by every realistic TP degree so the qwen_rules vocab sharding never
-    # silently falls back to replication, AND independent of
-    # tensor_parallel for tp <= 8 so a checkpoint trained at one degree
-    # restores/eval_only's at another (pad rows are masked out of the
-    # loss by valid_vocab and out of generation by valid_vocab/allowed
-    # slices).
+    # Pad embed_tokens/lm_head rows to a multiple of lcm(8, tp): divisible
+    # by the actual TP degree (including non-power-of-2 meshes) so the
+    # qwen_rules vocab sharding never silently falls back to replication,
+    # AND independent of tensor_parallel for every tp dividing 8, so a
+    # checkpoint trained at one such degree restores/eval_only's at
+    # another (pad rows are masked out of the loss by valid_vocab and out
+    # of generation by valid_vocab/allowed slices).
+    import math
+
     cfg, params, base_vocab = extend_vocab(
         cfg, params, num_codebooks, codebook_size, vocab_rng,
-        base=getattr(tok, "base_vocab", None), pad_to=max(8, tensor_parallel),
+        base=getattr(tok, "base_vocab", None),
+        pad_to=math.lcm(8, max(tensor_parallel, 1)),
     )
     # remat mirrors the reference's gradient_checkpointing_enable (lcrec.py:42-46).
     model = QwenLM(cfg, dtype=compute_dtype, remat=gradient_checkpointing)
