@@ -40,6 +40,19 @@ class QwenConfig:
     rope_theta: float = 1_000_000.0
     rms_norm_eps: float = 1e-6
     tie_word_embeddings: bool = True
+    # Mixture-of-experts (Qwen2-MoE-class): >0 replaces the dense SwiGLU
+    # with `num_experts` routed SwiGLU experts (top-k, capacity-dropped).
+    # The reference has no MoE anywhere (SURVEY.md §2.5: EP "absent"); this
+    # is the beyond-parity path that gives the framework an expert-parallel
+    # axis to scale over.
+    num_experts: int = 0
+    num_experts_per_tok: int = 2
+    # Per-expert slot budget C = ceil(tokens/num_experts) * capacity_factor.
+    # Static C keeps every shape jit-compilable; overflow tokens fall back
+    # to the residual stream (their MLP delta is zero), the standard
+    # Switch/GShard trade.
+    moe_capacity_factor: float = 2.0
+    router_aux_coef: float = 0.01
 
     @property
     def head_dim(self) -> int:
@@ -142,21 +155,174 @@ class QwenMLP(nn.Module):
         )
 
 
+def _ctx_mesh_axes() -> tuple:
+    """Axis names of whichever mesh context is active — `jax.set_mesh`
+    (abstract) or the legacy `with mesh:` (physical) — so sharding
+    constraints no-op cleanly outside any mesh (e.g. during init)."""
+    from jax.sharding import get_abstract_mesh
+
+    axes = tuple(getattr(get_abstract_mesh(), "axis_names", ()))
+    if not axes:
+        try:
+            from jax._src.mesh import thread_resources
+
+            axes = tuple(thread_resources.env.physical_mesh.axis_names)
+        except Exception:
+            axes = ()
+    return axes
+
+
+class QwenMoEMLP(nn.Module):
+    """Top-k routed mixture of SwiGLU experts, GShard/Switch dispatch.
+
+    TPU-first design: routing is expressed as two dense einsums against a
+    (tokens, experts, capacity) dispatch/combine tensor — static shapes,
+    no gather/scatter, so XLA tiles the per-expert matmuls onto the MXU
+    and, when the expert stacks are sharded over an ``expert`` mesh axis
+    (parallel/shardings.moe_rules), lowers the dispatch einsum to an
+    all-to-all over ICI. The fp32 router and the load-balancing auxiliary
+    loss (sown into the ``losses`` collection as ``router_aux``) follow
+    the Switch-Transformer formulation.
+    """
+
+    cfg: QwenConfig
+    dtype: jnp.dtype = jnp.float32
+    # When set, dispatched (E, C, D) activations are sharding-constrained
+    # to this mesh axis so the all-to-all boundary is explicit even before
+    # XLA's propagation pass.
+    expert_axis: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x, token_mask=None):
+        cfg = self.cfg
+        E, K, D, F = (
+            cfg.num_experts,
+            cfg.num_experts_per_tok,
+            cfg.hidden_size,
+            cfg.intermediate_size,
+        )
+        B, L, _ = x.shape
+        S = B * L
+        xf = x.reshape(S, D)
+
+        # Router in fp32: tiny matmul, and bf16 logits visibly perturb
+        # top-k order at realistic expert counts.
+        logits = nn.Dense(E, use_bias=False, dtype=jnp.float32, name="router")(
+            xf.astype(jnp.float32)
+        )
+        probs = jax.nn.softmax(logits, axis=-1)  # (S, E)
+        gates, eidx = jax.lax.top_k(probs, K)  # (S, K)
+        gates = gates / jnp.maximum(gates.sum(-1, keepdims=True), 1e-9)
+
+        # Padding tokens must not claim capacity slots (at tight capacity
+        # factors they would evict REAL tokens' primary experts with
+        # rank-0 priority) nor steer the load-balance loss.
+        valid = (
+            jnp.ones((S,), jnp.int32)
+            if token_mask is None
+            else token_mask.reshape(S).astype(jnp.int32)
+        )
+
+        C = max(1, int(-(-S // E) * cfg.moe_capacity_factor))
+        expert_mask = (
+            jax.nn.one_hot(eidx, E, dtype=jnp.int32) * valid[:, None, None]
+        )  # (S, K, E)
+        # Slot assignment: rank-k choices claim capacity only after every
+        # rank-(k-1) choice (transpose K to the front before the cumsum),
+        # so a token's primary expert is never evicted by another token's
+        # secondary pick.
+        flat = expert_mask.transpose(1, 0, 2).reshape(K * S, E)
+        pos = (jnp.cumsum(flat, axis=0) * flat - 1).reshape(K, S, E).transpose(1, 0, 2)
+        slot = (pos * expert_mask).sum(-1)  # (S, K)
+        keep = (slot >= 0) & (slot < C) & (valid[:, None] > 0)
+        slot = jnp.clip(slot, 0, C - 1)
+
+        # Accumulate (S, E, C) dispatch/combine one rank at a time: the
+        # fused 4-D (S, K, E, C) one-hot product is K x larger than the
+        # routing tensors themselves and XLA does not reliably fuse it
+        # away — at long-context S it alone could OOM the HBM.
+        dispatch = jnp.zeros((S, E, C), x.dtype)
+        combine = jnp.zeros((S, E, C), x.dtype)
+        for kk in range(K):
+            d = (
+                jax.nn.one_hot(eidx[:, kk], E, dtype=x.dtype)
+                * keep[:, kk, None].astype(x.dtype)
+            )[:, :, None] * jax.nn.one_hot(slot[:, kk], C, dtype=x.dtype)[:, None, :]
+            dispatch = dispatch + d
+            combine = combine + gates[:, kk].astype(x.dtype)[:, None, None] * d
+
+        w_gate = self.param("gate_proj", nn.initializers.lecun_normal(), (E, D, F))
+        w_up = self.param("up_proj", nn.initializers.lecun_normal(), (E, D, F))
+        w_down = self.param("down_proj", nn.initializers.lecun_normal(), (E, F, D))
+
+        expert_in = jnp.einsum("sec,sd->ecd", dispatch, xf)  # all-to-all boundary
+        if self.expert_axis is not None and self.expert_axis in _ctx_mesh_axes():
+            from jax.lax import with_sharding_constraint
+            from jax.sharding import PartitionSpec as P
+
+            expert_in = with_sharding_constraint(
+                expert_in, P(self.expert_axis, None, None)
+            )
+        h = nn.silu(
+            jnp.einsum("ecd,edf->ecf", expert_in, w_gate.astype(self.dtype))
+        ) * jnp.einsum("ecd,edf->ecf", expert_in, w_up.astype(self.dtype))
+        expert_out = jnp.einsum("ecf,efd->ecd", h, w_down.astype(self.dtype))
+        y = jnp.einsum("sec,ecd->sd", combine, expert_out)
+
+        # Switch load-balance loss over VALID tokens only: E * sum_e
+        # mean(router prob_e) * mean(fraction whose TOP choice is e);
+        # 1.0 when uniform.
+        vf = valid.astype(jnp.float32)
+        nv = jnp.maximum(vf.sum(), 1.0)
+        top1 = jax.nn.one_hot(eidx[:, 0], E, dtype=jnp.float32) * vf[:, None]
+        aux = E * jnp.sum((probs * vf[:, None]).sum(0) / nv * (top1.sum(0) / nv))
+        self.sow("losses", "router_aux", cfg.router_aux_coef * aux)
+
+        return y.reshape(B, L, D)
+
+
+def collect_moe_aux(mutables) -> jnp.ndarray:
+    """Sum every ``router_aux`` value sown during an
+    ``apply(..., mutable=["losses"])`` forward (0.0 for dense models).
+    Accepts any Mapping (older flax returns FrozenDict, not dict)."""
+    from collections.abc import Mapping
+
+    leaves = []
+
+    def walk(tree):
+        if isinstance(tree, Mapping):
+            for k, v in tree.items():
+                if k == "router_aux":
+                    leaves.extend(v if isinstance(v, (tuple, list)) else [v])
+                else:
+                    walk(v)
+
+    walk(mutables.get("losses", {}) if isinstance(mutables, Mapping) else {})
+    return sum(leaves) if leaves else jnp.asarray(0.0)
+
+
 class QwenBlock(nn.Module):
     cfg: QwenConfig
     dtype: jnp.dtype = jnp.float32
     ring_axis: Optional[str] = None
     ring_size: int = 1
+    expert_axis: Optional[str] = None
 
     @nn.compact
-    def __call__(self, x, positions, attn_bias, cache=None, ring_kv_valid=None):
+    def __call__(self, x, positions, attn_bias, cache=None, ring_kv_valid=None,
+                 token_mask=None):
         h = RMSNorm(self.cfg.hidden_size, self.cfg.rms_norm_eps, name="input_layernorm")(x)
         h, new_cache = QwenAttention(
             self.cfg, self.dtype, self.ring_axis, self.ring_size, name="self_attn"
         )(h.astype(self.dtype), positions, attn_bias, cache, ring_kv_valid)
         x = x + h
         h = RMSNorm(self.cfg.hidden_size, self.cfg.rms_norm_eps, name="post_attention_layernorm")(x)
-        x = x + QwenMLP(self.cfg, self.dtype, name="mlp")(h.astype(self.dtype))
+        if self.cfg.num_experts > 0:
+            x = x + QwenMoEMLP(self.cfg, self.dtype, self.expert_axis, name="moe")(
+                h.astype(self.dtype), token_mask
+            )
+        else:
+            x = x + QwenMLP(self.cfg, self.dtype, name="mlp")(h.astype(self.dtype))
         return x, new_cache
 
 
@@ -172,6 +338,9 @@ class QwenLM(nn.Module):
     # attention, everything else stays local. See models/lcrec.sp_sft_loss.
     ring_axis: Optional[str] = None
     ring_size: int = 1
+    # Expert parallelism: mesh axis the MoE expert stacks are sharded over
+    # (only meaningful with cfg.num_experts > 0).
+    expert_axis: Optional[str] = None
 
     def setup(self):
         self.embed_tokens = self.param(
@@ -182,7 +351,7 @@ class QwenLM(nn.Module):
         self.blocks = [
             block_cls(
                 self.cfg, self.dtype, self.ring_axis, self.ring_size,
-                name=f"layer_{i}",
+                self.expert_axis, name=f"layer_{i}",
             )
             for i in range(self.cfg.num_hidden_layers)
         ]
@@ -225,7 +394,10 @@ class QwenLM(nn.Module):
 
         x = self.embed_tokens[input_ids].astype(self.dtype)
         for block in self.blocks:
-            x, _ = block(x, positions, bias, ring_kv_valid=ring_valid)
+            x, _ = block(
+                x, positions, bias, ring_kv_valid=ring_valid,
+                token_mask=attention_mask,
+            )
         h = self.norm(x).astype(self.dtype)
         logits = self._head(h) if compute_logits else None
         if return_hidden:
@@ -271,7 +443,13 @@ class QwenLM(nn.Module):
 
 def params_from_hf_state_dict(sd: dict, cfg: QwenConfig) -> dict:
     """Convert an HF Qwen2ForCausalLM state dict (numpy arrays) into this
-    module's param tree."""
+    module's param tree. Dense models only: HF Qwen2-MoE checkpoints use
+    per-expert ``mlp.experts.*`` keys this converter does not map yet."""
+    if cfg.num_experts > 0:
+        raise NotImplementedError(
+            "params_from_hf_state_dict maps dense Qwen2 checkpoints; "
+            "MoE (cfg.num_experts > 0) key mapping is not implemented"
+        )
     lin = lambda p, bias: (
         {"kernel": sd[p + ".weight"].T, "bias": sd[p + ".bias"]}
         if bias

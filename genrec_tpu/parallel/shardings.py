@@ -40,6 +40,21 @@ def qwen_rules(model_axis: str = "model") -> Sequence[Rule]:
     )
 
 
+def moe_rules(expert_axis: str = "expert") -> Sequence[Rule]:
+    """Expert parallelism for the Qwen MoE blocks: the stacked per-expert
+    SwiGLU weights (E, D, F)/(E, F, D) shard on dim 0 over the expert
+    axis; the router stays replicated (it is tiny and every device needs
+    the full routing distribution to build its dispatch mask)."""
+    stacks = ("gate_proj", "up_proj", "down_proj")
+    return (
+        (
+            lambda p: "moe" in p and any(s in p for s in stacks) and "router" not in p,
+            0,
+            expert_axis,
+        ),
+    )
+
+
 def param_specs(params, rules: Sequence[Rule], mesh: Mesh, log_fn=None):
     """PartitionSpec tree for ``params`` under ``rules`` (replicated where
     no rule matches or the axis doesn't divide the mesh axis size).
