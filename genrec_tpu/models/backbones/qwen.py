@@ -433,9 +433,15 @@ class QwenLM(nn.Module):
         bias = causal + jnp.where(pad_mask[:, None, None, :] == 0, -1e9, 0.0)
 
         x = self.embed_tokens[input_ids].astype(self.dtype)
+        # Validity of the CURRENT block's tokens (pad_mask covers cache
+        # slots): without it, prefilling a padded prompt would let pad
+        # tokens claim MoE capacity that training denies them.
+        token_mask = jax.lax.dynamic_slice_in_dim(
+            pad_mask, caches[0]["idx"], L, axis=1
+        )
         new_caches = []
         for block, cache in zip(self.blocks, caches):
-            x, nc = block(x, positions, bias, cache)
+            x, nc = block(x, positions, bias, cache, token_mask=token_mask)
             new_caches.append(nc)
         h = self.norm(x).astype(self.dtype)
         return self._head(h)[:, -1, :], new_caches

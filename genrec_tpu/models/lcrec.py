@@ -93,12 +93,24 @@ def sft_loss(model: QwenLM, params, input_ids, attention_mask, labels,
     ``valid_vocab`` masks vocab pad rows out of the softmax (TP padding)."""
     from genrec_tpu.ops.losses import cross_entropy_with_ignore, mask_vocab_logits
 
-    logits = model.apply({"params": params}, input_ids, attention_mask=attention_mask)
+    if model.cfg.num_experts > 0:
+        # MoE backbone: collect the router load-balance aux loss sown by
+        # each QwenMoEMLP (dropped silently without mutable=).
+        from genrec_tpu.models.backbones.qwen import collect_moe_aux
+
+        logits, mut = model.apply(
+            {"params": params}, input_ids, attention_mask=attention_mask,
+            mutable=["losses"],
+        )
+        aux = collect_moe_aux(mut)
+    else:
+        logits = model.apply({"params": params}, input_ids, attention_mask=attention_mask)
+        aux = 0.0
     logits = mask_vocab_logits(logits, valid_vocab)
     per_tok, valid = cross_entropy_with_ignore(
         logits[:, :-1, :], labels[:, 1:], ignore_index=-100
     )
-    return per_tok.sum() / jnp.maximum(valid.sum(), 1)
+    return per_tok.sum() / jnp.maximum(valid.sum(), 1) + aux
 
 
 def make_sp_sft_loss(

@@ -160,6 +160,13 @@ def train(
     lora_rank=8,
     lora_alpha=16.0,
     lora_targets=("q_proj", "v_proj"),
+    # >0: replace the dense SwiGLU with a routed mixture of experts
+    # (backbones.qwen.QwenMoEMLP — beyond-parity, reference has no MoE).
+    num_experts=0,
+    num_experts_per_tok=2,
+    # >1: shard the expert stacks over an "expert" mesh axis
+    # (parallel/shardings.moe_rules); requires num_experts % it == 0.
+    expert_parallel=1,
     # Backbone (synthetic default: tiny random-init Qwen).
     pretrained_path=None,
     hidden_size=64,
@@ -192,11 +199,35 @@ def train(
     distributed_init()
     logger = setup_logger(save_dir_root)
     tracker = Tracker(wandb_logging, wandb_project, save_dir=save_dir_root)
-    chosen = [n for n in (sequence_parallel, pipeline_parallel, tensor_parallel)
+    chosen = [n for n in (sequence_parallel, pipeline_parallel, tensor_parallel,
+                          expert_parallel)
               if n > 1]
     if len(chosen) > 1:
         raise ValueError("pick ONE of sequence_parallel / pipeline_parallel / "
-                         "tensor_parallel per run (composition not wired yet)")
+                         "tensor_parallel / expert_parallel per run "
+                         "(composition not wired yet)")
+    if num_experts > 0 and (
+        sequence_parallel > 1 or pipeline_parallel > 1 or tensor_parallel > 1
+    ):
+        # sp/pp run the blocks inside shard_map and do not collect the
+        # sown router-aux loss; tp's qwen_rules match Dense kernels only,
+        # so the dominant (E, D, F) expert stacks would silently stay
+        # replicated. Refuse rather than quietly degrade.
+        raise ValueError("num_experts>0 is wired for dp / expert_parallel "
+                         "runs only")
+    if expert_parallel > 1 and use_lora:
+        # Same reasoning as tensor_parallel+LoRA below: the trainable tree
+        # is the adapters, moe_rules match nothing in it, and the expert
+        # axis would just eat devices from data parallelism.
+        raise ValueError("expert_parallel with use_lora is not wired; "
+                         "run LoRA data-parallel")
+    if expert_parallel > 1 and (
+        num_experts <= 0 or num_experts % expert_parallel
+    ):
+        raise ValueError(
+            f"expert_parallel={expert_parallel} needs num_experts>0 "
+            f"divisible by it (got {num_experts})"
+        )
     if tensor_parallel > 1 and use_lora:
         # The LoRA step rebuilds the merged tree per step from replicated
         # base_params, so TP would shard nothing (no memory benefit) while
@@ -210,6 +241,7 @@ def train(
         axis = (
             ("sp", sequence_parallel) if sequence_parallel > 1
             else ("pipe", pipeline_parallel) if pipeline_parallel > 1
+            else ("expert", expert_parallel) if expert_parallel > 1
             else ("model", tensor_parallel)
         )
         mesh = make_mesh({"data": -1, axis[0]: axis[1]})
@@ -234,8 +266,10 @@ def train(
             num_attention_heads=num_heads, num_key_value_heads=num_kv_heads,
             max_position_embeddings=max_text_len + num_codebooks + 1,
             rope_theta=10000.0, tie_word_embeddings=False,
+            num_experts=num_experts, num_experts_per_tok=num_experts_per_tok,
         )
-        model0 = QwenLM(cfg, dtype=compute_dtype, remat=gradient_checkpointing)
+        model0 = QwenLM(cfg, dtype=compute_dtype, remat=gradient_checkpointing,
+                        expert_axis="expert" if expert_parallel > 1 else None)
         params = model0.init(init_rng, jnp.zeros((1, 4), jnp.int32))["params"]
     else:
         # Real-data path (reference amazon_lcrec.py:164-676): sequences +
@@ -260,6 +294,11 @@ def train(
         max_pos = max_text_len + max(num_codebooks, index2item_max_new) + 1
 
         hf_config = os.path.join(pretrained_path or "", "config.json")
+        if num_experts > 0 and pretrained_path and os.path.exists(hf_config):
+            raise ValueError(
+                "num_experts>0 with a full HF checkpoint is not wired "
+                "(params_from_hf_state_dict maps dense Qwen2 only)"
+            )
         if pretrained_path and os.path.exists(hf_config):
             # Full local checkpoint: convert torch weights into the flax
             # tree (backbones.qwen.params_from_hf_state_dict).
@@ -302,8 +341,11 @@ def train(
                 num_attention_heads=num_heads, num_key_value_heads=num_kv_heads,
                 max_position_embeddings=max_pos,
                 rope_theta=10000.0, tie_word_embeddings=False,
+                num_experts=num_experts,
+                num_experts_per_tok=num_experts_per_tok,
             )
-        model0 = QwenLM(cfg, dtype=compute_dtype, remat=gradient_checkpointing)
+        model0 = QwenLM(cfg, dtype=compute_dtype, remat=gradient_checkpointing,
+                        expert_axis="expert" if expert_parallel > 1 else None)
         params = (
             params
             if pretrained_path and os.path.exists(hf_config)
@@ -328,7 +370,8 @@ def train(
         pad_to=math.lcm(8, max(tensor_parallel, 1)),
     )
     # remat mirrors the reference's gradient_checkpointing_enable (lcrec.py:42-46).
-    model = QwenLM(cfg, dtype=compute_dtype, remat=gradient_checkpointing)
+    model = QwenLM(cfg, dtype=compute_dtype, remat=gradient_checkpointing,
+                   expert_axis="expert" if expert_parallel > 1 else None)
     # Ids >= live_vocab are pad rows (TP padding / HF resize padding):
     # masked out of the SFT softmax and of generation argmax, so they stay
     # inert and tp>1 losses match tp=1 exactly.
@@ -394,10 +437,14 @@ def train(
         params_of = lambda tp: tp
 
     step_fn = jax.jit(make_train_step(loss_fn, optimizer, clip_norm=1.0), donate_argnums=0)
-    from genrec_tpu.parallel.shardings import make_place_state, qwen_rules
+    from genrec_tpu.parallel.shardings import make_place_state, moe_rules, qwen_rules
 
     place_state = make_place_state(
-        mesh, qwen_rules() if tensor_parallel > 1 else None, log_fn=logger.info
+        mesh,
+        qwen_rules() if tensor_parallel > 1
+        else moe_rules() if expert_parallel > 1
+        else None,
+        log_fn=logger.info,
     )
     state = place_state(TrainState.create(trainable, optimizer, state_rng))
     gen_fn = make_generate_fn(
