@@ -48,21 +48,49 @@ def to_savable(tree: Any) -> Any:
 
 
 def from_savable(saved: Any, like: Any) -> Any:
-    """Re-wrap leaves that were PRNG keys in ``like``."""
+    """Re-wrap leaves that were PRNG keys in ``like``, preserving the
+    like-key's generator (TPU states carry 'rbg' step keys — see
+    core.state.fast_step_rng — whose key data is wider than threefry's)."""
 
     def conv(s, l):
         if _is_prng_key(l):
-            return jax.random.wrap_key_data(jnp.asarray(s))
+            return jax.random.wrap_key_data(
+                jnp.asarray(s), impl=jax.random.key_impl(l)
+            )
         return s
 
     return jax.tree_util.tree_map(conv, saved, like)
 
 
-def save_params(path: str, params: Any) -> None:
-    """Save a params pytree (host-side, synchronous)."""
-    ckptr = ocp.StandardCheckpointer()
+# Shared async checkpointer: StandardCheckpointer subclasses
+# AsyncCheckpointer, so save() returns once arrays are snapshotted to host
+# and the directory write proceeds on a background thread (a new save
+# first waits for the previous one). SURVEY.md §5.3: async checkpointing
+# is the explicit exceeds-parity goal here.
+_ASYNC_CKPTR: ocp.StandardCheckpointer | None = None
+
+
+def _async_ckptr() -> ocp.StandardCheckpointer:
+    global _ASYNC_CKPTR
+    if _ASYNC_CKPTR is None:
+        _ASYNC_CKPTR = ocp.StandardCheckpointer()
+    return _ASYNC_CKPTR
+
+
+def wait_for_saves() -> None:
+    """Block until every async `save_params(..., wait=False)` has landed."""
+    if _ASYNC_CKPTR is not None:
+        _ASYNC_CKPTR.wait_until_finished()
+
+
+def save_params(path: str, params: Any, wait: bool = True) -> None:
+    """Save a params pytree. ``wait=False`` returns as soon as the arrays
+    are snapshotted (training continues while the write is in flight);
+    call `wait_for_saves()` (or save again, or read back) to join."""
+    ckptr = _async_ckptr()
     ckptr.save(_abs(path), to_savable(params), force=True)
-    ckptr.wait_until_finished()
+    if wait:
+        ckptr.wait_until_finished()
 
 
 def load_params(path: str, like: Any | None = None) -> Any:
@@ -117,6 +145,12 @@ class BestTracker:
         if self.dir:
             import json
 
+            # Synchronous on purpose: the sidecar must only ever describe
+            # a DURABLE best_model dir. An async write here would let a
+            # crash leave value=X on disk with no params — a resumed run
+            # would then never re-save anything below X and the best model
+            # is lost for good. Best-improvements are rare; the epoch-level
+            # CheckpointManager saves are the async path.
             save_params(self.dir, params)
             with open(self.meta, "w") as f:
                 json.dump({"metric": self.metric, "value": value}, f)
@@ -124,6 +158,7 @@ class BestTracker:
 
     def best_params(self, like):
         """Best params seen across ALL runs (disk), or None if none saved."""
+        wait_for_saves()
         if self.dir and os.path.exists(self.dir) and self.value > -1.0:
             return load_params(self.dir, like=like)
         return None

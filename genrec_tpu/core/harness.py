@@ -48,7 +48,13 @@ def make_train_step(
         return loss, aux, grads
 
     def step(state: TrainState, batch) -> tuple[TrainState, dict]:
+        from genrec_tpu.core.state import fast_step_rng
+
         rng, step_rng = jax.random.split(state.rng)
+        # TPU: dropout bits come from the hardware RngBitGenerator instead
+        # of threefry (~40% of a small-model step); state.rng itself stays
+        # threefry so checkpoints are backend-portable (see fast_step_rng).
+        step_rng = fast_step_rng(step_rng)
 
         if accum_steps == 1:
             loss, aux, grads = grads_of(state.params, batch, step_rng)

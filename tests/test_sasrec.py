@@ -256,3 +256,18 @@ def test_prefetch_to_device_matches_direct():
     assert len(direct) == len(pre)
     for a, b in zip(direct, pre):
         np.testing.assert_array_equal(a, b)
+
+
+def test_async_save_overlap_and_join(tmp_path):
+    """save_params(wait=False) returns before the write lands; overlapping
+    saves serialize (orbax joins the previous one first) and
+    wait_for_saves() makes the LAST write durable and readable."""
+    from genrec_tpu.core.checkpoint import load_params, save_params, wait_for_saves
+
+    p1 = {"w": np.full((64, 64), 1.0, np.float32)}
+    p2 = {"w": np.full((64, 64), 2.0, np.float32)}
+    save_params(str(tmp_path / "a"), p1, wait=False)
+    save_params(str(tmp_path / "a"), p2, wait=False)  # overwrites in-flight
+    wait_for_saves()
+    got = load_params(str(tmp_path / "a"), like=p1)
+    np.testing.assert_array_equal(np.asarray(got["w"]), p2["w"])
