@@ -15,13 +15,16 @@ import numpy as np
 
 
 def prefetch_to_device(iterator, mesh, size: int = 2, axis: str = "data"):
-    """Overlap host batching/placement with device compute.
+    """Overlap host batching with device compute.
 
-    Wraps a (batch, valid) iterator: a background thread shards batches
-    onto the mesh ``size`` steps ahead, so the accelerator never waits on
-    the host input pipeline (the reference leans on torch DataLoader
-    worker processes for this; here a single thread + jax async dispatch
-    suffices because batches are pre-materialized numpy).
+    Wraps a (batch, valid) iterator: a background thread assembles numpy
+    batches ``size`` steps ahead (the fancy-index gather + padding is the
+    host cost torch DataLoader workers hide in the reference); the MAIN
+    thread then places them with `shard_batch` — jax transfers are
+    asynchronous, and issuing device_put from a second thread while a
+    compiled program holds the devices can deadlock the CPU backend's
+    collective rendezvous (observed: hard abort on the 8-device virtual
+    mesh), so all device interaction stays single-threaded.
     """
     import queue
     import threading
@@ -30,21 +33,43 @@ def prefetch_to_device(iterator, mesh, size: int = 2, axis: str = "data"):
 
     q: "queue.Queue" = queue.Queue(maxsize=size)
     _END = object()
+    _ERR = object()
+    stop = threading.Event()
+
+    def _put(item) -> bool:
+        # Bounded-wait put so the thread can't block forever if the
+        # consumer abandons the loop (e.g. an iteration-cap break).
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def producer():
         try:
             for batch, valid in iterator:
-                q.put((shard_batch(mesh, batch, axis=axis), valid))
-        finally:
-            q.put(_END)
+                if not _put((batch, valid)):
+                    return
+        except BaseException as e:  # data-pipeline failures must CRASH the
+            _put((_ERR, e))  # train loop, not truncate the epoch silently
+            return
+        _put(_END)
 
     t = threading.Thread(target=producer, daemon=True)
     t.start()
-    while True:
-        item = q.get()
-        if item is _END:
-            return
-        yield item
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
+                return
+            if isinstance(item, tuple) and item[0] is _ERR:
+                raise item[1]
+            batch, valid = item
+            yield shard_batch(mesh, batch, axis=axis), valid
+    finally:
+        stop.set()  # unblocks + retires the producer on early exit
 
 
 def cycle(iterable_factory):

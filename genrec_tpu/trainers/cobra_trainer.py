@@ -20,7 +20,7 @@ from genrec_tpu.core.harness import make_train_step
 from genrec_tpu.core.logging import Tracker, setup_logger
 from genrec_tpu.core.profiling import ProfileWindow, StepTimer, log_epoch_perf
 from genrec_tpu.core.state import TrainState
-from genrec_tpu.data.batching import batch_iterator, pad_to_batch
+from genrec_tpu.data.batching import batch_iterator, pad_to_batch, prefetch_to_device
 from genrec_tpu.data.cobra_seq import CobraSeqData, synthetic_cobra_data
 from genrec_tpu.models.cobra import Cobra, beam_fusion
 from genrec_tpu.ops.metrics import TopKAccumulator
@@ -219,10 +219,12 @@ def train(
     for epoch in range(start_epoch, epochs):
         epoch_loss, n_batches = None, 0
         timer = StepTimer(batch_size, skip_first=1 if epoch == start_epoch else 0)
-        for batch, _ in batch_iterator(
-            train_arrays, batch_size, shuffle=True, seed=seed, epoch=epoch, drop_last=True
+        for sharded, _ in prefetch_to_device(
+            batch_iterator(train_arrays, batch_size, shuffle=True,
+                           seed=seed, epoch=epoch, drop_last=True),
+            mesh,
         ):
-            state, m = step_fn(state, shard_batch(mesh, batch))
+            state, m = step_fn(state, sharded)
             epoch_loss = m["loss"] if epoch_loss is None else epoch_loss + m["loss"]
             timer.tick()
             n_batches += 1

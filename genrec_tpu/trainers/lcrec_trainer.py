@@ -26,7 +26,7 @@ from genrec_tpu.core.logging import Tracker, setup_logger
 from genrec_tpu.core.profiling import ProfileWindow, StepTimer, log_epoch_perf
 from genrec_tpu.core.lora import lora_init, lora_merge, lora_param_count
 from genrec_tpu.core.state import TrainState
-from genrec_tpu.data.batching import batch_iterator
+from genrec_tpu.data.batching import batch_iterator, prefetch_to_device
 from genrec_tpu.data.lcrec_tasks import synthetic_lcrec_data
 from genrec_tpu.models.backbones.qwen import QwenConfig, QwenLM
 from genrec_tpu.models.lcrec import (
@@ -502,10 +502,12 @@ def train(
     for epoch in range(start_epoch, epochs):
         epoch_loss, n_batches = None, 0
         timer = StepTimer(batch_size, skip_first=1 if epoch == start_epoch else 0)
-        for batch, _ in batch_iterator(
-            train_arrays, batch_size, shuffle=True, seed=seed, epoch=epoch, drop_last=True
+        for sharded, _ in prefetch_to_device(
+            batch_iterator(train_arrays, batch_size, shuffle=True,
+                           seed=seed, epoch=epoch, drop_last=True),
+            mesh,
         ):
-            state, m = step_fn(state, shard_batch(mesh, batch))
+            state, m = step_fn(state, sharded)
             epoch_loss = m["loss"] if epoch_loss is None else epoch_loss + m["loss"]
             timer.tick()
             n_batches += 1

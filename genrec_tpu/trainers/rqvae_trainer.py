@@ -29,7 +29,7 @@ from genrec_tpu.core.harness import make_train_step
 from genrec_tpu.core.logging import Tracker, setup_logger
 from genrec_tpu.core.profiling import ProfileWindow, StepTimer, log_epoch_perf
 from genrec_tpu.core.state import TrainState
-from genrec_tpu.data.batching import batch_iterator, pad_to_batch
+from genrec_tpu.data.batching import batch_iterator, pad_to_batch, prefetch_to_device
 from genrec_tpu.data.items import ItemEmbeddingData, SyntheticItemEmbeddings
 from genrec_tpu.data.sem_ids import save_sem_ids
 from genrec_tpu.models.rqvae import (
@@ -39,7 +39,7 @@ from genrec_tpu.models.rqvae import (
     kmeans_init_params,
 )
 from genrec_tpu.ops.schedules import linear_schedule_with_warmup
-from genrec_tpu.parallel import distributed_init, get_mesh, replicate, shard_batch
+from genrec_tpu.parallel import distributed_init, get_mesh, replicate
 
 
 @functools.partial(jax.jit, static_argnums=0)
@@ -233,12 +233,14 @@ def train(
     for epoch in range(start_epoch, epochs):
         epoch_loss, n_batches = None, 0
         timer = StepTimer(batch_size, skip_first=1 if epoch == start_epoch else 0)
-        for batch, _ in batch_iterator(
-            {"x": train_x}, batch_size, shuffle=True, seed=seed, epoch=epoch, drop_last=True
+        for sharded, _ in prefetch_to_device(
+            batch_iterator({"x": train_x}, batch_size, shuffle=True,
+                           seed=seed, epoch=epoch, drop_last=True),
+            mesh,
         ):
             if global_step >= total_steps:
                 break
-            state, m = step_fn(state, shard_batch(mesh, batch))
+            state, m = step_fn(state, sharded)
             epoch_loss = m["loss"] if epoch_loss is None else epoch_loss + m["loss"]
             timer.tick()
             n_batches += 1

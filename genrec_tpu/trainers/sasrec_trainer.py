@@ -22,7 +22,7 @@ from genrec_tpu.core.harness import make_train_step
 from genrec_tpu.core.logging import Tracker, setup_logger
 from genrec_tpu.core.profiling import ProfileWindow, StepTimer, log_epoch_perf
 from genrec_tpu.core.state import TrainState
-from genrec_tpu.data.batching import batch_iterator
+from genrec_tpu.data.batching import batch_iterator, prefetch_to_device
 from genrec_tpu.data.synthetic import SyntheticSeqDataset
 from genrec_tpu.models.sasrec import SASRec
 from genrec_tpu.ops.metrics import first_match_ranks
@@ -177,10 +177,12 @@ def train(
         # the host never blocks on the jitted step (async dispatch).
         epoch_loss, n_batches = None, 0
         timer = StepTimer(batch_size, skip_first=1 if epoch == start_epoch else 0)
-        for batch, _ in batch_iterator(
-            train_arrays, batch_size, shuffle=True, seed=seed, epoch=epoch, drop_last=True
+        for sharded, _ in prefetch_to_device(
+            batch_iterator(train_arrays, batch_size, shuffle=True,
+                           seed=seed, epoch=epoch, drop_last=True),
+            mesh,
         ):
-            state, metrics = step_fn(state, shard_batch(mesh, batch))
+            state, metrics = step_fn(state, sharded)
             epoch_loss = metrics["loss"] if epoch_loss is None else epoch_loss + metrics["loss"]
             timer.tick()
             n_batches += 1

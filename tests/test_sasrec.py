@@ -7,6 +7,8 @@ Flax model must reproduce logits/loss/top-k exactly (fp32 tolerance).
 
 import os
 
+import time
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -271,3 +273,38 @@ def test_async_save_overlap_and_join(tmp_path):
     wait_for_saves()
     got = load_params(str(tmp_path / "a"), like=p1)
     np.testing.assert_array_equal(np.asarray(got["w"]), p2["w"])
+
+
+def test_prefetch_propagates_iterator_errors():
+    """A data-pipeline failure must crash the train loop, not silently
+    truncate the epoch (the producer runs in a thread)."""
+    from genrec_tpu.data.batching import prefetch_to_device
+    from genrec_tpu.parallel import get_mesh
+
+    def bad_iter():
+        yield {"x": np.zeros((8, 2), np.float32)}, np.ones((8,), bool)
+        raise RuntimeError("corrupt shard")
+
+    it = prefetch_to_device(bad_iter(), get_mesh())
+    next(it)
+    with pytest.raises(RuntimeError, match="corrupt shard"):
+        next(it)
+
+
+def test_prefetch_early_break_retires_producer():
+    """Abandoning the loop (iteration-cap break) must unblock and retire
+    the producer thread instead of leaking it on a full queue."""
+    import threading
+
+    from genrec_tpu.data.batching import batch_iterator, prefetch_to_device
+    from genrec_tpu.parallel import get_mesh
+
+    before = threading.active_count()
+    arrays = {"x": np.arange(400, dtype=np.float32).reshape(100, 4)}
+    for i, (b, _) in enumerate(prefetch_to_device(batch_iterator(arrays, 8), get_mesh())):
+        if i == 1:
+            break
+    deadline = time.time() + 5
+    while threading.active_count() > before and time.time() < deadline:
+        time.sleep(0.05)
+    assert threading.active_count() <= before
