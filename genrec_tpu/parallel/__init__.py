@@ -13,6 +13,7 @@ from genrec_tpu.parallel.mesh import (
     shard_batch,
     replicate,
     metric_allreduce,
+    to_host,
     barrier,
 )
 
@@ -23,5 +24,6 @@ __all__ = [
     "shard_batch",
     "replicate",
     "metric_allreduce",
+    "to_host",
     "barrier",
 ]

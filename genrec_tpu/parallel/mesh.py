@@ -54,11 +54,28 @@ def get_mesh(data_axis: str = "data") -> Mesh:
 
 
 def shard_batch(mesh: Mesh, batch: Any, axis: str = "data") -> Any:
-    """Place a host batch pytree with its leading dim sharded over ``axis``."""
+    """Place a host batch pytree with its leading dim sharded over ``axis``.
+
+    Single-process: a plain device_put of the global array. Multi-host:
+    every process holds the same GLOBAL batch (batch_iterator's (seed,
+    epoch)-deterministic shuffle guarantees it) and
+    `jax.make_array_from_process_local_data(..., global_shape)` uploads
+    only this process's addressable shards — no cross-host transfer of
+    array contents, the TPU-native analog of the reference's
+    `Accelerator(split_batches=True)` per-rank loader split (SURVEY.md
+    §5.8).
+    """
+    multi = jax.process_count() > 1
+
     def place(x):
         x = np.asarray(x)
         spec = P(axis, *([None] * (x.ndim - 1)))
-        return jax.device_put(x, NamedSharding(mesh, spec))
+        sharding = NamedSharding(mesh, spec)
+        if multi:
+            return jax.make_array_from_process_local_data(
+                sharding, x, global_shape=x.shape
+            )
+        return jax.device_put(x, sharding)
 
     return jax.tree_util.tree_map(place, batch)
 
@@ -67,6 +84,18 @@ def replicate(mesh: Mesh, tree: Any) -> Any:
     """Fully replicate a pytree (params/opt state) across the mesh."""
     sharding = NamedSharding(mesh, P())
     return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), tree)
+
+
+def to_host(x) -> np.ndarray:
+    """Materialize a (possibly globally-sharded) device array on every
+    host. Single-process: plain np.asarray. Multi-host: np.asarray on an
+    array spanning non-addressable devices raises, so gather the global
+    value via process_allgather instead."""
+    if jax.process_count() == 1 or getattr(x, "is_fully_addressable", True):
+        return np.asarray(x)
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(x, tiled=True))
 
 
 def metric_allreduce(tree: Any) -> Any:

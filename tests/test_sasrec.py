@@ -308,3 +308,19 @@ def test_prefetch_early_break_retires_producer():
     while threading.active_count() > before and time.time() < deadline:
         time.sleep(0.05)
     assert threading.active_count() <= before
+
+
+def test_shard_batch_process_local_path_matches_device_put(monkeypatch):
+    """The multi-host branch of shard_batch (make_array_from_process_local_data
+    with an explicit global_shape) must place identical values to the
+    single-process device_put path."""
+    import genrec_tpu.parallel.mesh as mesh_mod
+    from genrec_tpu.parallel import get_mesh, shard_batch
+
+    mesh = get_mesh()
+    x = np.arange(64, dtype=np.float32).reshape(16, 4)
+    direct = shard_batch(mesh, {"x": x})["x"]
+    monkeypatch.setattr(mesh_mod.jax, "process_count", lambda: 2)
+    viaproc = shard_batch(mesh, {"x": x})["x"]
+    np.testing.assert_array_equal(np.asarray(direct), np.asarray(viaproc))
+    assert viaproc.sharding.spec == direct.sharding.spec
