@@ -84,10 +84,20 @@ class NoteLLMPairData:
             out[i, : len(ids)] = ids
             mask[i, : len(ids)] = 1
         P = len(rows) // 2
+        topic_of = {t: i for i, t in enumerate(
+            self.train_topics + self.eval_topics
+        )}
+        topic_id = np.repeat(
+            [topic_of[t] for t in topics], pairs_per_topic
+        ).astype(np.int32)
         return {
             "input_ids": out.reshape(P, 2, L),
             "attention_mask": mask.reshape(P, 2, L),
             "emb_idx": np.asarray(emb_idx, np.int32).reshape(P, 2, 1),
+            # Per-pair topic label: the loss masks same-topic off-diagonal
+            # entries out of the in-batch InfoNCE softmax (two pairs about
+            # one note are duplicate positives, not negatives).
+            "topic_id": topic_id,
         }
 
     def train_arrays(self, pairs_per_topic: int = 4):

@@ -49,11 +49,18 @@ def query2embedding_forward(
     alpha: float = 0.01,
     hardneg_r: float = 0.1,
     return_loss: bool = True,
+    pair_groups=None,
 ) -> Query2EmbeddingOutput:
     """Sentence embedding + paired contrastive (+ optional generation) loss.
 
     input_ids rows are interleaved pairs: even rows queries, odd rows
     positives. emb_token_idx: (B, 1) position of [EMB] per row.
+
+    pair_groups: optional (B/2,) int array of group/topic ids per pair.
+    Off-diagonal entries whose groups MATCH are masked out of the InfoNCE
+    softmax — two pairs about the same note in one batch are duplicate
+    positives, and scoring them as negatives pushes same-topic
+    embeddings apart (irreducible loss, anti-retrieval gradient).
     """
     positions = jnp.maximum(jnp.cumsum(attention_mask, axis=1) - 1, 0)
     # The LM head (L x vocab matmul) is only needed for the category
@@ -72,6 +79,11 @@ def query2embedding_forward(
     q, p = sent[::2], sent[1::2]
     sim = q @ p.T  # (B/2, B/2) already normalized
     scaled = sim * jnp.exp(tau)
+    if pair_groups is not None:
+        dup = (pair_groups[:, None] == pair_groups[None, :]) & ~jnp.eye(
+            pair_groups.shape[0], dtype=bool
+        )
+        scaled = jnp.where(dup, -1e9, scaled)
     # -log softmax diagonal (reference :170-176).
     logz = jax.nn.logsumexp(scaled, axis=1)
     neg_logp = logz - jnp.diagonal(scaled)

@@ -202,19 +202,29 @@ def train(
     chosen = [n for n in (sequence_parallel, pipeline_parallel, tensor_parallel,
                           expert_parallel)
               if n > 1]
-    if len(chosen) > 1:
+    # The ONE wired composition: tensor x expert parallelism for MoE runs
+    # (dp x model x expert — the standard MoE-LLM layout: attention
+    # Megatron-sharded, expert stacks expert-sharded; the rule sets match
+    # disjoint param paths so they concatenate).
+    tp_ep_combo = (
+        tensor_parallel > 1 and expert_parallel > 1 and num_experts > 0
+        and sequence_parallel == 1 and pipeline_parallel == 1
+    )
+    if len(chosen) > 1 and not tp_ep_combo:
         raise ValueError("pick ONE of sequence_parallel / pipeline_parallel / "
-                         "tensor_parallel / expert_parallel per run "
-                         "(composition not wired yet)")
-    if num_experts > 0 and (
-        sequence_parallel > 1 or pipeline_parallel > 1 or tensor_parallel > 1
-    ):
+                         "tensor_parallel / expert_parallel per run (the only "
+                         "wired composition is tensor_parallel x "
+                         "expert_parallel with num_experts>0)")
+    if num_experts > 0 and (sequence_parallel > 1 or pipeline_parallel > 1):
         # sp/pp run the blocks inside shard_map and do not collect the
-        # sown router-aux loss; tp's qwen_rules match Dense kernels only,
-        # so the dominant (E, D, F) expert stacks would silently stay
-        # replicated. Refuse rather than quietly degrade.
-        raise ValueError("num_experts>0 is wired for dp / expert_parallel "
-                         "runs only")
+        # sown router-aux loss. Refuse rather than quietly degrade.
+        raise ValueError("num_experts>0 is wired for dp / expert_parallel / "
+                         "tensor_parallel x expert_parallel runs only")
+    if num_experts > 0 and tensor_parallel > 1 and expert_parallel == 1:
+        # tp's qwen_rules match Dense kernels only, so the dominant
+        # (E, D, F) expert stacks would silently stay replicated.
+        raise ValueError("MoE with tensor_parallel needs expert_parallel>1 "
+                         "too (else the expert stacks stay replicated)")
     if expert_parallel > 1 and use_lora:
         # Same reasoning as tensor_parallel+LoRA below: the trainable tree
         # is the adapters, moe_rules match nothing in it, and the expert
@@ -235,7 +245,14 @@ def train(
         # rather than silently run at 1/tp throughput.
         raise ValueError("tensor_parallel with use_lora is not wired; "
                          "run LoRA data-parallel (it is already memory-light)")
-    if chosen:
+    if tp_ep_combo:
+        from genrec_tpu.parallel import make_mesh
+
+        mesh = make_mesh(
+            {"data": -1, "model": tensor_parallel, "expert": expert_parallel}
+        )
+        logger.info(f"mesh {dict(zip(mesh.axis_names, mesh.devices.shape))}")
+    elif chosen:
         from genrec_tpu.parallel import make_mesh
 
         axis = (
@@ -439,13 +456,13 @@ def train(
     step_fn = jax.jit(make_train_step(loss_fn, optimizer, clip_norm=1.0), donate_argnums=0)
     from genrec_tpu.parallel.shardings import make_place_state, moe_rules, qwen_rules
 
-    place_state = make_place_state(
-        mesh,
-        qwen_rules() if tensor_parallel > 1
+    rules = (
+        tuple(qwen_rules()) + tuple(moe_rules()) if tp_ep_combo
+        else qwen_rules() if tensor_parallel > 1
         else moe_rules() if expert_parallel > 1
-        else None,
-        log_fn=logger.info,
+        else None
     )
+    place_state = make_place_state(mesh, rules, log_fn=logger.info)
     state = place_state(TrainState.create(trainable, optimizer, state_rng))
     gen_fn = make_generate_fn(
         model, base_vocab, num_codebooks, codebook_size, beam_width,

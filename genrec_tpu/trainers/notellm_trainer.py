@@ -137,13 +137,20 @@ def train(
     schedule = cosine_schedule_with_warmup(
         learning_rate, num_warmup_steps, epochs * steps_per_epoch
     )
-    optimizer = optax.adamw(schedule, weight_decay=weight_decay)
+    # Decay only matrix-shaped weights: tau is a plain learnable scalar
+    # (reference notellm.py:170 — no decay; CLIP-style practice excludes
+    # the logit scale) and norm vectors are conventionally undecayed too.
+    optimizer = optax.adamw(
+        schedule, weight_decay=weight_decay,
+        mask=lambda p: jax.tree_util.tree_map(lambda x: jnp.ndim(x) >= 2, p),
+    )
 
     def loss_fn(p, batch, step_rng):
         flat = _flatten_pairs(batch)
         out = query2embedding_forward(
             model, p["backbone"], flat["input_ids"], flat["attention_mask"],
             flat["emb_idx"], p["tau"],
+            pair_groups=batch["topic_id"],
         )
         return out.loss, {"cl_loss": out.cl_loss}
 

@@ -196,3 +196,28 @@ def test_trainer_moe_guards():
         lcrec_trainer.train(num_experts=3, expert_parallel=2)
     with _pytest.raises(ValueError, match="dp / expert_parallel"):
         lcrec_trainer.train(num_experts=4, sequence_parallel=2)
+
+
+def test_trainer_tp_x_ep_composition(tmp_path):
+    """dp x model x expert (2x2x2): the one wired composition trains and
+    evaluates end to end."""
+    from genrec_tpu.trainers import lcrec_trainer
+
+    valid_m, test_m = lcrec_trainer.train(
+        epochs=1, batch_size=16, eval_every_epoch=1, eval_batch_size=16,
+        hidden_size=32, intermediate_size=64, n_layers=2,
+        num_heads=2, num_kv_heads=2, max_text_len=64,
+        num_experts=4, expert_parallel=2, tensor_parallel=2,
+        eval_item_tasks=False,
+        save_dir_root=str(tmp_path / "lcrec_tp_ep"),
+    )
+    assert 0.0 <= test_m["Recall@10"] <= 1.0
+
+
+def test_trainer_moe_with_tp_alone_refused():
+    import pytest as _pytest
+
+    from genrec_tpu.trainers import lcrec_trainer
+
+    with _pytest.raises(ValueError, match="expert stacks stay replicated"):
+        lcrec_trainer.train(num_experts=4, tensor_parallel=2)

@@ -136,3 +136,36 @@ def test_notellm_pairs_share_topic_and_survive_shuffle():
                 if am[side].sum() == 0:
                     continue  # padding rows of the last partial batch
                 assert pair[side][em[side, 0]] == data.emb_id
+
+
+def test_same_topic_pairs_masked_from_infonce():
+    """Two pairs about the same note in one batch must not be each
+    other's negatives: with pair_groups the duplicate's similarity is
+    masked out of the softmax, so a perfect embedding reaches ~zero loss
+    where the unmasked loss is stuck at log(2)."""
+    from genrec_tpu.models.notellm import query2embedding_forward
+    from genrec_tpu.models.backbones.qwen import QwenConfig, QwenLM
+
+    cfg = QwenConfig(
+        vocab_size=16, hidden_size=8, intermediate_size=16,
+        num_hidden_layers=1, num_attention_heads=2, num_key_value_heads=1,
+        max_position_embeddings=8, rope_theta=1e4, tie_word_embeddings=False,
+    )
+    model = QwenLM(cfg)
+    # Two pairs, SAME tokens (same topic, identical note text).
+    ids = jnp.asarray(np.tile(np.arange(4)[None], (4, 1)), jnp.int32)
+    mask = jnp.ones((4, 4), jnp.int32)
+    emb_idx = jnp.full((4, 1), 3, jnp.int32)
+    params = model.init(jax.random.key(0), ids)["params"]
+    tau = jnp.asarray(4.0, jnp.float32)
+
+    unmasked = query2embedding_forward(
+        model, params, ids, mask, emb_idx, tau
+    ).cl_loss
+    masked = query2embedding_forward(
+        model, params, ids, mask, emb_idx, tau,
+        pair_groups=jnp.asarray([7, 7], jnp.int32),
+    ).cl_loss
+    # Identical embeddings: softmax over two equal logits -> log(2).
+    np.testing.assert_allclose(float(unmasked), np.log(2.0), atol=1e-4)
+    assert float(masked) < 1e-3
