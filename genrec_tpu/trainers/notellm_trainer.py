@@ -182,7 +182,21 @@ def train(
         os.path.join(save_dir_root, "profile") if save_dir_root else "",
         profile_steps,
     )
+    from genrec_tpu.core.preemption import PreemptionGuard
+
+    guard = PreemptionGuard(logger)
     for epoch in range(start_epoch, epochs):
+        if guard.fired:
+            # Preempted (SIGTERM grace window): persist the last
+            # COMPLETED epoch and exit; resume_from_checkpoint
+            # continues from here instead of the last periodic save.
+            if ckpt is not None and epoch > start_epoch:
+                ckpt.save(epoch - 1, state)
+                ckpt.close()
+            guard.close()
+            tracker.finish()
+            logger.info(f"preempted: exiting before epoch {epoch}")
+            return {}
         epoch_loss, n_batches = None, 0
         # 2 rows per pair: count sequences, like every other trainer.
         timer = StepTimer(batch_pairs * 2, skip_first=1 if epoch == start_epoch else 0)

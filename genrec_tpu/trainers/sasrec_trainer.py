@@ -172,7 +172,21 @@ def train(
         os.path.join(save_dir_root, "profile") if save_dir_root else "",
         profile_steps,
     )
+    from genrec_tpu.core.preemption import PreemptionGuard
+
+    guard = PreemptionGuard(logger)
     for epoch in range(start_epoch, epochs):
+        if guard.fired:
+            # Preempted (SIGTERM grace window): persist the last
+            # COMPLETED epoch and exit; resume_from_checkpoint
+            # continues from here instead of the last periodic save.
+            if ckpt_mgr is not None and epoch > start_epoch:
+                ckpt_mgr.save(epoch - 1, state)
+                ckpt_mgr.close()
+            guard.close()
+            tracker.finish()
+            logger.info(f"preempted: exiting before epoch {epoch}")
+            return {}, {}
         # Device-scalar accumulation: float() only at logging boundaries so
         # the host never blocks on the jitted step (async dispatch).
         epoch_loss, n_batches = None, 0
