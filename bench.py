@@ -2,20 +2,35 @@
 
 Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
 
-Architecture (round-2 fix): the parent process never imports jax. The
-measurement runs in a child process, so a TPU backend-init failure (round 1:
-the tunnel returned UNAVAILABLE and bench.py crashed without printing
-anything) is a retryable child exit, not a crash. After two TPU attempts the
-parent falls back to a CPU-pinned child and reports the number with an
-``error`` field naming the TPU failure; if even that fails it still prints
-the JSON line with ``value: null``.
+Architecture (round-3 rework, addressing VERDICT round-2 Weak #1): the parent
+process never imports jax; the measurement runs in a child process. Three
+reliability mechanisms make the TPU number land even under tunnel flakiness
+and single-chip contention:
+
+1. **Persistent compilation cache** (`.jax_compile_cache/` at repo root,
+   written by every child): the first successful run this round compiles
+   through the tunnel once; every later child — including the driver's
+   end-of-round run — loads the executable from cache in seconds instead of
+   paying the multi-minute compile inside its timeout.
+2. **Contention-safe retry**: a child that exceeds its timeout is ABANDONED,
+   never killed (killing a process mid-TPU-backend-init wedges the axon
+   tunnel machine-wide). But an abandoned child still *holds the single
+   chip*, so spawning a sibling would race it and lose. Instead the parent
+   keeps grace-polling the abandoned child's output for an extended window —
+   a late result is salvaged. A fresh TPU child is spawned only if the
+   previous one EXITED (a crashed child does not hold the chip).
+3. **Cached-result fallback**: every successful TPU measurement is written
+   to `out/bench_tpu_last.json`. If live measurement fails entirely, the
+   parent reports that cached number (clearly marked "source": "cached-tpu",
+   with its age) rather than a meaningless CPU fallback.
 
 The reference publishes no throughput numbers (SURVEY.md §6); BASELINE.md
 sets the bar at >=3x a single-A100 running the torch reference. A single
 A100 on the reference TIGER config sustains roughly 25 steps/s at batch
 256 (conservative published-class estimate for a 6-layer enc-dec at
-seq~61); we report seq/sec/chip and vs_baseline against that estimate
-until a measured torch number replaces it.
+seq~61); we report seq/sec/chip and vs_baseline against that estimate,
+plus the ratio to the torch reference measured on this host's CPU
+(BASELINE_MEASURED.json, scripts/bench_torch_ref.py).
 """
 
 from __future__ import annotations
@@ -27,6 +42,10 @@ import sys
 import time
 
 A100_REF_SEQ_PER_SEC = 25.0 * 256  # steps/s * batch -> seq/s (estimate)
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+COMPILE_CACHE_DIR = os.path.join(REPO, ".jax_compile_cache")
+TPU_RESULT_CACHE = os.path.join(REPO, "out", "bench_tpu_last.json")
 
 # Single source of truth for the benchmarked architecture/shapes — the
 # torch-reference measurement (scripts/bench_torch_ref.py) imports these
@@ -53,6 +72,12 @@ def _measure(platform: str) -> None:
     if platform == "cpu":
         # Env alone cannot unpin the axon platform (sitecustomize).
         jax.config.update("jax_platforms", "cpu")
+    # Persistent compilation cache: the driver's end-of-round child hits
+    # executables compiled (and cached) by in-round runs, turning a
+    # multi-minute tunnel compile into a seconds-long cache load.
+    jax.config.update("jax_compilation_cache_dir", COMPILE_CACHE_DIR)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
     import jax.numpy as jnp
     import numpy as np
@@ -134,55 +159,33 @@ def _measure(platform: str) -> None:
     )
     # Headline number lands FIRST (the parent keeps the last complete
     # BENCH_RESULT line even from an abandoned child); the kernel
-    # preflight — ~4 AOT compiles through the tunnel, minutes of wall —
-    # then enriches it with a second line if it completes in time.
-    print("BENCH_RESULT " + json.dumps(result), flush=True)
+    # preflight — a few AOT compiles through the tunnel, cached after the
+    # first run — then enriches it with a second line if it completes.
+    _emit(result)
 
     if backend == "tpu":
         from genrec_tpu.kernels.preflight import run as preflight_run
 
         result["kernel_preflight"] = preflight_run(interpret=False)
-        print("BENCH_RESULT " + json.dumps(result), flush=True)
+        _emit(result)
 
 
-def _run_child(platform: str, timeout: float) -> dict | None:
-    """Spawn a measurement child; return its inner result dict or None.
+def _emit(result: dict) -> None:
+    """Print a BENCH_RESULT line and, for TPU runs, persist it atomically to
+    the cross-invocation cache file."""
+    print("BENCH_RESULT " + json.dumps(result), flush=True)
+    if result.get("backend") == "tpu":
+        try:
+            os.makedirs(os.path.dirname(TPU_RESULT_CACHE), exist_ok=True)
+            tmp = TPU_RESULT_CACHE + f".tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump({**result, "measured_at": time.time()}, f)
+            os.replace(tmp, TPU_RESULT_CACHE)
+        except OSError:
+            pass  # cache is best-effort; never fail the measurement
 
-    A child that exceeds ``timeout`` is ABANDONED, never killed: killing a
-    process mid-TPU-backend-init wedges the axon tunnel machine-wide (the
-    init then hangs for every later process). An orphan that eventually
-    acquires the chip just finishes harmlessly."""
-    import tempfile
 
-    env = dict(os.environ)
-    if platform == "cpu":
-        env["JAX_PLATFORMS"] = "cpu"
-    out = tempfile.NamedTemporaryFile(
-        mode="w+", suffix=f".bench.{platform}.log", delete=False
-    )
-    proc = subprocess.Popen(
-        [sys.executable, os.path.abspath(__file__), "--measure", platform],
-        env=env,
-        cwd=os.path.dirname(os.path.abspath(__file__)),
-        stdout=out,
-        stderr=subprocess.STDOUT,
-        text=True,
-    )
-    deadline = time.monotonic() + timeout
-    timed_out = False
-    while time.monotonic() < deadline:
-        if proc.poll() is not None:
-            break
-        time.sleep(2)
-    else:
-        timed_out = True
-        print(
-            f"bench child ({platform}) still running after {timeout}s; "
-            f"abandoning it (log: {out.name})",
-            file=sys.stderr,
-        )
-    with open(out.name) as f:
-        text = f.read()
+def _parse_results(text: str) -> dict | None:
     # The child prints the headline BENCH_RESULT before the (slow) kernel
     # preflight and an enriched line after it — keep the LAST complete
     # one, which salvages the measurement even from an abandoned child.
@@ -193,22 +196,138 @@ def _run_child(platform: str, timeout: float) -> dict | None:
                 result = json.loads(line[len("BENCH_RESULT "):])
             except ValueError:
                 pass  # torn final line from an abandoned child
-    if result is None and not timed_out:
-        sys.stderr.write(text[-2000:])
     return result
+
+
+class _Child:
+    """A measurement child whose output can be re-polled after abandonment."""
+
+    def __init__(self, platform: str):
+        import tempfile
+
+        env = dict(os.environ)
+        if platform == "cpu":
+            env["JAX_PLATFORMS"] = "cpu"
+        self.platform = platform
+        self.out = tempfile.NamedTemporaryFile(
+            mode="w+", suffix=f".bench.{platform}.log", delete=False
+        )
+        self.proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--measure", platform],
+            env=env,
+            cwd=REPO,
+            stdout=self.out,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+
+    def exited(self) -> bool:
+        return self.proc.poll() is not None
+
+    def result(self) -> dict | None:
+        with open(self.out.name) as f:
+            return _parse_results(f.read())
+
+    def wait(self, timeout: float) -> dict | None:
+        """Wait up to ``timeout`` s for a result; returns the latest parsed
+        BENCH_RESULT (which may be None). Never kills the child."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.exited():
+                break
+            # A child that already printed its headline may be grinding
+            # through kernel preflight; the headline alone is enough to
+            # stop waiting if we're past half the window.
+            if (
+                time.monotonic() + timeout / 2 > deadline
+                and self.result() is not None
+            ):
+                break
+            time.sleep(2)
+        else:
+            print(
+                f"bench child ({self.platform}) still running after "
+                f"{timeout}s; grace-polling (log: {self.out.name})",
+                file=sys.stderr,
+            )
+        res = self.result()
+        if res is None and self.exited():
+            with open(self.out.name) as f:
+                sys.stderr.write(f.read()[-2000:])
+        return res
+
+
+def _measure_tpu(budget: float = 720.0) -> dict | None:
+    """Contention-safe TPU measurement within a wall-clock budget.
+
+    One child at a time. A hung child is abandoned but grace-polled (it
+    holds the single chip; a sibling spawned alongside it could never win
+    the chip anyway). A *crashed* child frees the chip, so a fresh child is
+    spawned with the remaining budget."""
+    deadline = time.monotonic() + budget
+    child = _Child("tpu")
+    attempt = 1
+    # Phase 1: wait the initial window (generous: first-ever run compiles
+    # through the tunnel; cached runs finish in well under a minute).
+    res = child.wait(min(480.0, budget * 2 / 3))
+    while res is None and time.monotonic() < deadline:
+        if child.exited():
+            # Crash, not contention — the chip is free; retry compiles
+            # from the persistent cache so a short window suffices. Cap
+            # retries: a deterministically-crashing child (broken import)
+            # would otherwise respawn futilely for the whole budget.
+            if attempt >= 3:
+                break
+            attempt += 1
+            print(f"bench: tpu child crashed; retry #{attempt}", file=sys.stderr)
+            time.sleep(5)
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            child = _Child("tpu")
+            res = child.wait(remaining)
+        else:
+            # Hung child still holds the chip: grace-poll its log.
+            time.sleep(10)
+            res = child.result()
+    return res
+
+
+def _cached_tpu_result() -> dict | None:
+    try:
+        with open(TPU_RESULT_CACHE) as f:
+            cached = json.load(f)
+        # Full schema check: main() indexes these keys unconditionally, and
+        # the always-print-one-line contract must survive a schema-drifted
+        # or hand-edited cache file.
+        required = ("seq_per_sec", "n_chips", "step_ms", "batch_size")
+        if cached.get("backend") == "tpu" and all(
+            isinstance(cached.get(k), (int, float)) for k in required
+        ):
+            return cached
+    except (OSError, ValueError):
+        pass
+    return None
 
 
 def main():
     error = None
-    result = None
-    for attempt, timeout in enumerate((540, 180)):
-        result = _run_child("tpu", timeout=timeout)
-        if result is not None:
-            break
-        error = f"tpu measurement failed (attempt {attempt + 1}/2)"
-        time.sleep(5)
+    source = "live"
+    result = _measure_tpu()
     if result is None:
-        result = _run_child("cpu", timeout=1500)
+        error = "tpu measurement failed (hung or crashed children)"
+        cached = _cached_tpu_result()
+        if cached is not None:
+            result = cached
+            source = "cached-tpu"
+            age_h = (time.time() - cached.get("measured_at", 0)) / 3600
+            error = (
+                "live tpu measurement unavailable; reporting cached tpu "
+                f"result measured {age_h:.1f}h ago on this host"
+            )
+    if result is None:
+        child = _Child("cpu")
+        result = child.wait(timeout=1500)
         if result is not None:
             error = "tpu backend unavailable; measured on cpu fallback"
 
@@ -229,6 +348,7 @@ def main():
             backend=result["backend"],
             step_ms=round(result["step_ms"], 2),
             batch_size=result["batch_size"],
+            source=source,
         )
         if "kernel_preflight" in result:
             line["kernel_preflight"] = result["kernel_preflight"]
@@ -237,11 +357,7 @@ def main():
         # Guarded end-to-end: a corrupt artifact must never break the
         # always-print-one-line contract.
         try:
-            measured = os.path.join(
-                os.path.dirname(os.path.abspath(__file__)),
-                "BASELINE_MEASURED.json",
-            )
-            with open(measured) as f:
+            with open(os.path.join(REPO, "BASELINE_MEASURED.json")) as f:
                 ref = json.load(f)
             if ref.get("torch_cpu_seq_per_sec"):
                 same_host = ref.get("host") == host_fingerprint()
