@@ -19,10 +19,19 @@ and single-chip contention:
    keeps grace-polling the abandoned child's output for an extended window —
    a late result is salvaged. A fresh TPU child is spawned only if the
    previous one EXITED (a crashed child does not hold the chip).
-3. **Cached-result fallback**: every successful TPU measurement is written
-   to `out/bench_tpu_last.json`. If live measurement fails entirely, the
-   parent reports that cached number (clearly marked "source": "cached-tpu",
-   with its age) rather than a meaningless CPU fallback.
+3. **Liveness short-circuit**: the child prints ``BACKEND_READY <backend>``
+   the moment backend init succeeds (before any compile). If that marker
+   has not appeared within ~90s the tunnel is down (backend init normally
+   takes seconds; r01-r03 showed hung init, never slow init) and the parent
+   skips straight to the fallback ladder instead of burning the full
+   measurement window on a dead child.
+4. **Cached-result fallback ladder**: every successful TPU measurement is
+   written to `out/bench_tpu_last.json`. If live measurement fails, the
+   parent reports that cached number ("source": "cached-tpu", with its
+   age); failing that, the committed artifact `results/tpu/bench.json`
+   from the last successful hardware session ("source":
+   "cached-tpu-committed"); only with no TPU evidence at all does it fall
+   back to a CPU measurement ("source": "cpu-fallback").
 
 The reference publishes no throughput numbers (SURVEY.md §6); BASELINE.md
 sets the bar at >=3x a single-A100 running the torch reference. A single
@@ -46,6 +55,10 @@ A100_REF_SEQ_PER_SEC = 25.0 * 256  # steps/s * batch -> seq/s (estimate)
 REPO = os.path.dirname(os.path.abspath(__file__))
 COMPILE_CACHE_DIR = os.path.join(REPO, ".jax_compile_cache")
 TPU_RESULT_CACHE = os.path.join(REPO, "out", "bench_tpu_last.json")
+TPU_RESULT_COMMITTED = os.path.join(REPO, "results", "tpu", "bench.json")
+# Backend init over a live tunnel takes seconds; every observed failure
+# mode (r01-r03) is a hang or an UNAVAILABLE crash, never a slow success.
+PROBE_WINDOW_S = 90.0
 
 # Single source of truth for the benchmarked architecture/shapes — the
 # torch-reference measurement (scripts/bench_torch_ref.py) imports these
@@ -84,6 +97,9 @@ def _measure(platform: str) -> None:
     import optax
 
     backend = jax.default_backend()
+    # Liveness marker: the parent treats its absence after PROBE_WINDOW_S
+    # as a dead tunnel and short-circuits to the fallback ladder.
+    print(f"BACKEND_READY {backend}", flush=True)
     result: dict = {"backend": backend, "n_chips": jax.device_count()}
 
     from genrec_tpu.core.harness import make_train_step
@@ -228,19 +244,41 @@ class _Child:
         with open(self.out.name) as f:
             return _parse_results(f.read())
 
-    def wait(self, timeout: float) -> dict | None:
-        """Wait up to ``timeout`` s for a result; returns the latest parsed
-        BENCH_RESULT (which may be None). Never kills the child."""
+    def backend_ready(self) -> bool:
+        with open(self.out.name) as f:
+            return any(l.startswith("BACKEND_READY ") for l in f)
+
+    def wait_backend_ready(self, timeout: float = PROBE_WINDOW_S) -> bool:
+        """Liveness probe: True once the child reports backend init done.
+        False after ``timeout`` (or child exit without the marker) — the
+        tunnel is down, skip the measurement window entirely."""
         deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.backend_ready():
+                return True
+            if self.exited():
+                return False
+            time.sleep(2)
+        return self.backend_ready()
+
+    def wait(self, timeout: float, headline_grace: float = 120.0) -> dict | None:
+        """Wait up to ``timeout`` s for a result; returns the latest parsed
+        BENCH_RESULT (which may be None). Never kills the child.
+
+        Once the headline BENCH_RESULT appears, only ``headline_grace``
+        more seconds are granted for the (optional) kernel-preflight
+        enrichment line — a child grinding through preflight must not
+        hold the parent for the full window."""
+        deadline = time.monotonic() + timeout
+        headline_seen_at = None
         while time.monotonic() < deadline:
             if self.exited():
                 break
-            # A child that already printed its headline may be grinding
-            # through kernel preflight; the headline alone is enough to
-            # stop waiting if we're past half the window.
+            if headline_seen_at is None and self.result() is not None:
+                headline_seen_at = time.monotonic()
             if (
-                time.monotonic() + timeout / 2 > deadline
-                and self.result() is not None
+                headline_seen_at is not None
+                and time.monotonic() > headline_seen_at + headline_grace
             ):
                 break
             time.sleep(2)
@@ -267,6 +305,22 @@ def _measure_tpu(budget: float = 720.0) -> dict | None:
     deadline = time.monotonic() + budget
     child = _Child("tpu")
     attempt = 1
+    # Phase 0: liveness probe. No BACKEND_READY within the probe window
+    # means the tunnel is down (init hangs or crashes; it is never slow) —
+    # short-circuit to the fallback ladder instead of burning the full
+    # measurement window. The abandoned child is left running: killing a
+    # process mid-backend-init wedges the tunnel machine-wide.
+    if not child.wait_backend_ready():
+        if not child.exited():
+            print(
+                "bench: tpu backend init not ready after "
+                f"{PROBE_WINDOW_S}s; tunnel presumed down "
+                f"(log: {child.out.name})",
+                file=sys.stderr,
+            )
+            return None
+        # Child exited without the marker: init *crashed* (chip free).
+        # Fall through to the crash-retry loop below with res=None.
     # Phase 1: wait the initial window (generous: first-ever run compiles
     # through the tunnel; cached runs finish in well under a minute).
     res = child.wait(min(480.0, budget * 2 / 3))
@@ -285,6 +339,22 @@ def _measure_tpu(budget: float = 720.0) -> dict | None:
             if remaining <= 0:
                 break
             child = _Child("tpu")
+            if not child.wait_backend_ready(min(PROBE_WINDOW_S, remaining)):
+                if not child.exited():
+                    print(
+                        "bench: retry tpu child backend init not ready; "
+                        f"tunnel presumed down (log: {child.out.name})",
+                        file=sys.stderr,
+                    )
+                    return None  # retry hung in init too: tunnel is down
+                # Crashed again; dump its tail (the first child's crash is
+                # reported by wait(), but this one never reaches wait()).
+                with open(child.out.name) as f:
+                    sys.stderr.write(f.read()[-2000:])
+                continue  # loop decides whether to re-retry
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
             res = child.wait(remaining)
         else:
             # Hung child still holds the chip: grace-poll its log.
@@ -299,12 +369,37 @@ def _cached_tpu_result() -> dict | None:
             cached = json.load(f)
         # Full schema check: main() indexes these keys unconditionally, and
         # the always-print-one-line contract must survive a schema-drifted
-        # or hand-edited cache file.
-        required = ("seq_per_sec", "n_chips", "step_ms", "batch_size")
+        # or hand-edited cache file. measured_at is required so the age
+        # report in main() is always meaningful.
+        required = ("seq_per_sec", "n_chips", "step_ms", "batch_size", "measured_at")
         if cached.get("backend") == "tpu" and all(
             isinstance(cached.get(k), (int, float)) for k in required
         ):
             return cached
+    except (OSError, ValueError):
+        pass
+    return None
+
+
+def _committed_tpu_result() -> dict | None:
+    """Last-resort TPU evidence: the committed artifact from the most
+    recent successful hardware session (results/tpu/bench.json). It is in
+    output-line schema (has "value", not "seq_per_sec"), so main() emits
+    it directly rather than recomputing."""
+    try:
+        with open(TPU_RESULT_COMMITTED) as f:
+            committed = json.load(f)
+        # Same discipline as _cached_tpu_result: the always-print-one-line
+        # contract must survive a drifted or hand-edited artifact, so the
+        # full output-line schema is required before emitting it verbatim.
+        numeric = ("value", "step_ms", "batch_size")
+        if (
+            committed.get("backend") == "tpu"
+            and all(isinstance(committed.get(k), (int, float)) for k in numeric)
+            and isinstance(committed.get("metric"), str)
+            and isinstance(committed.get("unit"), str)
+        ):
+            return committed
     except (OSError, ValueError):
         pass
     return None
@@ -320,15 +415,35 @@ def main():
         if cached is not None:
             result = cached
             source = "cached-tpu"
-            age_h = (time.time() - cached.get("measured_at", 0)) / 3600
+            age_h = (time.time() - cached["measured_at"]) / 3600
             error = (
                 "live tpu measurement unavailable; reporting cached tpu "
                 f"result measured {age_h:.1f}h ago on this host"
             )
     if result is None:
+        committed = _committed_tpu_result()
+        if committed is not None:
+            # Output-line schema already: emit directly, relabeled. The
+            # stale kernel_preflight and host-ratio fields are dropped —
+            # they were measured in the committed session, not now.
+            stale = {
+                "kernel_preflight", "tpu_vs_torch_cpu",
+                "vs_torch_cpu_same_host", "vs_torch_cpu_other_host",
+            }
+            line = {k: v for k, v in committed.items() if k not in stale}
+            line["source"] = "cached-tpu-committed"
+            line["error"] = (
+                "live tpu measurement unavailable and no in-round cache; "
+                "reporting the committed artifact from the last successful "
+                "hardware session (results/tpu/bench.json)"
+            )
+            print(json.dumps(line))
+            return
+    if result is None:
         child = _Child("cpu")
         result = child.wait(timeout=1500)
         if result is not None:
+            source = "cpu-fallback"
             error = "tpu backend unavailable; measured on cpu fallback"
 
     line: dict = {
@@ -350,7 +465,9 @@ def main():
             batch_size=result["batch_size"],
             source=source,
         )
-        if "kernel_preflight" in result:
+        # A preflight from the in-round cache is stale in the same way the
+        # committed one is — only a LIVE run's preflight is current.
+        if "kernel_preflight" in result and source == "live":
             line["kernel_preflight"] = result["kernel_preflight"]
         # MEASURED baseline: scripts/bench_torch_ref.py times the torch
         # reference on this host's CPU and writes BASELINE_MEASURED.json.

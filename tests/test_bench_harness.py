@@ -10,8 +10,6 @@ import importlib.util
 import json
 import os
 
-import pytest
-
 spec = importlib.util.spec_from_file_location(
     "bench", os.path.join(os.path.dirname(__file__), "..", "bench.py")
 )
@@ -73,5 +71,127 @@ def test_cached_tpu_result_roundtrip(tmp_path, monkeypatch):
         "backend": "tpu", "seq_per_sec": 5.0, "n_chips": 1,
         "step_ms": 16.0, "batch_size": 256, "measured_at": 1.0,
     }
+    no_timestamp = {k: v for k, v in good.items() if k != "measured_at"}
+    cache.write_text(json.dumps(no_timestamp))
+    assert bench._cached_tpu_result() is None  # age report needs measured_at
     cache.write_text(json.dumps(good))
     assert bench._cached_tpu_result() == good
+
+
+def test_committed_tpu_result_schema(tmp_path, monkeypatch):
+    committed = tmp_path / "bench.json"
+    monkeypatch.setattr(bench, "TPU_RESULT_COMMITTED", str(committed))
+    assert bench._committed_tpu_result() is None  # missing
+    committed.write_text("{corrupt")
+    assert bench._committed_tpu_result() is None  # corrupt
+    committed.write_text(json.dumps({"backend": "cpu", "value": 16.4}))
+    assert bench._committed_tpu_result() is None  # wrong backend
+    committed.write_text(json.dumps({"backend": "tpu", "value": 16.4}))
+    assert bench._committed_tpu_result() is None  # partial schema
+    good = {
+        "metric": "tiger_train_seq_per_sec_per_chip", "value": 15549.34,
+        "unit": "seq/s/chip", "backend": "tpu", "step_ms": 16.46,
+        "batch_size": 256, "kernel_preflight": {"ok": True},
+    }
+    committed.write_text(json.dumps(good))
+    assert bench._committed_tpu_result() == good
+
+
+def test_main_falls_back_to_committed_artifact(tmp_path, monkeypatch, capsys):
+    """With no live TPU and no in-round cache, main() must emit the
+    committed artifact relabeled cached-tpu-committed — never a CPU line."""
+    monkeypatch.setattr(bench, "_measure_tpu", lambda *a, **k: None)
+    monkeypatch.setattr(bench, "TPU_RESULT_CACHE", str(tmp_path / "absent.json"))
+    committed = tmp_path / "bench.json"
+    committed.write_text(json.dumps({
+        "metric": "tiger_train_seq_per_sec_per_chip", "value": 15549.34,
+        "unit": "seq/s/chip", "vs_baseline": 2.43, "backend": "tpu",
+        "step_ms": 16.46, "batch_size": 256,
+        "kernel_preflight": {"ok": True}, "tpu_vs_torch_cpu": 580.98,
+    }))
+    monkeypatch.setattr(bench, "TPU_RESULT_COMMITTED", str(committed))
+    bench.main()
+    line = json.loads(capsys.readouterr().out)
+    assert line["backend"] == "tpu"
+    assert line["value"] == 15549.34
+    assert line["source"] == "cached-tpu-committed"
+    assert "kernel_preflight" not in line  # stale preflight dropped
+    assert "tpu_vs_torch_cpu" not in line  # stale host ratio dropped
+    assert "error" in line
+
+
+def _fake_child_cls(behaviors):
+    """behaviors: list consumed per spawn; each is 'hang' | 'crash' | dict."""
+
+    class FakeChild:
+        spawned = 0
+
+        def __init__(self, platform):
+            FakeChild.spawned += 1
+            self.behavior = behaviors.pop(0) if behaviors else "hang"
+            self.out = type("O", (), {"name": os.devnull})()
+
+        def wait_backend_ready(self, timeout=0):
+            return isinstance(self.behavior, dict)
+
+        def exited(self):
+            return self.behavior == "crash"
+
+        def result(self):
+            return self.behavior if isinstance(self.behavior, dict) else None
+
+        def wait(self, timeout, headline_grace=0):
+            return self.result()
+
+    return FakeChild
+
+
+def test_measure_tpu_short_circuits_on_hung_init(monkeypatch):
+    """A child that never reports BACKEND_READY must not burn the full
+    measurement window — the probe returns None fast."""
+    fake = _fake_child_cls(["hang"])
+    monkeypatch.setattr(bench, "_Child", fake)
+    t0 = __import__("time").monotonic()
+    assert bench._measure_tpu(budget=720.0) is None
+    assert __import__("time").monotonic() - t0 < 5  # no 480s wait
+    assert fake.spawned == 1  # and no sibling spawned against a held chip
+
+
+def test_measure_tpu_retries_crashed_children_with_cap(monkeypatch):
+    fake = _fake_child_cls(["crash", "crash", "crash", "crash"])
+    monkeypatch.setattr(bench, "_Child", fake)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    assert bench._measure_tpu(budget=720.0) is None
+    assert fake.spawned <= 3  # retry cap holds
+
+
+def test_measure_tpu_crash_then_success(monkeypatch):
+    good = {"backend": "tpu", "seq_per_sec": 100.0, "n_chips": 1}
+    fake = _fake_child_cls(["crash", good])
+    monkeypatch.setattr(bench, "_Child", fake)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    assert bench._measure_tpu(budget=720.0) == good
+
+
+def test_main_cpu_fallback_labels_source(tmp_path, monkeypatch, capsys):
+    monkeypatch.setattr(bench, "_measure_tpu", lambda *a, **k: None)
+    monkeypatch.setattr(bench, "TPU_RESULT_CACHE", str(tmp_path / "a.json"))
+    monkeypatch.setattr(bench, "TPU_RESULT_COMMITTED", str(tmp_path / "b.json"))
+
+    class FakeChild:
+        def __init__(self, platform):
+            assert platform == "cpu"
+
+        def wait(self, timeout):
+            return {
+                "backend": "cpu", "n_chips": 1, "seq_per_sec": 16.0,
+                "step_ms": 2000.0, "batch_size": 32,
+                "kernel_preflight": {"ok": True},  # hypothetical: must be dropped
+            }
+
+    monkeypatch.setattr(bench, "_Child", FakeChild)
+    bench.main()
+    line = json.loads(capsys.readouterr().out)
+    assert line["source"] == "cpu-fallback"
+    assert line["backend"] == "cpu"
+    assert "kernel_preflight" not in line  # only live TPU preflights are current
