@@ -245,8 +245,12 @@ class _Child:
             return _parse_results(f.read())
 
     def backend_ready(self) -> bool:
+        # The marker must name THIS child's platform: a tpu child that
+        # silently fell back to CPU must read as not-ready so the ladder
+        # reports cached TPU evidence instead of a mislabeled live number.
+        want = f"BACKEND_READY {self.platform}"
         with open(self.out.name) as f:
-            return any(l.startswith("BACKEND_READY ") for l in f)
+            return any(l.strip() == want for l in f)
 
     def wait_backend_ready(self, timeout: float = PROBE_WINDOW_S) -> bool:
         """Liveness probe: True once the child reports backend init done.
@@ -310,7 +314,7 @@ def _measure_tpu(budget: float = 720.0) -> dict | None:
     # short-circuit to the fallback ladder instead of burning the full
     # measurement window. The abandoned child is left running: killing a
     # process mid-backend-init wedges the tunnel machine-wide.
-    if not child.wait_backend_ready():
+    if not child.wait_backend_ready(min(PROBE_WINDOW_S, budget)):
         if not child.exited():
             print(
                 "bench: tpu backend init not ready after "
@@ -360,6 +364,11 @@ def _measure_tpu(budget: float = 720.0) -> dict | None:
             # Hung child still holds the chip: grace-poll its log.
             time.sleep(10)
             res = child.result()
+    if res is not None and res.get("backend") != "tpu":
+        # Child fell back to another backend (e.g. unpinned jax chose
+        # CPU): not a TPU measurement — let the ladder report real TPU
+        # evidence instead.
+        return None
     return res
 
 

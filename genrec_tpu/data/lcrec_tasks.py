@@ -579,6 +579,7 @@ def synthetic_lcrec_data(
     codebook_size: int = 8,
     num_codebooks: int = 3,
     seed: int = 0,
+    task_weights=DEFAULT_TASK_WEIGHTS,
     **seq_kwargs,
 ):
     from genrec_tpu.data.sem_ids import random_unique_sem_ids
@@ -596,7 +597,10 @@ def synthetic_lcrec_data(
     ]
     words = sorted({w for t in item_texts for w in t.split()} | _template_words())
     tok = WordTokenizer(words, num_codebooks, codebook_size)
-    return LCRecTaskData(ds.sequences, sem_ids, item_texts, tok), tok
+    data = LCRecTaskData(
+        ds.sequences, sem_ids, item_texts, tok, task_weights=task_weights
+    )
+    return data, tok
 
 
 def load_lcrec_item_meta(root: str, split: str):

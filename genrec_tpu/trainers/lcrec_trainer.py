@@ -178,11 +178,20 @@ def train(
     dataset_folder="dataset/amazon",
     split="beauty",
     sem_ids_path=None,
+    # Sampling weights over data.lcrec_tasks.TASKS (seqrec, item2index,
+    # index2item, fusionseqrec, itemsearch, preferenceobtain); None = the
+    # reference's default mix. The debug config pins seqrec-only, matching
+    # reference AmazonLCRecDataset.enabled_tasks=["seqrec"].
+    task_weights=None,
     eval_item_tasks=True,
     eval_items_limit=256,
     index2item_max_new=16,
     do_eval=True,
     eval_only=False,
+    # Debug fast mode (reference lcrec_trainer.py:283, 327-333 /
+    # lcrec_debug.gin): 0 = no limit.
+    max_train_samples=0,
+    max_eval_samples=0,
     resume_from_checkpoint=False,
     eval_every_epoch=2,
     eval_batch_size=16,
@@ -271,8 +280,12 @@ def train(
     init_rng, vocab_rng, state_rng = jax.random.split(rng, 3)
 
     if dataset == "synthetic":
+        extra = {}
+        if task_weights is not None:
+            extra["task_weights"] = tuple(task_weights)
         data, tok = synthetic_lcrec_data(
-            codebook_size=codebook_size, num_codebooks=num_codebooks, seed=seed
+            codebook_size=codebook_size, num_codebooks=num_codebooks, seed=seed,
+            **extra,
         )
         data.max_len = max_text_len
         # Backbone vocab covers words only; codebook tokens are appended by
@@ -302,9 +315,12 @@ def train(
             from transformers import AutoTokenizer
 
             hf_tok = AutoTokenizer.from_pretrained(pretrained_path)
+        extra = {}
+        if task_weights is not None:
+            extra["task_weights"] = tuple(task_weights)
         data, tok = amazon_lcrec_data(
             dataset_folder, split, sem_ids_path,
-            tokenizer=hf_tok, max_len=max_text_len, seed=seed,
+            tokenizer=hf_tok, max_len=max_text_len, seed=seed, **extra,
         )
         num_codebooks = int(data.sem_ids.shape[1])
         codebook_size = int(tok.codebook_size)
@@ -401,6 +417,13 @@ def train(
     train_arrays = data.train_arrays()
     valid_arrays = data.eval_arrays("valid")
     test_arrays = data.eval_arrays("test")
+    if max_train_samples > 0:
+        train_arrays = {k: v[:max_train_samples] for k, v in train_arrays.items()}
+        logger.info(f"limited train samples to {len(train_arrays['input_ids'])}")
+    if max_eval_samples > 0:
+        valid_arrays = {k: v[:max_eval_samples] for k, v in valid_arrays.items()}
+        test_arrays = {k: v[:max_eval_samples] for k, v in test_arrays.items()}
+        logger.info(f"limited eval samples to {len(valid_arrays['input_ids'])}")
 
     steps_per_epoch = max(1, len(train_arrays["input_ids"]) // batch_size)
     schedule = cosine_schedule_with_warmup(

@@ -1,0 +1,11 @@
+"""Same-data training-parity harness: torch reference vs genrec_tpu.
+
+One synthetic Amazon-shaped reviews file (synth.py) is fed to BOTH the
+unmodified reference trainers (/root/reference, run_ref.py) and the
+genrec_tpu trainers (run_tpu.py) with identical hyperparameters; compare.py
+writes side-by-side Recall/NDCG curves to results/parity/.
+
+This converts the golden forward-parity tests into end-to-end TRAINING
+parity evidence — the closest achievable form of BASELINE.md's +-0.002
+target in a zero-egress environment (real Amazon dumps unreachable).
+"""

@@ -1,0 +1,76 @@
+"""Compare reference-vs-genrec_tpu parity runs and write the summary.
+
+Reads the two JSON files produced by run_ref.py / run_tpu.py, computes
+per-metric deltas, and attaches the binomial noise scale of the eval set
+(std of a recall estimate at n samples) so the deltas can be judged
+against measurement noise rather than an absolute bar: with n=2000 eval
+users, one std on a recall of ~0.4 is ~0.011 — the +-0.002 north star
+(BASELINE.md) is only resolvable at full Amazon scale (~20k eval users).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+
+METRICS = ("Recall@1", "Recall@5", "Recall@10", "NDCG@5", "NDCG@10")
+
+
+def compare(ref_path: str, tpu_path: str, n_eval: int) -> dict:
+    with open(ref_path) as f:
+        ref = json.load(f)
+    with open(tpu_path) as f:
+        tpu = json.load(f)
+
+    rows = {}
+    for m in METRICS:
+        r, t = ref["test"].get(m), tpu["test"].get(m)
+        if r is None or t is None:
+            continue
+        p = (r + t) / 2
+        noise = math.sqrt(max(p * (1 - p), 1e-9) / n_eval)
+        rows[m] = {
+            "reference": round(r, 4),
+            "genrec_tpu": round(t, 4),
+            "delta": round(t - r, 4),
+            "eval_noise_std": round(noise, 4),
+            "within_2_std": abs(t - r) <= 2 * noise,
+        }
+    return {
+        "model": ref["model"],
+        "n_eval": n_eval,
+        "hparams": ref["hparams"],
+        "test": rows,
+        "valid_curve": {
+            "reference": [
+                {m: round(e.get(m, float("nan")), 4) for m in METRICS}
+                for e in ref["valid_curve"]
+            ],
+            "genrec_tpu": [
+                {m: round(e.get(m, float("nan")), 4) for m in METRICS}
+                for e in tpu["valid_curve"]
+            ],
+        },
+        "all_within_2_std": all(r["within_2_std"] for r in rows.values()),
+    }
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--ref", required=True)
+    p.add_argument("--tpu", required=True)
+    p.add_argument("--n-eval", type=int, required=True)
+    p.add_argument("--out", required=True)
+    a = p.parse_args()
+    summary = compare(a.ref, a.tpu, a.n_eval)
+    os.makedirs(os.path.dirname(a.out), exist_ok=True)
+    with open(a.out, "w") as f:
+        json.dump(summary, f, indent=1)
+    print(json.dumps({"model": summary["model"], "all_within_2_std": summary["all_within_2_std"],
+                      "test": summary["test"]}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,24 @@
+"""Shared hyperparameters for the parity runs — one definition, both sides.
+
+Values are the reference trainers' own defaults (sasrec_trainer.py:88-95,
+hstu_trainer.py:88-95) with three parity-run adjustments: few epochs
+(CPU debug scale), eval every epoch (curves), amp off (fp32 on CPU for
+both frameworks — the published bf16 setting targets accelerators).
+"""
+
+SASREC = dict(
+    epochs=12, batch_size=128, learning_rate=1e-3, weight_decay=0.0,
+    max_seq_len=50, embed_dim=64, num_heads=2, num_blocks=2, ffn_dim=256,
+    dropout=0.2, do_eval=True, eval_every_epoch=1, eval_batch_size=256,
+    save_every_epoch=1000, amp=False,
+)
+
+HSTU = dict(
+    epochs=12, batch_size=128, learning_rate=1e-3, weight_decay=0.0,
+    max_seq_len=50, embed_dim=64, num_heads=2, num_blocks=2, dropout=0.2,
+    num_position_buckets=32, num_time_buckets=64, use_temporal_bias=True,
+    do_eval=True, eval_every_epoch=1, eval_batch_size=256,
+    save_every_epoch=1000, amp=False,
+)
+
+BY_MODEL = {"sasrec": SASREC, "hstu": HSTU}

@@ -1,0 +1,48 @@
+"""Make the torch reference importable in this environment.
+
+The reference needs ``gin`` and ``wandb``, neither of which is installed
+here. The parity driver never uses either (hyperparameters are passed as
+explicit kwargs to train(); wandb_logging stays False), so no-op stubs
+cover the full API surface the reference touches at import time
+(gin.configurable / gin.constants_from_enum / gin.parse_config — verified
+by grep — and wandb's login/init/log/define_metric/finish).
+"""
+
+from __future__ import annotations
+
+import importlib.machinery
+import sys
+import types
+
+REFERENCE_ROOT = "/root/reference"
+
+
+def install() -> None:
+    def _stub_module(name: str) -> types.ModuleType:
+        mod = types.ModuleType(name)
+        # A real ModuleSpec so importlib.util.find_spec(name) — which
+        # accelerate uses for availability checks — doesn't choke on it.
+        mod.__spec__ = importlib.machinery.ModuleSpec(name, loader=None)
+        return mod
+
+    if "gin" not in sys.modules:
+        gin = _stub_module("gin")
+
+        def configurable(fn_or_name=None, *a, **k):
+            if callable(fn_or_name):
+                return fn_or_name  # bare @gin.configurable
+            return lambda fn: fn  # @gin.configurable("name")
+
+        gin.configurable = configurable
+        gin.constants_from_enum = configurable
+        gin.parse_config = lambda *a, **k: None
+        sys.modules["gin"] = gin
+
+    if "wandb" not in sys.modules:
+        wandb = _stub_module("wandb")
+        for name in ("login", "init", "log", "define_metric", "finish", "watch"):
+            setattr(wandb, name, lambda *a, **k: None)
+        sys.modules["wandb"] = wandb
+
+    if REFERENCE_ROOT not in sys.path:
+        sys.path.insert(0, REFERENCE_ROOT)

@@ -1,0 +1,57 @@
+"""One-command parity pipeline: synth data -> reference run -> genrec_tpu
+run -> comparison summary, per model, into results/parity/.
+
+Each stage runs in its OWN subprocess: the reference must import torch
+without jax platform pinning, genrec_tpu must repin jax to CPU, and
+configlib/gin keep global state — process isolation sidesteps all three.
+
+Usage: python -m scripts.parity.run_all [--models sasrec hstu] [--epochs 12]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _run(argv: list[str]) -> None:
+    print("+", " ".join(argv), file=sys.stderr, flush=True)
+    subprocess.run(argv, cwd=REPO, check=True)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--models", nargs="+", default=["sasrec", "hstu"])
+    p.add_argument("--epochs", type=int, default=12)
+    p.add_argument("--root", default="/tmp/genrec_parity_data")
+    p.add_argument("--out-dir", default="results/parity")
+    a = p.parse_args()
+
+    from scripts.parity import synth
+
+    synth.generate(a.root)
+    # Eval-set size = users with len>=3 sequences = all of them.
+    n_eval = synth.N_USERS
+
+    py = [sys.executable, "-m"]
+    for model in a.models:
+        ref_out = os.path.join(a.out_dir, f"ref_{model}.json")
+        tpu_out = os.path.join(a.out_dir, f"tpu_{model}.json")
+        summary = os.path.join(a.out_dir, f"{model}_summary.json")
+        _run(py + ["scripts.parity.run_ref", model, "--root", a.root,
+                   "--out", ref_out, "--epochs", str(a.epochs)])
+        _run(py + ["scripts.parity.run_tpu", model, "--root", a.root,
+                   "--out", tpu_out, "--epochs", str(a.epochs)])
+        _run(py + ["scripts.parity.compare", "--ref", ref_out, "--tpu", tpu_out,
+                   "--n-eval", str(n_eval), "--out", summary])
+        with open(os.path.join(REPO, summary)) as f:
+            print(json.dumps(json.load(f)["test"], indent=1))
+
+
+if __name__ == "__main__":
+    main()

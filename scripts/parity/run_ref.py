@@ -1,0 +1,83 @@
+"""Drive the UNMODIFIED torch reference trainer on the shared synthetic data.
+
+The reference's own train() (genrec/trainers/sasrec_trainer.py:87-209,
+hstu_trainer.py:86-209) runs end to end — dataset parsing, DDP-ready
+Accelerator, epoch loop, best-model selection, final test eval. The only
+instrumentation is a recording wrapper around the module's ``evaluate`` so
+the per-epoch valid metrics and the final test metrics land in a JSON file
+(the reference only logs them to its logfile).
+
+Usage: python -m scripts.parity.run_ref sasrec --root dataset/parity \
+           --out results/parity/ref_sasrec.json [--epochs N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import tempfile
+
+from . import hparams, ref_stubs
+
+
+def run_model(model: str, root: str, split: str, out_path: str, epochs: int | None):
+    ref_stubs.install()
+    import torch
+
+    torch.manual_seed(0)
+
+    if model == "sasrec":
+        import genrec.trainers.sasrec_trainer as T
+    elif model == "hstu":
+        import genrec.trainers.hstu_trainer as T
+    else:
+        raise ValueError(f"unsupported reference model {model!r}")
+
+    records: list[dict] = []
+    orig_eval = T.evaluate
+
+    def recording_eval(*a, **k):
+        m = orig_eval(*a, **k)
+        records.append({k2: float(v) for k2, v in m.items()})
+        return m
+
+    T.evaluate = recording_eval
+
+    hp = dict(hparams.BY_MODEL[model])
+    if epochs:
+        hp["epochs"] = epochs
+    with tempfile.TemporaryDirectory() as td:
+        T.train(
+            dataset_folder=root, split=split, save_dir_root=td,
+            wandb_logging=False, **hp,
+        )
+
+    # train() calls evaluate once per epoch on valid, then once on test
+    # (with the best-valid-Recall@10 weights restored).
+    out = {
+        "model": model,
+        "framework": "torch-reference",
+        "hparams": hp,
+        "valid_curve": records[:-1],
+        "test": records[-1] if records else {},
+    }
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({"model": model, "framework": "torch-reference", "test": out["test"]}))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("model", choices=["sasrec", "hstu"])
+    p.add_argument("--root", default="dataset/parity")
+    p.add_argument("--split", default="beauty")
+    p.add_argument("--out", required=True)
+    p.add_argument("--epochs", type=int, default=None)
+    a = p.parse_args()
+    run_model(a.model, a.root, a.split, a.out, a.epochs)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,82 @@
+"""Drive the genrec_tpu trainer on the shared synthetic data (CPU backend).
+
+Calls the real trainer train() with the SAME hyperparameters as
+run_ref.py (scripts/parity/hparams.py) and extracts the per-epoch valid
+curve from the Tracker's metrics.jsonl plus the returned final metrics.
+
+Usage: python -m scripts.parity.run_tpu sasrec --root dataset/parity \
+           --out results/parity/tpu_sasrec.json [--epochs N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+
+def run_model(model: str, root: str, split: str, out_path: str, epochs: int | None):
+    # sitecustomize pins JAX_PLATFORMS=axon at interpreter start; the env
+    # var alone cannot unpin it (see tests/conftest.py).
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from scripts.parity import hparams
+
+    if model == "sasrec":
+        from genrec_tpu.trainers.sasrec_trainer import train
+    elif model == "hstu":
+        from genrec_tpu.trainers.hstu_trainer import train
+    else:
+        raise ValueError(f"unsupported model {model!r}")
+
+    hp = dict(hparams.BY_MODEL[model])
+    if epochs:
+        hp["epochs"] = epochs
+    save_dir = os.path.join(os.path.dirname(out_path) or ".", f"tpu_{model}_rundir")
+    valid_metrics, test_metrics = train(
+        dataset="amazon", dataset_folder=root, split=split,
+        save_dir_root=save_dir, wandb_logging=False, seed=0, **hp,
+    )
+
+    curve = []
+    with open(os.path.join(save_dir, "metrics.jsonl")) as f:
+        for line in f:
+            rec = json.loads(line)
+            if "eval/Recall@10" in rec:
+                curve.append(
+                    {
+                        k.removeprefix("eval/"): v
+                        for k, v in rec.items()
+                        if k.startswith("eval/")
+                    }
+                )
+
+    out = {
+        "model": model,
+        "framework": "genrec_tpu",
+        "hparams": hp,
+        "valid_curve": curve,
+        "valid_final": valid_metrics,
+        "test": test_metrics,
+    }
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({"model": model, "framework": "genrec_tpu", "test": test_metrics}))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("model", choices=["sasrec", "hstu"])
+    p.add_argument("--root", default="dataset/parity")
+    p.add_argument("--split", default="beauty")
+    p.add_argument("--out", required=True)
+    p.add_argument("--epochs", type=int, default=None)
+    a = p.parse_args()
+    run_model(a.model, a.root, a.split, a.out, a.epochs)
+
+
+if __name__ == "__main__":
+    main()

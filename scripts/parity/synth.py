@@ -1,0 +1,88 @@
+"""Synthetic Amazon-2014-shaped reviews with learnable sequence structure.
+
+Writes ``<root>/raw/<split>/reviews_Beauty_5.json.gz`` in the exact record
+shape both data layers parse (reference genrec/data/amazon_sasrec.py:53-66;
+ours genrec_tpu/data/amazon.py:load_sequences): JSON lines with asin /
+reviewerID / unixReviewTime. Because both sides assign item ids by first
+appearance over the same file stream, the integer sequences they build are
+identical — the two frameworks then train on literally the same data.
+
+Structure (so Recall@10 is far above the 10/n_items random floor): items
+live in clusters; each user prefers 2-3 clusters; the next item's cluster
+follows a sticky Markov transition over the user's preferred clusters and
+the item within a cluster follows a Zipf-ish popularity law. A model that
+learns "stay near the current cluster, prefer popular items" reaches
+R@10 >> random; an untrained or broken model cannot.
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import os
+
+import numpy as np
+
+# Module-level so run_ref/run_tpu agree on shapes without re-parsing.
+N_ITEMS = 300
+N_CLUSTERS = 12
+N_USERS = 2000
+MIN_LEN, MAX_LEN = 5, 28
+STAY_P, PREF_P = 0.55, 0.35  # remaining 0.10 = uniform exploration
+
+
+def generate(root: str, split: str = "beauty", seed: int = 7) -> str:
+    """Write the reviews gzip (idempotent) and return its path."""
+    fname = {
+        "beauty": "reviews_Beauty_5.json.gz",
+        "sports": "reviews_Sports_and_Outdoors_5.json.gz",
+        "toys": "reviews_Toys_and_Games_5.json.gz",
+    }[split]
+    path = os.path.join(root, "raw", split, fname)
+    if os.path.exists(path):
+        return path
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+
+    rng = np.random.default_rng(seed)
+    per_cluster = N_ITEMS // N_CLUSTERS
+    # Zipf-ish within-cluster popularity, shared by all clusters.
+    pop = 1.0 / (np.arange(per_cluster) + 5.0)
+    pop /= pop.sum()
+
+    records = []
+    for u in range(N_USERS):
+        n_pref = rng.integers(2, 4)
+        prefs = rng.choice(N_CLUSTERS, size=n_pref, replace=False)
+        length = int(rng.integers(MIN_LEN, MAX_LEN + 1))
+        cluster = int(rng.choice(prefs))
+        t = int(rng.integers(1.3e9, 1.4e9))
+        for _ in range(length):
+            r = rng.random()
+            if r < STAY_P:
+                pass  # stay in the current cluster
+            elif r < STAY_P + PREF_P:
+                cluster = int(rng.choice(prefs))
+            else:
+                cluster = int(rng.integers(N_CLUSTERS))
+            item = cluster * per_cluster + int(rng.choice(per_cluster, p=pop))
+            records.append(
+                {
+                    "reviewerID": f"U{u:05d}",
+                    "asin": f"I{item:05d}",
+                    "unixReviewTime": t,
+                    "overall": 5.0,
+                }
+            )
+            t += int(rng.integers(3600, 86400))  # strictly increasing: no ties
+
+    with gzip.open(path, "wt", encoding="utf-8") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+    return path
+
+
+if __name__ == "__main__":
+    import sys
+
+    root = sys.argv[1] if len(sys.argv) > 1 else "dataset/parity"
+    print(generate(root))

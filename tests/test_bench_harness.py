@@ -165,6 +165,16 @@ def test_measure_tpu_retries_crashed_children_with_cap(monkeypatch):
     assert fake.spawned <= 3  # retry cap holds
 
 
+def test_measure_tpu_rejects_backend_fallback_result(monkeypatch):
+    """A 'tpu' child whose jax silently chose another backend must not be
+    reported as a live TPU measurement."""
+    sneaky = {"backend": "cpu", "seq_per_sec": 16.0, "n_chips": 1}
+    fake = _fake_child_cls([sneaky])
+    monkeypatch.setattr(bench, "_Child", fake)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    assert bench._measure_tpu(budget=720.0) is None
+
+
 def test_measure_tpu_crash_then_success(monkeypatch):
     good = {"backend": "tpu", "seq_per_sec": 100.0, "n_chips": 1}
     fake = _fake_child_cls(["crash", good])

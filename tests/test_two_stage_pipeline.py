@@ -35,6 +35,14 @@ def amazon_root(tmp_path_factory):
                     "asin": f"B{int(rng.integers(n_items)):04d}",
                     "unixReviewTime": t0 + j * 86400,
                 }) + "\n")
+    with gzip.open(raw / "meta_Beauty.json.gz", "wt") as f:
+        for i in range(n_items):
+            f.write(json.dumps({
+                "asin": f"B{i:04d}",
+                "title": f"Product {i}",
+                "brand": f"Brand{i % 5}",
+                "categories": [["Beauty", f"Cat{i % 7}"]],
+            }) + "\n")
     return str(root)
 
 
@@ -84,6 +92,70 @@ def test_rqvae_then_tiger(amazon_root, tmp_path):
     )
     assert 0.0 <= test_m["Recall@10"] <= 1.0
     assert os.path.isdir(tmp_path / "tiger" / "best_model")
+
+
+def test_lcrec_two_stage_from_shipped_configs(amazon_root, tmp_path):
+    """Both LCRec stages launched from the SHIPPED configs
+    (config/lcrec/amazon/rqvae.gin + lcrec_debug.gin), shrunk to fixture
+    scale by --gin overrides. Pins the 5-codebook stage-1 parity settings
+    (reference config/lcrec/amazon/rqvae.gin) and the debug fast mode
+    (reference lcrec_debug.gin:22-25)."""
+    import numpy as np
+
+    from genrec_tpu import pipelines
+    from genrec_tpu.configlib import clear_bindings
+    from genrec_tpu.data.amazon import load_sequences
+    from genrec_tpu.data.items import SyntheticItemEmbeddings
+    from genrec_tpu.data.sem_ids import load_sem_ids
+
+    clear_bindings()
+    _, _, num_items = load_sequences(amazon_root, "beauty", download=False)
+    emb = SyntheticItemEmbeddings(num_items=num_items, dim=24, n_clusters=6,
+                                  seed=0).embeddings
+    proc = os.path.join(amazon_root, "processed")
+    np.save(os.path.join(proc, "beauty_item_emb.npy"), emb)
+
+    valid_m, test_m = pipelines.main([
+        "lcrec",
+        "--rqvae-config", "config/lcrec/amazon/rqvae.gin",
+        "--model-config", "config/lcrec/amazon/lcrec_debug.gin",
+        "--split", "beauty",
+        "--workdir", str(tmp_path / "wd"),
+        "--gin", f"train.dataset_folder='{amazon_root}'",
+        "--gin", "train.wandb_logging=False",
+        # Fixture-scale shrink for stage 1 (keeps n_layers=5 / STE+SINKHORN
+        # from the shipped config).
+        "--rqvae-gin", "train.epochs=3",
+        "--rqvae-gin", "train.warmup_epochs=0",
+        "--rqvae-gin", "train.batch_size=16",
+        "--rqvae-gin", "train.vae_input_dim=24",
+        "--rqvae-gin", "train.vae_hidden_dims=[32]",
+        "--rqvae-gin", "train.vae_embed_dim=8",
+        "--rqvae-gin", "train.vae_codebook_size=8",
+        "--rqvae-gin", "train.kmeans_warmup_rows=200",
+        "--rqvae-gin", "train.do_eval=False",
+        "--rqvae-gin", f"train.save_dir_root='{tmp_path}/rq'",
+        # Fixture-scale shrink for stage 2 (keeps max_train/eval_samples
+        # semantics and seqrec-only task weights from the shipped config).
+        "--model-gin", "train.pretrained_path=None",
+        "--model-gin", "train.epochs=1",
+        "--model-gin", "train.batch_size=8",
+        "--model-gin", "train.max_text_len=96",
+        "--model-gin", "train.num_warmup_steps=2",
+        "--model-gin", "train.hidden_size=32",
+        "--model-gin", "train.intermediate_size=64",
+        "--model-gin", "train.n_layers=2",
+        "--model-gin", "train.num_heads=4",
+        "--model-gin", "train.num_kv_heads=2",
+        "--model-gin", "train.beam_width=4",
+        "--model-gin", "train.max_train_samples=64",
+        "--model-gin", "train.max_eval_samples=8",
+        "--model-gin", "train.eval_batch_size=8",
+        "--model-gin", f"train.save_dir_root='{tmp_path}/lc'",
+    ])
+    sem_ids, K = load_sem_ids(str(tmp_path / "wd" / "beauty" / "sem_ids.npz"))
+    assert sem_ids.shape == (num_items, 5) and K == 8  # 5 codebooks shipped
+    assert isinstance(test_m, dict) and "Recall@10" in test_m
 
 
 def test_pipeline_runner_cli(tmp_path):
