@@ -15,9 +15,14 @@ so neither bias nor the (L, L) score matrix ever round-trips to HBM.
 Bias-table lookups use a one-hot select loop over the (tiny) bucket tables
 — TPU-friendly, no dynamic gather.
 
-`hstu_attention` wraps the kernel in jax.custom_vjp with the backward pass
-taken from the XLA reference implementation (rematerialized), so the
-kernel is usable in training too.
+`hstu_attention` wraps the kernel in jax.custom_vjp with a fused Pallas
+backward (`hstu_attention_bwd_pallas`): each (batch*head, q-block) tile
+recomputes scores + biases flash-style (nothing saved but the inputs),
+then emits dq per tile, accumulates dk/dv into revisited output blocks
+across the sequentially-executed q-block grid dimension, and writes
+per-tile bias-table partials that XLA sums afterwards — so training,
+like inference, never materializes the (B, H, L, L) score/bias tensors
+the reference does (hstu.py:386-409).
 """
 
 from __future__ import annotations
@@ -99,6 +104,34 @@ def _round_up(x, m):
     return (x + m - 1) // m * m
 
 
+def _pad(x, target_len, axis, value=0):
+    cfg = [(0, 0)] * x.ndim
+    cfg[axis] = (0, target_len - x.shape[axis])
+    return jnp.pad(x, cfg, constant_values=value)
+
+
+def _pad_inputs(q, k, v, timestamps, padding_mask, time_table, blk_q):
+    """Shared fwd/bwd input prep: flatten (B,H) and pad L to the q-block
+    multiple and hd to the 128-lane multiple. Padded key positions are
+    masked (value=1); absent timestamps/time_table get inert zeros so the
+    operand list keeps a static shape. The forward and backward kernels
+    recompute identical scores only because they run through this ONE
+    helper."""
+    B, H, L, hd = q.shape
+    Lp = _round_up(L, blk_q)
+    hp = _round_up(hd, 128)
+    qf = _pad(_pad(q.reshape(B * H, L, hd), Lp, 1), hp, 2)
+    kf = _pad(_pad(k.reshape(B * H, L, hd), Lp, 1), hp, 2)
+    vf = _pad(_pad(v.reshape(B * H, L, hd), Lp, 1), hp, 2)
+    maskf = _pad(padding_mask.astype(jnp.int32), Lp, 1, value=1)
+    if timestamps is not None and time_table is not None:
+        tsf = _pad(timestamps.astype(jnp.int32), Lp, 1)
+    else:
+        tsf = jnp.zeros((B, Lp), jnp.int32)
+        time_table = jnp.zeros((H, 1), jnp.float32)
+    return qf, kf, vf, maskf, tsf, time_table, Lp, hp
+
+
 def hstu_attention_pallas(
     q, k, v, timestamps, padding_mask, pos_table, time_table,
     max_position_distance: int = 128, blk_q: int = 128, interpret: bool = False,
@@ -119,25 +152,9 @@ def hstu_attention_pallas(
     # Mosaic compiles only on TPU; elsewhere fall back to the interpreter
     # so use_pallas=True models stay runnable (slowly) in CI.
     interpret = interpret or jax.default_backend() != "tpu"
-    Lp = _round_up(L, blk_q)
-    hp = _round_up(hd, 128)
-
-    def pad(x, target_len, axis, value=0):
-        cfg = [(0, 0)] * x.ndim
-        cfg[axis] = (0, target_len - x.shape[axis])
-        return jnp.pad(x, cfg, constant_values=value)
-
-    qf = pad(pad(q.reshape(B * H, L, hd), Lp, 1), hp, 2)
-    kf = pad(pad(k.reshape(B * H, L, hd), Lp, 1), hp, 2)
-    vf = pad(pad(v.reshape(B * H, L, hd), Lp, 1), hp, 2)
-    # Padded key positions must be masked.
-    maskf = pad(padding_mask.astype(jnp.int32), Lp, 1, value=1)
-    if use_time:
-        tsf = pad(timestamps.astype(jnp.int32), Lp, 1)
-    else:
-        tsf = jnp.zeros((B, Lp), jnp.int32)
-        time_table = jnp.zeros((H, 1), jnp.float32)
-
+    qf, kf, vf, maskf, tsf, time_table, Lp, hp = _pad_inputs(
+        q, k, v, timestamps, padding_mask, time_table, blk_q
+    )
     n_q = Lp // blk_q
     grid = (B * H, n_q)
 
@@ -175,6 +192,147 @@ def hstu_attention_pallas(
     return out.reshape(B, H, Lp, hp)[:, :, :L, :hd]
 
 
+def _bwd_kernel(
+    q_ref, k_ref, v_ref, do_ref, ts_ref, tsq_ref, mask_ref, ptab_ref, ttab_ref,
+    dq_ref, dk_ref, dv_ref, dpt_ref, dtt_ref,
+    *, blk_q: int, num_pos_buckets: int, num_time_buckets: int,
+    max_position_distance: int, use_time: bool,
+):
+    j = pl.program_id(1)
+    L = k_ref.shape[1]
+
+    q = q_ref[0].astype(jnp.float32)  # (blk_q, hd)
+    k = k_ref[0].astype(jnp.float32)  # (L, hd)
+    v = v_ref[0].astype(jnp.float32)  # (L, hd)
+    do = do_ref[0].astype(jnp.float32)  # (blk_q, hd)
+
+    # --- Recompute the masked scores exactly as the forward kernel does.
+    scores = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # (blk_q, L)
+    q_pos = j * blk_q + jax.lax.broadcasted_iota(jnp.int32, (blk_q, L), 0)
+    k_pos = jax.lax.broadcasted_iota(jnp.int32, (blk_q, L), 1)
+    pbucket = _pos_bucket_f(k_pos - q_pos, num_pos_buckets, max_position_distance)
+    pbias = jnp.zeros_like(scores)
+    for b in range(num_pos_buckets):
+        pbias = pbias + jnp.where(pbucket == b, ptab_ref[0, 0, b], 0.0)
+    scores = scores + pbias
+    if use_time:
+        ts = ts_ref[0]
+        t_q = tsq_ref[0]
+        tdiff = t_q.T - ts[0][None, :]
+        tbucket = _time_bucket_f(tdiff, num_time_buckets)
+        tbias = jnp.zeros_like(scores)
+        for b in range(num_time_buckets):
+            tbias = tbias + jnp.where(tbucket == b, ttab_ref[0, 0, b], 0.0)
+        scores = scores + tbias
+
+    masked = jnp.logical_or(k_pos > q_pos, mask_ref[0, 0][None, :] != 0)
+    s = jnp.where(masked, NEG, scores)
+
+    # --- Local grads. silu(s) = s*sig(s); silu'(s) = sig(s)*(1 + s*(1-sig(s))).
+    sig = jax.nn.sigmoid(s)
+    attn = s * sig  # (blk_q, L)
+    d_attn = jnp.dot(do, v.T, preferred_element_type=jnp.float32)  # (blk_q, L)
+    # Gradient at the PRE-mask scores: masked entries get exactly zero
+    # (the where() in the forward routes no gradient to them).
+    ds = jnp.where(masked, 0.0, d_attn * sig * (1.0 + s * (1.0 - sig)))
+
+    # --- Input grads. dq per tile; dk/dv accumulate across the j grid
+    # dim (sequential on TPU; the output blocks are revisited).
+    dq_ref[0] = jnp.dot(ds, k, preferred_element_type=jnp.float32)
+
+    @pl.when(j == 0)
+    def _init():
+        dk_ref[0] = jnp.zeros_like(dk_ref[0])
+        dv_ref[0] = jnp.zeros_like(dv_ref[0])
+
+    dk_ref[0] += jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
+    dv_ref[0] += jnp.dot(attn.T, do, preferred_element_type=jnp.float32)
+
+    # --- Bias-table partials for this tile (summed over tiles in XLA).
+    @pl.when(j == 0)
+    def _init_tabs():
+        dpt_ref[0] = jnp.zeros_like(dpt_ref[0])
+        if use_time:
+            dtt_ref[0] = jnp.zeros_like(dtt_ref[0])
+
+    dpt = [jnp.sum(jnp.where(pbucket == b, ds, 0.0)) for b in range(num_pos_buckets)]
+    dpt_ref[0] += jnp.stack(dpt)[None, :]
+    if use_time:
+        dtt = [
+            jnp.sum(jnp.where(tbucket == b, ds, 0.0))
+            for b in range(num_time_buckets)
+        ]
+        dtt_ref[0] += jnp.stack(dtt)[None, :]
+
+
+def hstu_attention_bwd_pallas(
+    q, k, v, timestamps, padding_mask, pos_table, time_table, g,
+    max_position_distance: int = 128, blk_q: int = 128, interpret: bool = False,
+):
+    """Fused flash-style backward. Returns (dq, dk, dv, dpos_table,
+    dtime_table) with input dtypes; accumulation is fp32 in-kernel."""
+    B, H, L, hd = q.shape
+    use_time = timestamps is not None and time_table is not None
+    interpret = interpret or jax.default_backend() != "tpu"
+    qf, kf, vf, maskf, tsf, ttab, Lp, hp = _pad_inputs(
+        q, k, v, timestamps, padding_mask, time_table, blk_q
+    )
+    gf = _pad(_pad(g.reshape(B * H, L, hd), Lp, 1), hp, 2)
+    n_q = Lp // blk_q
+    grid = (B * H, n_q)
+    nb, ntb = pos_table.shape[1], ttab.shape[1]
+
+    kernel = functools.partial(
+        _bwd_kernel,
+        blk_q=blk_q,
+        num_pos_buckets=nb,
+        num_time_buckets=ntb,
+        max_position_distance=max_position_distance,
+        use_time=use_time,
+    )
+    dq, dk, dv, dpt, dtt = pl.pallas_call(
+        kernel,
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Lp, hp), jnp.float32),  # dq
+            jax.ShapeDtypeStruct((B * H, Lp, hp), jnp.float32),  # dk
+            jax.ShapeDtypeStruct((B * H, Lp, hp), jnp.float32),  # dv
+            jax.ShapeDtypeStruct((B * H, 1, nb), jnp.float32),  # dpos partials
+            jax.ShapeDtypeStruct((B * H, 1, ntb), jnp.float32),  # dtime partials
+        ],
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, blk_q, hp), lambda i, j: (i, j, 0)),  # q block
+            pl.BlockSpec((1, Lp, hp), lambda i, j: (i, 0, 0)),  # full k
+            pl.BlockSpec((1, Lp, hp), lambda i, j: (i, 0, 0)),  # full v
+            pl.BlockSpec((1, blk_q, hp), lambda i, j: (i, j, 0)),  # dO block
+            pl.BlockSpec((1, 1, Lp), lambda i, j: (i // H, 0, 0)),  # ts (keys)
+            pl.BlockSpec((1, 1, blk_q), lambda i, j: (i // H, 0, j)),  # ts q-tile
+            pl.BlockSpec((1, 1, Lp), lambda i, j: (i // H, 0, 0)),  # padding mask
+            pl.BlockSpec((1, 1, nb), lambda i, j: (i % H, 0, 0)),
+            pl.BlockSpec((1, 1, ntb), lambda i, j: (i % H, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, blk_q, hp), lambda i, j: (i, j, 0)),  # dq per tile
+            pl.BlockSpec((1, Lp, hp), lambda i, j: (i, 0, 0)),  # dk accumulated
+            pl.BlockSpec((1, Lp, hp), lambda i, j: (i, 0, 0)),  # dv accumulated
+            pl.BlockSpec((1, 1, nb), lambda i, j: (i, 0, 0)),  # dpos accumulated
+            pl.BlockSpec((1, 1, ntb), lambda i, j: (i, 0, 0)),  # dtime accumulated
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, gf, tsf[:, None], tsf[:, None], maskf[:, None],
+      pos_table[:, None], ttab[:, None])
+
+    dq = dq.reshape(B, H, Lp, hp)[:, :, :L, :hd].astype(q.dtype)
+    dk = dk.reshape(B, H, Lp, hp)[:, :, :L, :hd].astype(k.dtype)
+    dv = dv.reshape(B, H, Lp, hp)[:, :, :L, :hd].astype(v.dtype)
+    # Per-(b,h) partials -> per-head tables (sum over the batch).
+    dpt = dpt.reshape(B, H, nb).sum(0).astype(pos_table.dtype)
+    dttab = (
+        dtt.reshape(B, H, ntb).sum(0).astype(time_table.dtype) if use_time else None
+    )
+    return dq, dk, dv, dpt, dttab
+
+
 def hstu_attention_xla(
     q, k, v, timestamps, padding_mask, pos_table, time_table,
     max_position_distance: int = 128,
@@ -203,7 +361,7 @@ def hstu_attention_xla(
 @functools.partial(jax.custom_vjp, nondiff_argnums=(7,))
 def hstu_attention(q, k, v, timestamps, padding_mask, pos_table, time_table,
                    max_position_distance=128):
-    """Kernel forward + XLA-derived backward (rematerialized)."""
+    """Kernel forward + fused flash-style Pallas backward."""
     return hstu_attention_pallas(
         q, k, v, timestamps, padding_mask, pos_table, time_table,
         max_position_distance,
@@ -219,14 +377,11 @@ def _fwd(q, k, v, timestamps, padding_mask, pos_table, time_table, mpd):
 
 def _bwd(mpd, res, g):
     q, k, v, timestamps, padding_mask, pos_table, time_table = res
-
-    def f(q, k, v, pos_table, time_table):
-        return hstu_attention_xla(
-            q, k, v, timestamps, padding_mask, pos_table, time_table, mpd
-        )
-
-    _, vjp = jax.vjp(f, q, k, v, pos_table, time_table)
-    dq, dk, dv, dpt, dtt = vjp(g)
+    dq, dk, dv, dpt, dtt = hstu_attention_bwd_pallas(
+        q, k, v, timestamps, padding_mask, pos_table, time_table, g, mpd
+    )
+    if dtt is None and time_table is not None:
+        dtt = jnp.zeros_like(time_table)
     return dq, dk, dv, None, None, dpt, dtt
 
 

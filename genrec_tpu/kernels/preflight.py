@@ -127,6 +127,59 @@ def run(interpret: bool = False) -> dict:
     except Exception as e:  # noqa: BLE001 - report, don't crash bench
         res["kernels"]["hstu_attention"] = {"ok": False, "error": repr(e)}
 
+    # --- HSTU fused backward (long-context scale: L=2048 compiled; the
+    # grads the training step actually uses) ---
+    try:
+        from genrec_tpu.kernels.hstu_attention import hstu_attention_bwd_pallas
+
+        B, H, L, D = (2, 2, 50, 32) if interpret else (2, 4, 2048, 64)
+        q, k, v, g = (
+            jnp.asarray(rng.normal(size=(B, H, L, D)), jnp.float32)
+            for _ in range(4)
+        )
+        ts = jnp.asarray(
+            np.cumsum(rng.integers(3600, 2e5, (B, L)), 1), jnp.int32
+        )
+        pad = jnp.zeros((B, L), bool)
+        pt = jnp.asarray(rng.normal(size=(H, 32)) * 0.1, jnp.float32)
+        tt = jnp.asarray(rng.normal(size=(H, 64)) * 0.1, jnp.float32)
+
+        def xla_bwd(g, q, k, v):
+            _, vjp = jax.vjp(
+                lambda q, k, v, pt, tt: hstu_attention_xla(q, k, v, ts, pad, pt, tt),
+                q, k, v, pt, tt,
+            )
+            return vjp(g)
+
+        pl_fn = jax.jit(
+            lambda g, q, k, v: hstu_attention_bwd_pallas(
+                q, k, v, ts, pad, pt, tt, g, interpret=interpret
+            )
+        )
+        got = pl_fn(g, q, k, v)
+        ref = xla_bwd(g, q, k, v)
+        err = float(
+            max(
+                np.max(np.abs(np.asarray(a) - np.asarray(b)))
+                for a, b in zip(ref, got)
+            )
+        )
+        entry = {"max_abs_err": err, "ok": bool(err < 5e-3), "seq_len": L}
+        if not interpret:
+            # dq has g's shape: chain it back as the cotangent.
+            entry["pallas_ms"] = _bench_chained(
+                lambda g, q, k, v: hstu_attention_bwd_pallas(
+                    q, k, v, ts, pad, pt, tt, g
+                )[0],
+                g, q, k, v,
+            )
+            entry["xla_ms"] = _bench_chained(
+                lambda g, q, k, v: xla_bwd(g, q, k, v)[0], g, q, k, v
+            )
+        res["kernels"]["hstu_attention_bwd"] = entry
+    except Exception as e:  # noqa: BLE001
+        res["kernels"]["hstu_attention_bwd"] = {"ok": False, "error": repr(e)}
+
     # --- RQ cascade (rqvae-scale: B2048 D32 L3 K256) ---
     try:
         Bq, Dq, Lq, Kq = (128, 16, 3, 20) if interpret else (2048, 32, 3, 256)

@@ -279,13 +279,12 @@ def train(
     rng = jax.random.key(seed)
     init_rng, vocab_rng, state_rng = jax.random.split(rng, 3)
 
+    # None = each data source's default mix.
+    tw_extra = {} if task_weights is None else {"task_weights": tuple(task_weights)}
     if dataset == "synthetic":
-        extra = {}
-        if task_weights is not None:
-            extra["task_weights"] = tuple(task_weights)
         data, tok = synthetic_lcrec_data(
             codebook_size=codebook_size, num_codebooks=num_codebooks, seed=seed,
-            **extra,
+            **tw_extra,
         )
         data.max_len = max_text_len
         # Backbone vocab covers words only; codebook tokens are appended by
@@ -315,12 +314,9 @@ def train(
             from transformers import AutoTokenizer
 
             hf_tok = AutoTokenizer.from_pretrained(pretrained_path)
-        extra = {}
-        if task_weights is not None:
-            extra["task_weights"] = tuple(task_weights)
         data, tok = amazon_lcrec_data(
             dataset_folder, split, sem_ids_path,
-            tokenizer=hf_tok, max_len=max_text_len, seed=seed, **extra,
+            tokenizer=hf_tok, max_len=max_text_len, seed=seed, **tw_extra,
         )
         num_codebooks = int(data.sem_ids.shape[1])
         codebook_size = int(tok.codebook_size)

@@ -32,15 +32,38 @@ STAY_P, PREF_P = 0.55, 0.35  # remaining 0.10 = uniform exploration
 
 
 def generate(root: str, split: str = "beauty", seed: int = 7) -> str:
-    """Write the reviews gzip (idempotent) and return its path."""
+    """Write the reviews gzip (idempotent per parameter set) and return its
+    path. A params-stamp sidecar invalidates the cache when the generator
+    constants or seed change, so a stale file can never silently feed a
+    run labeled with the new parameters."""
     fname = {
         "beauty": "reviews_Beauty_5.json.gz",
         "sports": "reviews_Sports_and_Outdoors_5.json.gz",
         "toys": "reviews_Toys_and_Games_5.json.gz",
     }[split]
     path = os.path.join(root, "raw", split, fname)
+    stamp_path = path + ".params.json"
+    stamp = json.dumps(
+        {
+            "n_items": N_ITEMS, "n_clusters": N_CLUSTERS, "n_users": N_USERS,
+            "min_len": MIN_LEN, "max_len": MAX_LEN, "stay_p": STAY_P,
+            "pref_p": PREF_P, "seed": seed,
+        },
+        sort_keys=True,
+    )
     if os.path.exists(path):
-        return path
+        try:
+            with open(stamp_path) as f:
+                if f.read() == stamp:
+                    return path
+        except OSError:
+            pass
+        os.remove(path)  # parameters changed: regenerate
+        # The genrec_tpu data layer caches parsed sequences under
+        # <root>/processed — stale alongside the old reviews file.
+        import shutil
+
+        shutil.rmtree(os.path.join(root, "processed"), ignore_errors=True)
     os.makedirs(os.path.dirname(path), exist_ok=True)
 
     rng = np.random.default_rng(seed)
@@ -78,6 +101,8 @@ def generate(root: str, split: str = "beauty", seed: int = 7) -> str:
     with gzip.open(path, "wt", encoding="utf-8") as f:
         for r in records:
             f.write(json.dumps(r) + "\n")
+    with open(stamp_path, "w") as f:
+        f.write(stamp)
     return path
 
 

@@ -87,30 +87,50 @@ def test_model_use_pallas_matches_xla_path():
     np.testing.assert_allclose(np.asarray(l_pal), np.asarray(l_ref), atol=2e-4, rtol=1e-4)
 
 
-def test_custom_vjp_grads_match_xla():
+@pytest.mark.parametrize("use_time", [True, False])
+def test_custom_vjp_grads_match_xla(use_time):
+    """Fused Pallas backward (interpret mode) vs XLA autodiff, end to end
+    through the custom_vjp op — pos/time table grads included."""
     from genrec_tpu.kernels.hstu_attention import hstu_attention
 
-    q, k, v, ts, pad, ptab, ttab = _inputs(B=1, H=2, L=16, hd=8)
+    q, k, v, ts, pad, ptab, ttab = _inputs(B=2, H=2, L=50, hd=32,
+                                           use_time=use_time)
 
-    # In interpret-safe sizes, compare grads of the custom-vjp op (pallas
-    # fwd would need TPU; here we only exercise the bwd wiring via the XLA
-    # forward) against direct XLA autodiff.
     def loss_xla(q, k, v, ptab, ttab):
         return jnp.sum(hstu_attention_xla(q, k, v, ts, pad, ptab, ttab) ** 2)
 
-    g_ref = jax.grad(loss_xla, argnums=(0, 1, 2, 3, 4))(q, k, v, ptab, ttab)
+    argnums = (0, 1, 2, 3, 4) if use_time else (0, 1, 2, 3)
+    g_ref = jax.grad(loss_xla, argnums=argnums)(q, k, v, ptab, ttab)
 
-    from genrec_tpu.kernels import hstu_attention as mod
+    def loss_k(q, k, v, ptab, ttab):
+        return jnp.sum(hstu_attention(q, k, v, ts, pad, ptab, ttab) ** 2)
 
-    orig = mod.hstu_attention_pallas
-    mod.hstu_attention_pallas = lambda *a, **kw: hstu_attention_xla(*a[:7])
-    try:
-        def loss_k(q, k, v, ptab, ttab):
-            return jnp.sum(hstu_attention(q, k, v, ts, pad, ptab, ttab) ** 2)
-
-        g_got = jax.grad(loss_k, argnums=(0, 1, 2, 3, 4))(q, k, v, ptab, ttab)
-    finally:
-        mod.hstu_attention_pallas = orig
+    g_got = jax.grad(loss_k, argnums=argnums)(q, k, v, ptab, ttab)
 
     for a, b in zip(g_ref, g_got):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, rtol=1e-4)
+
+
+def test_bwd_kernel_multiple_query_blocks():
+    """dk/dv/bias-table accumulation across the j grid dim: L=200,
+    blk_q=64 -> 4 query blocks, odd head dim, padding rows."""
+    from genrec_tpu.kernels.hstu_attention import hstu_attention_bwd_pallas
+
+    q, k, v, ts, pad, ptab, ttab = _inputs(L=200, hd=16, seed=3)
+    g = jnp.asarray(
+        np.random.default_rng(9).normal(size=q.shape), jnp.float32
+    )
+
+    def f(q, k, v, ptab, ttab):
+        return hstu_attention_xla(q, k, v, ts, pad, ptab, ttab)
+
+    _, vjp = jax.vjp(f, q, k, v, ptab, ttab)
+    ref = vjp(g)
+
+    got = hstu_attention_bwd_pallas(
+        q, k, v, ts, pad, ptab, ttab, g, blk_q=64, interpret=True
+    )
+    for a, b in zip(ref, got):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, rtol=1e-4)
