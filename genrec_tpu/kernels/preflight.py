@@ -180,6 +180,45 @@ def run(interpret: bool = False) -> dict:
     except Exception as e:  # noqa: BLE001
         res["kernels"]["hstu_attention_bwd"] = {"ok": False, "error": repr(e)}
 
+    # --- Fused linear+CE (SASRec-Amazon scale: R=B*L=6400 rows, V~12k
+    # items, d=64 — where the materialized (R, V) logits cost ~300MB of
+    # HBM traffic per direction) ---
+    try:
+        from genrec_tpu.kernels.fused_ce import (
+            fused_linear_ce,
+            fused_linear_ce_fwd,
+            linear_ce_xla,
+        )
+
+        R, V, D = (256, 1000, 48) if interpret else (6400, 12160, 64)
+        x = jnp.asarray(rng.normal(size=(R, D)), jnp.float32)
+        w = jnp.asarray(rng.normal(size=(V, D)) * 0.1, jnp.float32)
+        tgt = jnp.asarray(rng.integers(0, V, (R,)), jnp.int32)
+        got, _ = jax.jit(
+            lambda x, w: fused_linear_ce_fwd(x, w, tgt, interpret=interpret)
+        )(x, w)
+        ref = jax.jit(lambda x, w: linear_ce_xla(x, w, tgt))(x, w)
+        err = float(np.max(np.abs(np.asarray(got) - np.asarray(ref))))
+        entry = {"max_abs_err": err, "ok": bool(err < 1e-3)}
+        if not interpret:
+            # Time the TRAINING direction (fwd+bwd): grads wrt x chain
+            # back as the next iteration's x.
+            entry["pallas_ms"] = _bench_chained(
+                lambda x, w: jax.grad(
+                    lambda x: fused_linear_ce(x, w, tgt).sum()
+                )(x),
+                x, w,
+            )
+            entry["xla_ms"] = _bench_chained(
+                lambda x, w: jax.grad(
+                    lambda x: linear_ce_xla(x, w, tgt).sum()
+                )(x),
+                x, w,
+            )
+        res["kernels"]["fused_linear_ce"] = entry
+    except Exception as e:  # noqa: BLE001
+        res["kernels"]["fused_linear_ce"] = {"ok": False, "error": repr(e)}
+
     # --- RQ cascade (rqvae-scale: B2048 D32 L3 K256) ---
     try:
         Bq, Dq, Lq, Kq = (128, 16, 3, 20) if interpret else (2048, 32, 3, 256)

@@ -165,6 +165,9 @@ class HSTU(nn.Module):
     max_position_distance: int = 128
     use_temporal_bias: bool = True
     use_pallas: bool = False  # fused-bias attention kernel (TPU)
+    # Fused full-softmax CE (kernels/fused_ce.py): identical loss without
+    # materializing (B, L, V) logits; training call returns logits=None.
+    fused_ce: bool = False
     dtype: jnp.dtype = jnp.float32
 
     def setup(self):
@@ -195,8 +198,15 @@ class HSTU(nn.Module):
             x = layer(x, padding_mask, timestamps, deterministic)
 
         x = self.final_norm(x).astype(self.dtype)
-        logits = x @ self.item_embedding.T.astype(self.dtype)
+        if targets is not None and self.fused_ce:
+            from genrec_tpu.kernels.fused_ce import fused_ce_mean_loss
 
+            loss = fused_ce_mean_loss(
+                x, self.item_embedding.astype(self.dtype), targets
+            )
+            return None, loss
+
+        logits = x @ self.item_embedding.T.astype(self.dtype)
         loss = None
         if targets is not None:
             per_tok, valid = cross_entropy_with_ignore(logits, targets, ignore_index=0)

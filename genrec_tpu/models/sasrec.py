@@ -113,6 +113,11 @@ class SASRec(nn.Module):
     # Compute dtype (bf16 for TPU mixed precision); params stay fp32 and
     # softmax/CE/LayerNorm statistics are always fp32.
     dtype: jnp.dtype = jnp.float32
+    # Fused full-softmax CE (kernels/fused_ce.py): identical loss, but the
+    # (B, L, V) logits never hit HBM. Training-path only; eval still gets
+    # materialized logits (it needs them for top-k). When on, the training
+    # call returns logits=None.
+    fused_ce: bool = False
 
     def setup(self):
         xavier = nn.initializers.xavier_uniform()
@@ -146,8 +151,15 @@ class SASRec(nn.Module):
             x = x * mask  # re-mask after every block (official-impl quirk)
 
         x = self.final_norm(x)
-        logits = x.astype(self.dtype) @ self.item_embedding.T.astype(self.dtype)  # (B, L, V+1)
+        if targets is not None and self.fused_ce:
+            from genrec_tpu.kernels.fused_ce import fused_ce_mean_loss
 
+            loss = fused_ce_mean_loss(
+                x.astype(self.dtype), self.item_embedding.astype(self.dtype), targets
+            )
+            return None, loss
+
+        logits = x.astype(self.dtype) @ self.item_embedding.T.astype(self.dtype)  # (B, L, V+1)
         loss = None
         if targets is not None:
             per_tok, valid = cross_entropy_with_ignore(logits, targets, ignore_index=0)

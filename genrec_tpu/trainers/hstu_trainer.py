@@ -77,6 +77,10 @@ def train(
     max_position_distance=128,
     use_temporal_bias=True,
     use_pallas="auto",
+    # Fused full-softmax CE over the tied item-embedding head
+    # (kernels/fused_ce.py): same loss, no (B,L,V) logits in HBM.
+    # auto = on when running on TPU (Mosaic-compiled only).
+    use_fused_ce="auto",
     dataset="synthetic",
     dataset_folder="dataset/amazon",
     split="beauty",
@@ -123,6 +127,8 @@ def train(
         # The fused kernel compiles only under Mosaic; interpret mode on
         # CPU is correct but slow, so auto = TPU-only.
         use_pallas = jax.default_backend() == "tpu"
+    if use_fused_ce == "auto":
+        use_fused_ce = jax.default_backend() == "tpu"
     model = HSTU(
         num_items=n_items,
         max_seq_len=max_seq_len,
@@ -135,6 +141,7 @@ def train(
         max_position_distance=max_position_distance,
         use_temporal_bias=use_temporal_bias,
         use_pallas=bool(use_pallas),
+        fused_ce=bool(use_fused_ce),
         dtype=compute_dtype,
     )
     rng = jax.random.key(seed)

@@ -93,6 +93,10 @@ def train(
     wandb_log_interval=100,
     amp=True,
     mixed_precision_type="bf16",
+    # Fused full-softmax CE over the tied item-embedding head
+    # (kernels/fused_ce.py): same loss, no (B,L,V) logits in HBM.
+    # auto = on when running on TPU (Mosaic-compiled only).
+    use_fused_ce="auto",
     profile_steps=0,
     seed=0,
 ):
@@ -120,6 +124,8 @@ def train(
     compute_dtype = (
         jnp.bfloat16 if (amp and mixed_precision_type == "bf16") else jnp.float32
     )
+    if use_fused_ce == "auto":
+        use_fused_ce = jax.default_backend() == "tpu"
     model = SASRec(
         num_items=n_items,
         max_seq_len=max_seq_len,
@@ -128,6 +134,7 @@ def train(
         num_blocks=num_blocks,
         ffn_dim=ffn_dim,
         dropout=dropout,
+        fused_ce=bool(use_fused_ce),
         dtype=compute_dtype,
     )
     rng = jax.random.key(seed)

@@ -35,12 +35,14 @@ def run_model(model: str, root: str, split: str, out_path: str, epochs: int | No
     if epochs:
         hp["epochs"] = epochs
     save_dir = os.path.join(os.path.dirname(out_path) or ".", f"tpu_{model}_rundir")
-    # Tracker appends to metrics.jsonl; a stale file from a previous run
-    # would interleave two curves. Start fresh.
+    # Start from an empty rundir: Tracker appends to metrics.jsonl (curves
+    # would interleave) and BestTracker seeds itself from a leftover
+    # best_model.json (a stale best would be reported as THIS run's test
+    # metrics).
+    import shutil
+
+    shutil.rmtree(save_dir, ignore_errors=True)
     os.makedirs(save_dir, exist_ok=True)
-    jsonl = os.path.join(save_dir, "metrics.jsonl")
-    if os.path.exists(jsonl):
-        os.remove(jsonl)
     valid_metrics, test_metrics = train(
         dataset="amazon", dataset_folder=root, split=split,
         save_dir_root=save_dir, wandb_logging=False, seed=0, **hp,
