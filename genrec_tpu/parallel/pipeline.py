@@ -45,6 +45,37 @@ def unstack_layer_params(rest: dict, stacked, n_layers: int) -> dict:
     return out
 
 
+def stacked_param_specs(stacked, rules, pipe_axis: str, mesh, log_fn=None):
+    """PartitionSpec tree for the STACKED layer params: dim 0 (layers) is
+    sharded over ``pipe_axis``; ``rules`` (e.g. shardings.qwen_rules) are
+    matched on the leaf path with their dim index shifted by the leading
+    layer axis — the dp x tp x pp layout. Same fallback discipline as
+    shardings.param_specs: a rule-matched dim that does not divide the
+    mesh axis replicates, and ``log_fn`` reports it (silent fallback
+    would hide that "tensor parallelism" sharded nothing)."""
+    from jax.sharding import PartitionSpec as P
+
+    def spec_of(path, leaf):
+        p = "/".join(str(getattr(k, "key", k)) for k in path)
+        out = [None] * leaf.ndim
+        out[0] = pipe_axis
+        for pred, axis, mesh_axis in rules or ():
+            if pred(p) and leaf.ndim > axis + 1:
+                if leaf.shape[axis + 1] % mesh.shape[mesh_axis] == 0:
+                    out[axis + 1] = mesh_axis
+                elif log_fn is not None:
+                    log_fn(
+                        f"stacked sharding rule matched {p} but dim "
+                        f"{axis + 1} ({leaf.shape[axis + 1]}) is not "
+                        f"divisible by {mesh_axis}={mesh.shape[mesh_axis]}; "
+                        f"replicating"
+                    )
+                break
+        return P(*out)
+
+    return jax.tree_util.tree_map_with_path(spec_of, stacked)
+
+
 def make_pp_sft_loss(
     cfg,
     mesh,
@@ -53,6 +84,8 @@ def make_pp_sft_loss(
     dtype=jnp.float32,
     remat: bool = False,
     valid_vocab: int | None = None,
+    tp_rules=None,
+    log_fn=None,
 ):
     """Pipeline-parallel causal-LM SFT loss for the Qwen backbone.
 
@@ -61,6 +94,15 @@ def make_pp_sft_loss(
     by n_micro (and by the "data" axis when present), n_layers by the pipe
     size. The block stack runs under shard_map over ``pipe_axis`` with
     ppermute-forwarded activations; embed / norm / head run outside.
+
+    ``tp_rules`` (e.g. shardings.qwen_rules()) enables the 3-axis
+    dp x tp x pp layout: the shard_map goes manual over ONLY pipe/data
+    (JAX 0.9 ``axis_names``) while the "model" axis stays auto — XLA's
+    SPMD partitioner Megatron-shards the per-stage block matmuls from the
+    sharding constraints this function places on the stacked params, and
+    the out-of-pipeline embed/head matmuls likewise. No hand-written
+    model-axis collectives: the scan/ppermute schedule is identical to
+    the 1-axis pipeline.
     """
     from genrec_tpu.models.backbones.qwen import QwenBlock
     from genrec_tpu.ops.losses import cross_entropy_with_ignore
@@ -74,6 +116,10 @@ def make_pp_sft_loss(
     batch_axis = "data" if "data" in mesh.axis_names else None
     block = QwenBlock(cfg, dtype)
 
+    # Manual collective axes; any OTHER mesh axis (model) stays auto so
+    # XLA can tensor-shard the in-stage compute.
+    manual = frozenset({pipe_axis} | ({batch_axis} if batch_axis else set()))
+
     # x: (M, Bm, L, D) microbatched activations; masks/positions likewise.
     x_spec = P(None, batch_axis, None, None)
     m_spec = P(None, batch_axis, None)
@@ -83,6 +129,7 @@ def make_pp_sft_loss(
         mesh=mesh,
         in_specs=(P(pipe_axis), x_spec, m_spec, m_spec),
         out_specs=x_spec,
+        axis_names=manual,
     )
     def _pp_blocks(stacked, x, positions, attention_mask):
         from genrec_tpu.models.backbones.qwen import causal_pad_bias
@@ -148,6 +195,18 @@ def make_pp_sft_loss(
             raise ValueError(f"batch {B} not divisible by n_micro {M}")
         Bm = B // M
         rest, stacked = stack_layer_params(params, cfg.num_hidden_layers)
+        # Pin the stacked layout: layers over pipe, and (with tp_rules)
+        # Megatron dims over the model axis — the constraint is what the
+        # auto-axis partitioner propagates into the per-stage matmuls.
+        from jax.sharding import NamedSharding
+
+        specs = stacked_param_specs(stacked, tp_rules, pipe_axis, mesh, log_fn)
+        stacked = jax.tree_util.tree_map(
+            lambda x, s: jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, s)
+            ),
+            stacked, specs,
+        )
         positions = jnp.maximum(jnp.cumsum(am, axis=1) - 1, 0)
 
         x = rest["embed_tokens"][ids].astype(dtype)

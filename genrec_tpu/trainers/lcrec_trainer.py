@@ -211,7 +211,7 @@ def train(
     chosen = [n for n in (sequence_parallel, pipeline_parallel, tensor_parallel,
                           expert_parallel)
               if n > 1]
-    # The ONE wired composition: tensor x expert parallelism for MoE runs
+    # Wired composition #1: tensor x expert parallelism for MoE runs
     # (dp x model x expert — the standard MoE-LLM layout: attention
     # Megatron-sharded, expert stacks expert-sharded; the rule sets match
     # disjoint param paths so they concatenate).
@@ -219,11 +219,22 @@ def train(
         tensor_parallel > 1 and expert_parallel > 1 and num_experts > 0
         and sequence_parallel == 1 and pipeline_parallel == 1
     )
-    if len(chosen) > 1 and not tp_ep_combo:
+    # Wired composition #2 — dp x tp x pp: the standard dense-LLM pod
+    # layout. The pipeline
+    # shard_map goes manual over pipe/data only; the model axis stays
+    # auto and XLA Megatron-shards the per-stage matmuls from the
+    # qwen_rules constraints (parallel/pipeline.py make_pp_sft_loss).
+    tp_pp_combo = (
+        tensor_parallel > 1 and pipeline_parallel > 1
+        and sequence_parallel == 1 and expert_parallel == 1
+        and num_experts == 0
+    )
+    if len(chosen) > 1 and not (tp_ep_combo or tp_pp_combo):
         raise ValueError("pick ONE of sequence_parallel / pipeline_parallel / "
-                         "tensor_parallel / expert_parallel per run (the only "
-                         "wired composition is tensor_parallel x "
-                         "expert_parallel with num_experts>0)")
+                         "tensor_parallel / expert_parallel per run (wired "
+                         "compositions: tensor_parallel x expert_parallel "
+                         "with num_experts>0, and tensor_parallel x "
+                         "pipeline_parallel for the dense stack)")
     if num_experts > 0 and (sequence_parallel > 1 or pipeline_parallel > 1):
         # sp/pp run the blocks inside shard_map and do not collect the
         # sown router-aux loss. Refuse rather than quietly degrade.
@@ -259,6 +270,13 @@ def train(
 
         mesh = make_mesh(
             {"data": -1, "model": tensor_parallel, "expert": expert_parallel}
+        )
+        logger.info(f"mesh {dict(zip(mesh.axis_names, mesh.devices.shape))}")
+    elif tp_pp_combo:
+        from genrec_tpu.parallel import make_mesh
+
+        mesh = make_mesh(
+            {"data": -1, "model": tensor_parallel, "pipe": pipeline_parallel}
         )
         logger.info(f"mesh {dict(zip(mesh.axis_names, mesh.devices.shape))}")
     elif chosen:
@@ -443,10 +461,12 @@ def train(
         )
     elif pipeline_parallel > 1:
         from genrec_tpu.parallel.pipeline import make_pp_sft_loss
+        from genrec_tpu.parallel.shardings import qwen_rules as _qr
 
         base_loss = make_pp_sft_loss(
             cfg, mesh, n_micro=pp_microbatches, dtype=compute_dtype,
             remat=gradient_checkpointing, valid_vocab=live_vocab,
+            tp_rules=_qr() if tp_pp_combo else None, log_fn=logger.info,
         )
     else:
         base_loss = lambda p, batch: sft_loss(

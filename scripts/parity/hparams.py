@@ -21,4 +21,16 @@ HSTU = dict(
     save_every_epoch=1000, amp=False,
 )
 
-BY_MODEL = {"sasrec": SASREC, "hstu": HSTU}
+# TIGER: values shared by both sides; the drivers map names onto each
+# trainer's signature (reference tiger_trainer.py:83-117 vs
+# genrec_tpu/trainers/tiger_trainer.py) — the semantics are identical
+# (n_layers splits into n_layers//2 encoder + decoder on both sides;
+# max-items histories of 20 flatten to 60 sem-id tokens).
+TIGER = dict(
+    epochs=6, batch_size=64, learning_rate=1e-3, weight_decay=0.01,
+    num_warmup_steps=50, embedding_dim=64, attn_dim=128, dropout=0.1,
+    num_heads=4, n_layers=4, sem_id_dim=3, codebook_size=256,
+    max_items=20, num_user_embeddings=10_000, amp=False,
+)
+
+BY_MODEL = {"sasrec": SASREC, "hstu": HSTU, "tiger": TIGER}

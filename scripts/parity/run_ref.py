@@ -21,40 +21,107 @@ import tempfile
 from . import hparams, ref_stubs
 
 
+def _run_tiger(root: str, split: str, hp: dict, records: list):
+    """Reference TIGER via its own train(): the dataset CLASS is a train()
+    parameter (tiger_trainer.py:92, 145-165), so a thin adapter subclass
+    injects the shared sem-id table instead of loading an RQ-VAE torch
+    checkpoint in the constructor — everything else (sliding window,
+    trie-constrained generate eval, TopKAccumulator) is the reference's
+    own code. Eval metrics are captured by a recording TopKAccumulator
+    (the evaluate fn is a closure inside train(), not patchable)."""
+    import numpy as np
+
+    import genrec.trainers.tiger_trainer as T
+    from genrec.data.amazon import AmazonSeqDataset
+
+    from genrec_tpu.data.sem_ids import load_sem_ids
+    from scripts.parity import synth
+
+    sem_ids, _ = load_sem_ids(
+        synth.ensure_sem_ids(
+            root, split, codebook_size=hp["codebook_size"],
+            sem_id_dim=hp["sem_id_dim"],
+        )
+    )
+    shared_rows = [list(map(int, r)) for r in np.asarray(sem_ids)]
+
+    class ParitySeqDataset(AmazonSeqDataset):
+        def __init__(self, root, train_test_split="train", max_seq_len=20, **kw):
+            self.root = root
+            self.split = split.lower()
+            self.train_test_split = train_test_split
+            self._max_seq_len = max_seq_len
+            self.add_disambiguation = False
+            self.sem_ids_list = shared_rows
+            self._load_sequences()
+            self._generate_samples()
+
+    class RecordingAccumulator(T.TopKAccumulator):
+        def reduce(self):
+            m = super().reduce()
+            records.append({k: float(v) for k, v in m.items()})
+            return m
+
+    T.TopKAccumulator = RecordingAccumulator
+
+    with tempfile.TemporaryDirectory() as td:
+        T.train(
+            dataset=ParitySeqDataset, dataset_folder=root, save_dir_root=td,
+            wandb_logging=False, epochs=hp["epochs"],
+            batch_size=hp["batch_size"], learning_rate=hp["learning_rate"],
+            weight_decay=hp["weight_decay"],
+            num_warmup_steps=hp["num_warmup_steps"],
+            embedding_dim=hp["embedding_dim"], attn_dim=hp["attn_dim"],
+            dropout=hp["dropout"], num_heads=hp["num_heads"],
+            n_layers=hp["n_layers"], sem_id_dim=hp["sem_id_dim"],
+            num_item_embeddings=hp["codebook_size"],
+            num_user_embeddings=hp["num_user_embeddings"],
+            max_seq_len=hp["max_items"], amp=hp["amp"],
+            do_eval=True, eval_valid_every_epoch=2,
+            eval_test_every_epoch=hp["epochs"],
+            save_every_epoch=10_000,
+        )
+
+
 def run_model(model: str, root: str, split: str, out_path: str, epochs: int | None):
     ref_stubs.install()
     import torch
 
     torch.manual_seed(0)
 
-    if model == "sasrec":
-        import genrec.trainers.sasrec_trainer as T
-    elif model == "hstu":
-        import genrec.trainers.hstu_trainer as T
-    else:
-        raise ValueError(f"unsupported reference model {model!r}")
-
-    records: list[dict] = []
-    orig_eval = T.evaluate
-
-    def recording_eval(*a, **k):
-        m = orig_eval(*a, **k)
-        records.append({k2: float(v) for k2, v in m.items()})
-        return m
-
-    T.evaluate = recording_eval
-
     hp = dict(hparams.BY_MODEL[model])
     if epochs:
         hp["epochs"] = epochs
-    with tempfile.TemporaryDirectory() as td:
-        T.train(
-            dataset_folder=root, split=split, save_dir_root=td,
-            wandb_logging=False, **hp,
-        )
+    records: list[dict] = []
 
-    # train() calls evaluate once per epoch on valid, then once on test
-    # (with the best-valid-Recall@10 weights restored).
+    if model == "tiger":
+        _run_tiger(root, split, hp, records)
+    elif model in ("sasrec", "hstu"):
+        if model == "sasrec":
+            import genrec.trainers.sasrec_trainer as T
+        else:
+            import genrec.trainers.hstu_trainer as T
+
+        orig_eval = T.evaluate
+
+        def recording_eval(*a, **k):
+            m = orig_eval(*a, **k)
+            records.append({k2: float(v) for k2, v in m.items()})
+            return m
+
+        T.evaluate = recording_eval
+
+        with tempfile.TemporaryDirectory() as td:
+            T.train(
+                dataset_folder=root, split=split, save_dir_root=td,
+                wandb_logging=False, **hp,
+            )
+    else:
+        raise ValueError(f"unsupported reference model {model!r}")
+
+    # Both loop shapes end with the test eval as the LAST record (sasrec/
+    # hstu: per-epoch valid then best-model test; tiger: valid every 2
+    # epochs then test at the final epoch).
     out = {
         "model": model,
         "framework": "torch-reference",
@@ -70,7 +137,7 @@ def run_model(model: str, root: str, split: str, out_path: str, epochs: int | No
 
 def main():
     p = argparse.ArgumentParser()
-    p.add_argument("model", choices=["sasrec", "hstu"])
+    p.add_argument("model", choices=["sasrec", "hstu", "tiger"])
     p.add_argument("--root", default="dataset/parity")
     p.add_argument("--split", default="beauty")
     p.add_argument("--out", required=True)

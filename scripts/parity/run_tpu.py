@@ -22,18 +22,31 @@ def run_model(model: str, root: str, split: str, out_path: str, epochs: int | No
 
     jax.config.update("jax_platforms", "cpu")
 
-    from scripts.parity import hparams
-
-    if model == "sasrec":
-        from genrec_tpu.trainers.sasrec_trainer import train
-    elif model == "hstu":
-        from genrec_tpu.trainers.hstu_trainer import train
-    else:
-        raise ValueError(f"unsupported model {model!r}")
+    from scripts.parity import hparams, synth
 
     hp = dict(hparams.BY_MODEL[model])
     if epochs:
         hp["epochs"] = epochs
+    extra = {}
+    if model == "sasrec":
+        from genrec_tpu.trainers.sasrec_trainer import train
+    elif model == "hstu":
+        from genrec_tpu.trainers.hstu_trainer import train
+    elif model == "tiger":
+        from genrec_tpu.trainers.tiger_trainer import train
+
+        # Shared sem-id artifact (same table the reference adapter uses);
+        # mirror the reference run's eval cadence (valid every 2 epochs).
+        extra = dict(
+            sem_ids_path=synth.ensure_sem_ids(
+                root, split, codebook_size=hp["codebook_size"],
+                sem_id_dim=hp["sem_id_dim"],
+            ),
+            eval_every_epoch=2,
+            eval_batch_size=hp["batch_size"],
+        )
+    else:
+        raise ValueError(f"unsupported model {model!r}")
     save_dir = os.path.join(os.path.dirname(out_path) or ".", f"tpu_{model}_rundir")
     # Start from an empty rundir: Tracker appends to metrics.jsonl (curves
     # would interleave) and BestTracker seeds itself from a leftover
@@ -45,7 +58,7 @@ def run_model(model: str, root: str, split: str, out_path: str, epochs: int | No
     os.makedirs(save_dir, exist_ok=True)
     valid_metrics, test_metrics = train(
         dataset="amazon", dataset_folder=root, split=split,
-        save_dir_root=save_dir, wandb_logging=False, seed=0, **hp,
+        save_dir_root=save_dir, wandb_logging=False, seed=0, **hp, **extra,
     )
 
     curve = []
@@ -77,7 +90,7 @@ def run_model(model: str, root: str, split: str, out_path: str, epochs: int | No
 
 def main():
     p = argparse.ArgumentParser()
-    p.add_argument("model", choices=["sasrec", "hstu"])
+    p.add_argument("model", choices=["sasrec", "hstu", "tiger"])
     p.add_argument("--root", default="dataset/parity")
     p.add_argument("--split", default="beauty")
     p.add_argument("--out", required=True)

@@ -106,6 +106,33 @@ def generate(root: str, split: str = "beauty", seed: int = 7) -> str:
     return path
 
 
+def ensure_sem_ids(root: str, split: str = "beauty", codebook_size: int = 256,
+                   sem_id_dim: int = 3, seed: int = 11) -> str:
+    """Shared random-unique sem-id artifact for the TIGER parity run.
+
+    Both frameworks assign item ids by first appearance over the same
+    reviews stream (reference 0-based, ours 1-based), so row i of this
+    table is reference item i == our item i+1 — the SAME mapping. Random
+    unique tuples stand in for a trained RQ-VAE: parity here tests the
+    generative-retrieval TRAINING dynamics, not stage-1 quality."""
+    from genrec_tpu.data.sem_ids import random_unique_sem_ids, save_sem_ids
+
+    # Parameters in the filename: a changed codebook/dim/seed can never
+    # silently reuse a stale artifact built for different table shapes.
+    path = os.path.join(
+        root, "processed",
+        f"{split}_parity_sem_ids_k{codebook_size}_d{sem_id_dim}_s{seed}.npz",
+    )
+    if os.path.exists(path):
+        return path
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    sem_ids = random_unique_sem_ids(
+        N_ITEMS, codebook_size, sem_id_dim, np.random.default_rng(seed)
+    )
+    save_sem_ids(path, sem_ids, codebook_size)
+    return path
+
+
 if __name__ == "__main__":
     import sys
 
