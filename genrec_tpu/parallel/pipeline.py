@@ -26,7 +26,7 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax import shard_map
-from jax.sharding import PartitionSpec as P
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 
 def stack_layer_params(params: dict, n_layers: int):
@@ -53,7 +53,6 @@ def stacked_param_specs(stacked, rules, pipe_axis: str, mesh, log_fn=None):
     shardings.param_specs: a rule-matched dim that does not divide the
     mesh axis replicates, and ``log_fn`` reports it (silent fallback
     would hide that "tensor parallelism" sharded nothing)."""
-    from jax.sharding import PartitionSpec as P
 
     def spec_of(path, leaf):
         p = "/".join(str(getattr(k, "key", k)) for k in path)
@@ -198,8 +197,6 @@ def make_pp_sft_loss(
         # Pin the stacked layout: layers over pipe, and (with tp_rules)
         # Megatron dims over the model axis — the constraint is what the
         # auto-axis partitioner propagates into the per-stage matmuls.
-        from jax.sharding import NamedSharding
-
         specs = stacked_param_specs(stacked, tp_rules, pipe_axis, mesh, log_fn)
         stacked = jax.tree_util.tree_map(
             lambda x, s: jax.lax.with_sharding_constraint(

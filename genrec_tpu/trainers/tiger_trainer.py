@@ -84,6 +84,11 @@ def train(
     do_eval=True,
     eval_every_epoch=10,
     eval_batch_size=64,
+    # True (default): final valid/test run with the best-valid-Recall@10
+    # snapshot (the sasrec/hstu reference protocol). False: final-epoch
+    # weights — the reference TIGER trainer's protocol (it keeps no best
+    # model, tiger_trainer.py:345); the parity harness uses this.
+    test_on_best=True,
     save_dir_root="out/tiger",
     save_every_epoch=100,
     resume_from_checkpoint=False,
@@ -266,7 +271,7 @@ def train(
         if ckpt is not None and (epoch + 1) % save_every_epoch == 0:
             ckpt.save(epoch, state)  # epoch-keyed: uniform across trainers
 
-    final_params = best.best_params(like=state.params)
+    final_params = best.best_params(like=state.params) if test_on_best else None
     if final_params is None:
         final_params = state.params
     eval_rng, s1, s2 = jax.random.split(eval_rng, 3)

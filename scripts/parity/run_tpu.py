@@ -44,6 +44,9 @@ def run_model(model: str, root: str, split: str, out_path: str, epochs: int | No
             ),
             eval_every_epoch=2,
             eval_batch_size=hp["batch_size"],
+            # Protocol match: the reference TIGER trainer evaluates test
+            # with FINAL-epoch weights (no best tracking).
+            test_on_best=False,
         )
     else:
         raise ValueError(f"unsupported model {model!r}")

@@ -1,0 +1,115 @@
+"""Worker process for tests/test_multihost.py (not a pytest module).
+
+Runs as 1 of 2 jax.distributed processes, each with 4 virtual CPU
+devices -> an 8-device global mesh, and exercises every multi-host-only
+branch the single-process suite cannot reach:
+
+- parallel.mesh.shard_batch -> jax.make_array_from_process_local_data
+- parallel.mesh.metric_allreduce / to_host / barrier
+- ops.metrics.TopKAccumulator.reduce(cross_process=True)
+- core.checkpoint.CheckpointManager save/restore of a NON-ADDRESSABLE
+  (cross-process data-sharded) array
+
+Prints MULTIHOST_OK on success; any assertion kills the process and the
+parent test fails on the exit code.
+"""
+
+import os
+import sys
+
+
+def main(coordinator: str, process_id: int, ckpt_dir: str) -> None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = [
+        f for f in os.environ.get("XLA_FLAGS", "").split()
+        if not f.startswith("--xla_force_host_platform_device_count")
+    ]
+    flags.append("--xla_force_host_platform_device_count=4")
+    os.environ["XLA_FLAGS"] = " ".join(flags)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        coordinator_address=coordinator, num_processes=2, process_id=process_id
+    )
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.device_count() == 8, jax.device_count()
+    assert jax.local_device_count() == 4
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from genrec_tpu.parallel import (
+        barrier,
+        get_mesh,
+        metric_allreduce,
+        replicate,
+        shard_batch,
+        to_host,
+    )
+
+    mesh = get_mesh()
+
+    # --- shard_batch: the make_array_from_process_local_data branch.
+    # Every process holds the same GLOBAL batch (the trainers' contract);
+    # each uploads only its addressable shards.
+    batch = {"x": np.arange(16, dtype=np.float32).reshape(8, 2)}
+    sharded = shard_batch(mesh, batch)
+    assert sharded["x"].shape == (8, 2)
+    assert not sharded["x"].is_fully_addressable
+
+    # A jitted global reduction over the cross-process array.
+    total = jax.jit(lambda b: jnp.sum(b["x"]))(sharded)
+    assert float(total) == float(np.arange(16).sum()), float(total)
+
+    # --- to_host on a non-addressable array (process_allgather path).
+    back = to_host(sharded["x"])
+    np.testing.assert_array_equal(back, batch["x"])
+
+    # --- metric_allreduce: per-process partial sums -> global sums.
+    got = metric_allreduce({"n": 1.0 + process_id, "s": 10.0})
+    assert got["n"] == 3.0, got  # 1 + 2
+    assert got["s"] == 20.0, got
+
+    # --- TopKAccumulator.reduce(cross_process=True): processes accumulate
+    # DIFFERENT batches; the reduced metrics must reflect both.
+    from genrec_tpu.ops.metrics import TopKAccumulator
+
+    acc = TopKAccumulator(ks=(1,))
+    if process_id == 0:
+        actual = jnp.asarray([[7]])
+        top = jnp.asarray([[[7]]])  # hit
+    else:
+        actual = jnp.asarray([[7]])
+        top = jnp.asarray([[[3]]])  # miss
+    acc.accumulate(actual=actual, top_k=top)
+    m = acc.reduce(cross_process=True)
+    assert abs(m["Recall@1"] - 0.5) < 1e-6, m  # 1 hit / 2 samples globally
+
+    # --- orbax save/restore of a non-addressable array via the one
+    # CheckpointManager all trainers use.
+    from genrec_tpu.core.checkpoint import CheckpointManager
+
+    state = {
+        "w": replicate(mesh, jnp.full((4,), 3.0)),
+        "data_sharded": sharded["x"],
+    }
+    mgr = CheckpointManager(ckpt_dir)
+    mgr.save(0, state)
+    mgr._mgr.wait_until_finished()
+    like = {
+        "w": replicate(mesh, jnp.zeros((4,))),
+        "data_sharded": shard_batch(mesh, {"x": np.zeros((8, 2), np.float32)})["x"],
+    }
+    restored = mgr.restore(like)
+    np.testing.assert_array_equal(to_host(restored["w"]), np.full((4,), 3.0))
+    np.testing.assert_array_equal(to_host(restored["data_sharded"]), batch["x"])
+    mgr.close()
+
+    barrier("multihost-test-done")
+    print(f"MULTIHOST_OK {process_id}", flush=True)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1], int(sys.argv[2]), sys.argv[3])
