@@ -118,6 +118,10 @@ def train(
     do_eval=True,
     eval_every_epoch=10,
     eval_batch_size=32,
+    # False: final valid/test with final-epoch weights — the reference
+    # COBRA trainer's protocol (no best tracking); True keeps the
+    # best-valid-Recall@10 snapshot protocol of sasrec/hstu.
+    test_on_best=True,
     save_dir_root="out/cobra",
     save_every_epoch=50,
     resume_from_checkpoint=False,
@@ -134,7 +138,14 @@ def train(
     tracker = Tracker(wandb_logging, wandb_project, save_dir=save_dir_root)
     mesh = get_mesh()
 
-    if dataset == "synthetic":
+    if callable(dataset):
+        # Injected data factory returning a CobraSeqData — mirrors the
+        # reference trainer's dataset-class parameter (cobra_trainer.py:99)
+        # and is how the parity harness feeds shared fixed token tables.
+        data = dataset()
+        id_vocab_size = data.id_vocab_size
+        n_codebooks = data.C
+    elif dataset == "synthetic":
         data = synthetic_cobra_data(
             id_vocab_size=id_vocab_size, n_codebooks=n_codebooks,
             text_vocab=encoder_vocab_size, max_items=max_items, seed=seed,
@@ -199,7 +210,11 @@ def train(
 
     step_fn = jax.jit(make_train_step(loss_fn, optimizer, clip_norm=1.0), donate_argnums=0)
     state = replicate(mesh, TrainState.create(params, optimizer, state_rng))
-    fusion_fn = make_fusion_fn(model, item_sem_ids, 10, n_beam, fusion_alpha)
+    # Reference eval: n_candidates=10 of n_beam=20 (cobra_trainer.py:433-435);
+    # clamped so small-beam debug runs stay valid.
+    fusion_fn = make_fusion_fn(
+        model, item_sem_ids, min(10, n_beam), n_beam, fusion_alpha
+    )
 
     from genrec_tpu.core.checkpoint import BestTracker, CheckpointManager, maybe_resume, save_params
 
@@ -270,7 +285,7 @@ def train(
             tracker.log({"epoch": epoch, **{f"eval/{k}": v for k, v in m.items()}})
             best.update(m["Recall@10"], state.params)
 
-    final_params = best.best_params(like=state.params)
+    final_params = best.best_params(like=state.params) if test_on_best else None
     if final_params is None:
         final_params = state.params
     item_vecs = compute_item_dense_vecs(model, final_params, data.item_texts)

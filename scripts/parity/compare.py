@@ -53,7 +53,11 @@ def compare(ref_path: str, tpu_path: str, n_eval: int) -> dict:
                 for e in tpu["valid_curve"]
             ],
         },
-        "all_within_2_std": all(r["within_2_std"] for r in rows.values()),
+        # bool(rows) guard: empty metrics (a run that never evaluated)
+        # must read as a FAILED comparison, not a vacuous pass.
+        "all_within_2_std": bool(rows) and all(
+            r["within_2_std"] for r in rows.values()
+        ),
     }
 
 

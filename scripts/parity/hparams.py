@@ -33,4 +33,18 @@ TIGER = dict(
     max_items=20, num_user_embeddings=10_000, amp=False,
 )
 
-BY_MODEL = {"sasrec": SASREC, "hstu": HSTU, "tiger": TIGER}
+# COBRA: shared values; the drivers map names (reference
+# cobra_trainer.py:91-138 vs genrec_tpu/trainers/cobra_trainer.py —
+# ref max_seq_len == our max_items, ref temperature == our
+# infonce_temperature). Eval protocol on both sides: beam_fusion with
+# n_candidates=10, n_beam=20, alpha=0.5 over recomputed item vectors.
+COBRA = dict(
+    epochs=8, batch_size=32, learning_rate=3e-4, weight_decay=0.01,
+    num_warmup_steps=50, encoder_n_layers=1, encoder_hidden_dim=128,
+    encoder_num_heads=4, encoder_vocab_size=2048, id_vocab_size=256,
+    n_codebooks=3, d_model=128, decoder_n_layers=2, decoder_num_heads=4,
+    decoder_dropout=0.1, max_items=20, max_text_len=16, temperature=0.2,
+    sparse_loss_weight=1.0, dense_loss_weight=1.0, amp=False,
+)
+
+BY_MODEL = {"sasrec": SASREC, "hstu": HSTU, "tiger": TIGER, "cobra": COBRA}

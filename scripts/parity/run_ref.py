@@ -21,6 +21,20 @@ import tempfile
 from . import hparams, ref_stubs
 
 
+def _patch_recording_accumulator(trainer_module, records: list):
+    """Swap the trainer module's TopKAccumulator for a subclass that
+    records every reduce() result (the eval fns are closures inside
+    train(), not patchable directly)."""
+
+    class RecordingAccumulator(trainer_module.TopKAccumulator):
+        def reduce(self):
+            m = super().reduce()
+            records.append({k: float(v) for k, v in m.items()})
+            return m
+
+    trainer_module.TopKAccumulator = RecordingAccumulator
+
+
 def _run_tiger(root: str, split: str, hp: dict, records: list):
     """Reference TIGER via its own train(): the dataset CLASS is a train()
     parameter (tiger_trainer.py:92, 145-165), so a thin adapter subclass
@@ -56,13 +70,7 @@ def _run_tiger(root: str, split: str, hp: dict, records: list):
             self._load_sequences()
             self._generate_samples()
 
-    class RecordingAccumulator(T.TopKAccumulator):
-        def reduce(self):
-            m = super().reduce()
-            records.append({k: float(v) for k, v in m.items()})
-            return m
-
-    T.TopKAccumulator = RecordingAccumulator
+    _patch_recording_accumulator(T, records)
 
     with tempfile.TemporaryDirectory() as td:
         T.train(
@@ -83,6 +91,92 @@ def _run_tiger(root: str, split: str, hp: dict, records: list):
         )
 
 
+def _run_cobra(root: str, split: str, hp: dict, records: list):
+    """Reference COBRA via its own train(): like TIGER, the dataset class
+    is a train() parameter (cobra_trainer.py:99, 164-186). The adapter
+    injects the shared sem-id table and a table-backed tokenizer (the
+    real one needs sentence-t5 files; zero egress) — the trainer's
+    compute_item_dense_vecs calls ``dataset.tokenizer(texts, ...)``
+    directly, so the stand-in implements that callable contract and maps
+    the 'item_<i>' placeholder texts back to shared token rows."""
+    import numpy as np
+    import torch
+
+    import genrec.trainers.cobra_trainer as T
+    from genrec.data.amazon_cobra import AmazonCobraDataset
+
+    from genrec_tpu.data.sem_ids import load_sem_ids
+    from scripts.parity import synth
+
+    sem_ids, _ = load_sem_ids(
+        synth.ensure_sem_ids(
+            root, split, codebook_size=hp["id_vocab_size"],
+            sem_id_dim=hp["n_codebooks"],
+        )
+    )
+    shared_rows = [list(map(int, r)) for r in np.asarray(sem_ids)]
+    table = synth.item_token_table(
+        max_text_len=hp["max_text_len"], vocab=hp["encoder_vocab_size"]
+    )
+
+    class TableTokenizer:
+        """Callable matching the HF-tokenizer surface the reference uses
+        (__call__(texts, padding=, truncation=, max_length=,
+        return_tensors=) -> {'input_ids': LongTensor})."""
+
+        def __call__(self, texts, max_length=None, **kw):
+            rows = []
+            for t in texts:
+                i = int(t.rsplit("_", 1)[1]) if t.startswith("item_") else 0
+                rows.append(table[i][:max_length or table.shape[1]])
+            return {"input_ids": torch.tensor(np.stack(rows), dtype=torch.long)}
+
+    class ParityCobraDataset(AmazonCobraDataset):
+        def __init__(self, root, train_test_split="train", max_seq_len=20, **kw):
+            self.root = root
+            self.split = split.lower()
+            self.train_test_split = train_test_split
+            self._max_seq_len = max_seq_len
+            self.max_text_len = hp["max_text_len"]
+            self.n_codebooks = hp["n_codebooks"]
+            self.codebook_size = hp["id_vocab_size"]
+            self.tokenizer = TableTokenizer()
+            self.sem_ids_list = shared_rows
+            self.item_texts = {i: f"item_{i}" for i in range(len(shared_rows))}
+            self._load_sequences()
+            self._generate_samples()
+
+    _patch_recording_accumulator(T, records)
+
+    with tempfile.TemporaryDirectory() as td:
+        T.train(
+            dataset=ParityCobraDataset, dataset_folder=root, save_dir_root=td,
+            wandb_logging=False, epochs=hp["epochs"],
+            batch_size=hp["batch_size"], learning_rate=hp["learning_rate"],
+            weight_decay=hp["weight_decay"],
+            num_warmup_steps=hp["num_warmup_steps"],
+            encoder_n_layers=hp["encoder_n_layers"],
+            encoder_hidden_dim=hp["encoder_hidden_dim"],
+            encoder_num_heads=hp["encoder_num_heads"],
+            encoder_vocab_size=hp["encoder_vocab_size"],
+            id_vocab_size=hp["id_vocab_size"],
+            n_codebooks=hp["n_codebooks"], d_model=hp["d_model"],
+            decoder_n_layers=hp["decoder_n_layers"],
+            decoder_num_heads=hp["decoder_num_heads"],
+            decoder_dropout=hp["decoder_dropout"],
+            max_seq_len=hp["max_items"], temperature=hp["temperature"],
+            sparse_loss_weight=hp["sparse_loss_weight"],
+            dense_loss_weight=hp["dense_loss_weight"],
+            amp=hp["amp"], do_eval=True,
+            # The reference COBRA loop has no test eval, so the comparison
+            # point is the FINAL-epoch valid eval — make that the one
+            # eval regardless of the epoch count (arbitrary --epochs
+            # values stay comparable).
+            eval_valid_every_epoch=hp["epochs"],
+            eval_test_every_epoch=hp["epochs"], save_every_epoch=10_000,
+        )
+
+
 def run_model(model: str, root: str, split: str, out_path: str, epochs: int | None):
     ref_stubs.install()
     import torch
@@ -96,6 +190,8 @@ def run_model(model: str, root: str, split: str, out_path: str, epochs: int | No
 
     if model == "tiger":
         _run_tiger(root, split, hp, records)
+    elif model == "cobra":
+        _run_cobra(root, split, hp, records)
     elif model in ("sasrec", "hstu"):
         if model == "sasrec":
             import genrec.trainers.sasrec_trainer as T
@@ -119,9 +215,11 @@ def run_model(model: str, root: str, split: str, out_path: str, epochs: int | No
     else:
         raise ValueError(f"unsupported reference model {model!r}")
 
-    # Both loop shapes end with the test eval as the LAST record (sasrec/
-    # hstu: per-epoch valid then best-model test; tiger: valid every 2
-    # epochs then test at the final epoch).
+    # sasrec/hstu: per-epoch valid then best-model test; tiger: valid every
+    # 2 epochs then test at the final epoch — the LAST record is the test
+    # eval. COBRA: the reference trainer has NO test eval (the
+    # eval_test_every_epoch parameter is unused in its loop), so the
+    # comparison point is the final-epoch VALID eval.
     out = {
         "model": model,
         "framework": "torch-reference",
@@ -129,6 +227,11 @@ def run_model(model: str, root: str, split: str, out_path: str, epochs: int | No
         "valid_curve": records[:-1],
         "test": records[-1] if records else {},
     }
+    if model == "cobra":
+        out["protocol_note"] = (
+            "reference COBRA has no test eval; 'test' is the final-epoch "
+            "valid eval (beam_fusion)"
+        )
     os.makedirs(os.path.dirname(out_path), exist_ok=True)
     with open(out_path, "w") as f:
         json.dump(out, f, indent=1)
@@ -137,7 +240,7 @@ def run_model(model: str, root: str, split: str, out_path: str, epochs: int | No
 
 def main():
     p = argparse.ArgumentParser()
-    p.add_argument("model", choices=["sasrec", "hstu", "tiger"])
+    p.add_argument("model", choices=["sasrec", "hstu", "tiger", "cobra"])
     p.add_argument("--root", default="dataset/parity")
     p.add_argument("--split", default="beauty")
     p.add_argument("--out", required=True)

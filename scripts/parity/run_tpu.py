@@ -28,6 +28,7 @@ def run_model(model: str, root: str, split: str, out_path: str, epochs: int | No
     if epochs:
         hp["epochs"] = epochs
     extra = {}
+    dataset = "amazon"
     if model == "sasrec":
         from genrec_tpu.trainers.sasrec_trainer import train
     elif model == "hstu":
@@ -48,8 +49,41 @@ def run_model(model: str, root: str, split: str, out_path: str, epochs: int | No
             # with FINAL-epoch weights (no best tracking).
             test_on_best=False,
         )
+    elif model == "cobra":
+        from genrec_tpu.data.amazon import load_sequences
+        from genrec_tpu.data.cobra_seq import CobraSeqData
+        from genrec_tpu.data.sem_ids import load_sem_ids
+        from genrec_tpu.trainers.cobra_trainer import train
+
+        sem_path = synth.ensure_sem_ids(
+            root, split, codebook_size=hp["id_vocab_size"],
+            sem_id_dim=hp["n_codebooks"],
+        )
+        table = synth.item_token_table(
+            max_text_len=hp["max_text_len"], vocab=hp["encoder_vocab_size"]
+        )
+        max_items = hp["max_items"]
+
+        def dataset():  # callable-dataset hook (mirrors the reference's)
+            seqs, _, _ = load_sequences(root, split, download=False)
+            sem_ids, K = load_sem_ids(sem_path)
+            return CobraSeqData(
+                seqs, sem_ids, table, id_vocab_size=K, max_items=max_items
+            )
+
+        # Name mapping onto our trainer's signature.
+        hp["infonce_temperature"] = hp.pop("temperature")
+        del hp["max_text_len"]  # carried by the shared token table
+        extra = dict(
+            # Match run_ref: the comparison point is the one final-epoch
+            # valid eval (the reference COBRA loop has no test eval).
+            eval_every_epoch=hp["epochs"],
+            eval_batch_size=hp["batch_size"],
+            test_on_best=False,  # reference protocol: final-epoch weights
+        )
     else:
         raise ValueError(f"unsupported model {model!r}")
+
     save_dir = os.path.join(os.path.dirname(out_path) or ".", f"tpu_{model}_rundir")
     # Start from an empty rundir: Tracker appends to metrics.jsonl (curves
     # would interleave) and BestTracker seeds itself from a leftover
@@ -60,7 +94,7 @@ def run_model(model: str, root: str, split: str, out_path: str, epochs: int | No
     shutil.rmtree(save_dir, ignore_errors=True)
     os.makedirs(save_dir, exist_ok=True)
     valid_metrics, test_metrics = train(
-        dataset="amazon", dataset_folder=root, split=split,
+        dataset=dataset, dataset_folder=root, split=split,
         save_dir_root=save_dir, wandb_logging=False, seed=0, **hp, **extra,
     )
 
@@ -85,6 +119,14 @@ def run_model(model: str, root: str, split: str, out_path: str, epochs: int | No
         "valid_final": valid_metrics,
         "test": test_metrics,
     }
+    if model == "cobra":
+        # The reference COBRA trainer has no test eval; compare on the
+        # final-epoch valid eval (same weights, same split on both sides).
+        out["test"] = valid_metrics
+        out["protocol_note"] = (
+            "'test' is the final-epoch valid eval to match the reference "
+            "COBRA trainer (which never evaluates its test split)"
+        )
     os.makedirs(os.path.dirname(out_path), exist_ok=True)
     with open(out_path, "w") as f:
         json.dump(out, f, indent=1)
@@ -93,7 +135,7 @@ def run_model(model: str, root: str, split: str, out_path: str, epochs: int | No
 
 def main():
     p = argparse.ArgumentParser()
-    p.add_argument("model", choices=["sasrec", "hstu", "tiger"])
+    p.add_argument("model", choices=["sasrec", "hstu", "tiger", "cobra"])
     p.add_argument("--root", default="dataset/parity")
     p.add_argument("--split", default="beauty")
     p.add_argument("--out", required=True)

@@ -133,6 +133,21 @@ def ensure_sem_ids(root: str, split: str = "beauty", codebook_size: int = 256,
     return path
 
 
+def item_token_table(max_text_len: int = 16, vocab: int = 2048,
+                     seed: int = 13) -> np.ndarray:
+    """Deterministic per-item token ids standing in for tokenized item
+    text (N_ITEMS, max_text_len): ~8 real tokens in [2, vocab) then
+    0-padding. Row i is reference item i == our item i+1 (same mapping as
+    the sem-id table). Both COBRA adapters read THIS table, so the two
+    frameworks' encoders see identical token streams; tokens are
+    item-unique so a learning encoder can discriminate items."""
+    rng = np.random.default_rng(seed)
+    n_real = 8
+    table = np.zeros((N_ITEMS, max_text_len), np.int64)
+    table[:, :n_real] = rng.integers(2, vocab, (N_ITEMS, n_real))
+    return table.astype(np.int32)
+
+
 if __name__ == "__main__":
     import sys
 
