@@ -27,7 +27,10 @@ def _run(argv: list[str]) -> None:
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--models", nargs="+", default=["sasrec", "hstu"])
-    p.add_argument("--epochs", type=int, default=12)
+    # None = each model's protocol epochs from hparams.py (sasrec/hstu 12,
+    # tiger 6, cobra 8) — overriding globally would silently change the
+    # committed tables' protocols.
+    p.add_argument("--epochs", type=int, default=None)
     p.add_argument("--root", default="/tmp/genrec_parity_data")
     p.add_argument("--out-dir", default="results/parity")
     a = p.parse_args()
@@ -43,10 +46,11 @@ def main():
         ref_out = os.path.join(a.out_dir, f"ref_{model}.json")
         tpu_out = os.path.join(a.out_dir, f"tpu_{model}.json")
         summary = os.path.join(a.out_dir, f"{model}_summary.json")
+        ep = ["--epochs", str(a.epochs)] if a.epochs else []
         _run(py + ["scripts.parity.run_ref", model, "--root", a.root,
-                   "--out", ref_out, "--epochs", str(a.epochs)])
+                   "--out", ref_out] + ep)
         _run(py + ["scripts.parity.run_tpu", model, "--root", a.root,
-                   "--out", tpu_out, "--epochs", str(a.epochs)])
+                   "--out", tpu_out] + ep)
         _run(py + ["scripts.parity.compare", "--ref", ref_out, "--tpu", tpu_out,
                    "--n-eval", str(n_eval), "--out", summary])
         with open(os.path.join(REPO, summary)) as f:

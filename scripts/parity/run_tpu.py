@@ -75,9 +75,11 @@ def run_model(model: str, root: str, split: str, out_path: str, epochs: int | No
         hp["infonce_temperature"] = hp.pop("temperature")
         del hp["max_text_len"]  # carried by the shared token table
         extra = dict(
-            # Match run_ref: the comparison point is the one final-epoch
-            # valid eval (the reference COBRA loop has no test eval).
-            eval_every_epoch=hp["epochs"],
+            # epochs+1: no in-loop valid eval at all — the post-loop
+            # final-weights valid eval IS the comparison point (the
+            # reference COBRA loop has no test eval), and this matches
+            # run_ref's empty valid_curve without evaluating twice.
+            eval_every_epoch=hp["epochs"] + 1,
             eval_batch_size=hp["batch_size"],
             test_on_best=False,  # reference protocol: final-epoch weights
         )
@@ -130,7 +132,9 @@ def run_model(model: str, root: str, split: str, out_path: str, epochs: int | No
     os.makedirs(os.path.dirname(out_path), exist_ok=True)
     with open(out_path, "w") as f:
         json.dump(out, f, indent=1)
-    print(json.dumps({"model": model, "framework": "genrec_tpu", "test": test_metrics}))
+    # Print the SAME 'test' the artifact carries (for cobra that is the
+    # protocol-adjusted value) so stdout and JSON never contradict.
+    print(json.dumps({"model": model, "framework": "genrec_tpu", "test": out["test"]}))
 
 
 def main():
