@@ -47,4 +47,18 @@ COBRA = dict(
     sparse_loss_weight=1.0, dense_loss_weight=1.0, amp=False,
 )
 
-BY_MODEL = {"sasrec": SASREC, "hstu": HSTU, "tiger": TIGER, "cobra": COBRA}
+# RQ-VAE stage 1 (the LCRec 5-codebook architecture at debug scale; the
+# comparison metrics are the collision rate over the full item set and
+# the eval losses — the stage-1 quantities both stage-2 pipelines depend
+# on). Shared fabricated item embeddings (synth.item_embedding_matrix).
+RQVAE = dict(
+    epochs=80, batch_size=256, learning_rate=1e-3, weight_decay=1e-4,
+    vae_input_dim=768, vae_hidden_dims=[512, 256, 128], vae_embed_dim=64,
+    vae_codebook_size=256, vae_n_layers=5, commitment_weight=0.25,
+    eval_every=20, amp=False,
+)
+
+BY_MODEL = {
+    "sasrec": SASREC, "hstu": HSTU, "tiger": TIGER, "cobra": COBRA,
+    "rqvae": RQVAE,
+}

@@ -44,5 +44,32 @@ def install() -> None:
             setattr(wandb, name, lambda *a, **k: None)
         sys.modules["wandb"] = wandb
 
+    if "polars" not in sys.modules:
+        # Imported at module scope by genrec/data/p5_amazon.py (which the
+        # rqvae trainer imports); never called on the parity adapter path.
+        # DataFrame/LazyFrame appear in type annotations evaluated at
+        # class-definition time.
+        pl = _stub_module("polars")
+        pl.DataFrame = object
+        pl.LazyFrame = object
+        sys.modules["polars"] = pl
+
+    if "torch_geometric" not in sys.modules:
+        # p5_amazon.py imports these names at module scope; the parity
+        # adapter never constructs the P5 dataset, so inert placeholders
+        # satisfy the import.
+        tg = _stub_module("torch_geometric")
+        tg_data = _stub_module("torch_geometric.data")
+        tg_io = _stub_module("torch_geometric.io")
+        for name in ("download_google_url", "extract_zip", "HeteroData"):
+            setattr(tg_data, name, lambda *a, **k: None)
+        tg_data.InMemoryDataset = type("InMemoryDataset", (), {})
+        tg_io.fs = _stub_module("torch_geometric.io.fs")
+        tg.data = tg_data
+        tg.io = tg_io
+        sys.modules["torch_geometric"] = tg
+        sys.modules["torch_geometric.data"] = tg_data
+        sys.modules["torch_geometric.io"] = tg_io
+
     if REFERENCE_ROOT not in sys.path:
         sys.path.insert(0, REFERENCE_ROOT)

@@ -177,6 +177,114 @@ def _run_cobra(root: str, split: str, hp: dict, records: list):
         )
 
 
+def _run_rqvae(root: str, split: str, hp: dict, records: list):
+    """Reference RQ-VAE stage 1 via its own train(): the dataset class is
+    a train() parameter (rqvae_trainer.py:60, 109). The adapter serves
+    rows of the shared fabricated embedding matrix with the SAME 95/5
+    split as genrec_tpu's ItemEmbeddingData. Collision rate is captured
+    by wrapping the module's compute_collision_rate; the eval losses are
+    regex-parsed from the trainer's own tqdm.write eval lines (they are
+    computed inline in the loop, nowhere patchable)."""
+    import contextlib
+    import io
+    import re
+
+    import genrec.trainers.rqvae_trainer as T
+
+    from genrec_tpu.data.items import train_eval_split
+    from scripts.parity import synth
+
+    emb = synth.item_embedding_matrix(dim=hp["vae_input_dim"])
+    tr_idx, ev_idx = train_eval_split(len(emb))
+
+    import numpy as np
+
+    class ParityItemDataset:
+        def __init__(self, root, train_test_split="train", **kw):
+            if train_test_split == "all":
+                self.rows = emb
+            else:
+                idx = tr_idx if train_test_split == "train" else ev_idx
+                self.rows = emb[idx]
+
+        def __len__(self):
+            return len(self.rows)
+
+        def __getitem__(self, i):
+            return self.rows[i]
+
+    orig_cr = T.compute_collision_rate
+
+    def recording_cr(model, dataloader, device):
+        # The reference computes collision over its TRAIN subset only
+        # (rqvae_trainer.py passes train_dataloader); genrec_tpu computes
+        # it over ALL items (the quantity stage 2 depends on). Record the
+        # full-set rate too so the comparison is like-for-like.
+        import torch
+
+        rate, total, unique = orig_cr(model, dataloader, device)
+        full_loader = torch.utils.data.DataLoader(
+            ParityItemDataset(root=None, train_test_split="all"),
+            batch_size=512,
+            collate_fn=lambda b: torch.tensor(
+                np.asarray(b), dtype=torch.float32
+            ),
+        )
+        frate, ftotal, funique = orig_cr(model, full_loader, device)
+        records.append(
+            {"collision_rate": float(frate), "total": int(ftotal),
+             "unique": int(funique), "collision_rate_train": float(rate)}
+        )
+        return rate, total, unique
+
+    T.compute_collision_rate = recording_cr
+
+    class _Tee(io.TextIOBase):
+        def __init__(self, real):
+            self.real, self.buf = real, io.StringIO()
+
+        def write(self, s):
+            self.buf.write(s)
+            return self.real.write(s)
+
+        def flush(self):
+            self.real.flush()
+
+    import sys
+
+    tee = _Tee(sys.stdout)
+    with tempfile.TemporaryDirectory() as td, contextlib.redirect_stdout(tee):
+        T.train(
+            dataset=ParityItemDataset, dataset_folder=root, save_dir_root=td,
+            wandb_logging=False, epochs=hp["epochs"],
+            warmup_epochs=hp.get("warmup_epochs", 0),
+            batch_size=hp["batch_size"], learning_rate=hp["learning_rate"],
+            weight_decay=hp["weight_decay"],
+            vae_input_dim=hp["vae_input_dim"], vae_n_cat_feats=0,
+            vae_hidden_dims=list(hp["vae_hidden_dims"]),
+            vae_embed_dim=hp["vae_embed_dim"],
+            vae_codebook_size=hp["vae_codebook_size"],
+            vae_n_layers=hp["vae_n_layers"],
+            vae_codebook_mode=T.QuantizeForwardMode.STE,
+            vae_codebook_last_layer_mode=T.QuantizeForwardMode.SINKHORN,
+            commitment_weight=hp["commitment_weight"],
+            use_kmeans_init=True, amp=hp["amp"], do_eval=True,
+            eval_every=hp["eval_every"], save_model_every=10**9,
+        )
+    # "Epoch N Eval - loss: a, rec: b, vq: c, collision: d (u/t)".
+    # nan/inf must be CAPTURED, not dropped — a diverged run has to show
+    # up as failed loss rows in the comparison, not as missing ones.
+    num = r"([\d.]+|nan|inf|-inf)"
+    for m in re.finditer(
+        rf"Eval - loss: {num}, rec: {num}, vq: {num}", tee.buf.getvalue()
+    ):
+        records.append(
+            {"eval_total_loss": float(m.group(1)),
+             "eval_reconstruction_loss": float(m.group(2)),
+             "eval_rqvae_loss": float(m.group(3))}
+        )
+
+
 def run_model(model: str, root: str, split: str, out_path: str, epochs: int | None):
     ref_stubs.install()
     import torch
@@ -192,6 +300,27 @@ def run_model(model: str, root: str, split: str, out_path: str, epochs: int | No
         _run_tiger(root, split, hp, records)
     elif model == "cobra":
         _run_cobra(root, split, hp, records)
+    elif model == "rqvae":
+        _run_rqvae(root, split, hp, records)
+        collisions = [r for r in records if "collision_rate" in r]
+        losses = [r for r in records if "eval_total_loss" in r]
+        out = {
+            "model": model,
+            "framework": "torch-reference",
+            "hparams": hp,
+            "collision_curve": collisions,
+            "loss_curve": losses,
+            "test": {
+                **(collisions[-1] if collisions else {}),
+                **(losses[-1] if losses else {}),
+            },
+        }
+        os.makedirs(os.path.dirname(out_path), exist_ok=True)
+        with open(out_path, "w") as f:
+            json.dump(out, f, indent=1)
+        print(json.dumps({"model": model, "framework": "torch-reference",
+                          "test": out["test"]}))
+        return
     elif model in ("sasrec", "hstu"):
         if model == "sasrec":
             import genrec.trainers.sasrec_trainer as T
@@ -240,7 +369,7 @@ def run_model(model: str, root: str, split: str, out_path: str, epochs: int | No
 
 def main():
     p = argparse.ArgumentParser()
-    p.add_argument("model", choices=["sasrec", "hstu", "tiger", "cobra"])
+    p.add_argument("model", choices=["sasrec", "hstu", "tiger", "cobra", "rqvae"])
     p.add_argument("--root", default="dataset/parity")
     p.add_argument("--split", default="beauty")
     p.add_argument("--out", required=True)

@@ -83,6 +83,9 @@ def run_model(model: str, root: str, split: str, out_path: str, epochs: int | No
             eval_batch_size=hp["batch_size"],
             test_on_best=False,  # reference protocol: final-epoch weights
         )
+    elif model == "rqvae":
+        _run_rqvae(root, split, out_path, hp)
+        return
     else:
         raise ValueError(f"unsupported model {model!r}")
 
@@ -137,9 +140,76 @@ def run_model(model: str, root: str, split: str, out_path: str, epochs: int | No
     print(json.dumps({"model": model, "framework": "genrec_tpu", "test": out["test"]}))
 
 
+def _run_rqvae(root: str, split: str, out_path: str, hp: dict):
+    """RQ-VAE stage 1 on the shared fabricated embeddings through the
+    trainer's own 'amazon' path (ItemEmbeddingData reads
+    <root>/processed/<split>_item_emb.npy — we place the shared matrix
+    there; the 95/5 split function is shared by construction)."""
+    import shutil
+
+    import numpy as np
+
+    from genrec_tpu.trainers.rqvae_trainer import train
+    from scripts.parity import synth
+
+    emb_path = os.path.join(root, "processed", f"{split}_item_emb.npy")
+    os.makedirs(os.path.dirname(emb_path), exist_ok=True)
+    emb = synth.item_embedding_matrix(dim=hp["vae_input_dim"])
+    np.save(emb_path, emb)
+
+    save_dir = os.path.join(os.path.dirname(out_path) or ".", "tpu_rqvae_rundir")
+    shutil.rmtree(save_dir, ignore_errors=True)
+    os.makedirs(save_dir, exist_ok=True)
+    train(
+        epochs=hp["epochs"], warmup_epochs=hp.get("warmup_epochs", 0),
+        batch_size=hp["batch_size"], learning_rate=hp["learning_rate"],
+        weight_decay=hp["weight_decay"],
+        vae_input_dim=hp["vae_input_dim"], vae_n_cat_feats=0,
+        vae_hidden_dims=tuple(hp["vae_hidden_dims"]),
+        vae_embed_dim=hp["vae_embed_dim"],
+        vae_codebook_size=hp["vae_codebook_size"],
+        vae_n_layers=hp["vae_n_layers"],
+        commitment_weight=hp["commitment_weight"],
+        dataset="amazon", dataset_folder=root, split=split,
+        do_eval=True, eval_every=hp["eval_every"],
+        save_model_every=10**9, save_dir_root=save_dir, wandb_logging=False,
+        seed=0,
+    )
+
+    collisions, losses = [], []
+    with open(os.path.join(save_dir, "metrics.jsonl")) as f:
+        for line in f:
+            rec = json.loads(line)
+            if "collision_rate" in rec:
+                collisions.append({"collision_rate": rec["collision_rate"]})
+            if "eval_total_loss" in rec:
+                losses.append({
+                    k: rec[k]
+                    for k in ("eval_total_loss", "eval_reconstruction_loss",
+                              "eval_rqvae_loss")
+                    if k in rec
+                })
+    out = {
+        "model": "rqvae",
+        "framework": "genrec_tpu",
+        "hparams": hp,
+        "collision_curve": collisions,
+        "loss_curve": losses,
+        "test": {
+            **(collisions[-1] if collisions else {}),
+            **(losses[-1] if losses else {}),
+        },
+    }
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({"model": "rqvae", "framework": "genrec_tpu",
+                      "test": out["test"]}))
+
+
 def main():
     p = argparse.ArgumentParser()
-    p.add_argument("model", choices=["sasrec", "hstu", "tiger", "cobra"])
+    p.add_argument("model", choices=["sasrec", "hstu", "tiger", "cobra", "rqvae"])
     p.add_argument("--root", default="dataset/parity")
     p.add_argument("--split", default="beauty")
     p.add_argument("--out", required=True)

@@ -148,6 +148,21 @@ def item_token_table(max_text_len: int = 16, vocab: int = 2048,
     return table.astype(np.int32)
 
 
+def item_embedding_matrix(n_items: int = 2000, dim: int = 768,
+                          n_clusters: int = 40, seed: int = 17) -> np.ndarray:
+    """Shared fabricated item embeddings for the RQ-VAE stage-1 parity
+    run — both frameworks train on this ONE matrix with the same 95/5
+    split (genrec_tpu.data.items.train_eval_split). Delegates to the
+    canonical clustered-unit-norm generator so there is exactly one
+    synthetic-embedding recipe in the codebase."""
+    from genrec_tpu.data.items import SyntheticItemEmbeddings
+
+    return SyntheticItemEmbeddings(
+        num_items=n_items, dim=dim, n_clusters=n_clusters, noise=0.3,
+        seed=seed,
+    ).embeddings
+
+
 if __name__ == "__main__":
     import sys
 
