@@ -87,26 +87,54 @@ def extend_vocab(
 
 
 def sft_loss(model: QwenLM, params, input_ids, attention_mask, labels,
-             valid_vocab: int | None = None):
+             valid_vocab: int | None = None, use_fused_ce: bool = False):
     """Causal-LM CE with -100-masked labels (HF convention: logits at t
     predict labels at t+1; reference lcrec_trainer.py uses model(labels=...)).
-    ``valid_vocab`` masks vocab pad rows out of the softmax (TP padding)."""
+    ``valid_vocab`` masks vocab pad rows out of the softmax (TP padding).
+
+    ``use_fused_ce`` routes the head through kernels/fused_ce.py: the
+    (B, L, V) logits never materialize — at Qwen vocab scale (~150k) that
+    is the single largest activation of the SFT step. Exact same loss;
+    the valid_vocab mask becomes a row-slice of the head weights (a
+    never-computed logit == a -inf-masked one)."""
     from genrec_tpu.ops.losses import cross_entropy_with_ignore, mask_vocab_logits
 
+    apply_kwargs = {}
+    if use_fused_ce:
+        apply_kwargs = dict(return_hidden=True, compute_logits=False)
     if model.cfg.num_experts > 0:
         # MoE backbone: collect the router load-balance aux loss sown by
         # each QwenMoEMLP (dropped silently without mutable=).
         from genrec_tpu.models.backbones.qwen import collect_moe_aux
 
-        logits, mut = model.apply(
+        out, mut = model.apply(
             {"params": params}, input_ids, attention_mask=attention_mask,
-            mutable=["losses"],
+            mutable=["losses"], **apply_kwargs,
         )
         aux = collect_moe_aux(mut)
     else:
-        logits = model.apply({"params": params}, input_ids, attention_mask=attention_mask)
+        out = model.apply(
+            {"params": params}, input_ids, attention_mask=attention_mask,
+            **apply_kwargs,
+        )
         aux = 0.0
-    logits = mask_vocab_logits(logits, valid_vocab)
+
+    if use_fused_ce:
+        from genrec_tpu.kernels.fused_ce import fused_ce_mean_loss
+
+        _, h = out
+        w = (
+            params["embed_tokens"]
+            if model.cfg.tie_word_embeddings
+            else params["lm_head"]
+        ).astype(model.dtype)
+        if valid_vocab is not None:
+            w = w[:valid_vocab]
+        return fused_ce_mean_loss(
+            h[:, :-1, :], w, labels[:, 1:], ignore_index=-100
+        ) + aux
+
+    logits = mask_vocab_logits(out, valid_vocab)
     per_tok, valid = cross_entropy_with_ignore(
         logits[:, :-1, :], labels[:, 1:], ignore_index=-100
     )

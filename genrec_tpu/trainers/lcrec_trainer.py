@@ -145,6 +145,11 @@ def train(
     max_text_len=96,
     use_lora=False,
     gradient_checkpointing=False,
+    # Fused full-softmax CE over the LM head (kernels/fused_ce.py): the
+    # (B, L, vocab) logits — the largest activation of the SFT step at
+    # real Qwen vocab (~150k) — never materialize. Exact same loss.
+    # auto = on when on TPU; dense (non-sp/pp) loss path only.
+    use_fused_ce="auto",
     # >1: shard the token dim over an "sp" mesh axis and train with ring
     # attention (long-context path; max_text_len must divide by it).
     sequence_parallel=1,
@@ -469,9 +474,16 @@ def train(
             tp_rules=_qr() if tp_pp_combo else None, log_fn=logger.info,
         )
     else:
+        if use_fused_ce == "auto":
+            # TP>1 vocab-shards the head (qwen_rules dim 0); a pallas_call
+            # is not GSPMD-partitionable over it, so auto also requires
+            # tensor_parallel == 1 (the dense matmul stays partitionable).
+            use_fused_ce = (
+                jax.default_backend() == "tpu" and tensor_parallel == 1
+            )
         base_loss = lambda p, batch: sft_loss(
             model, p, batch["input_ids"], batch["attention_mask"], batch["labels"],
-            valid_vocab=live_vocab,
+            valid_vocab=live_vocab, use_fused_ce=bool(use_fused_ce),
         )
 
     if use_lora:

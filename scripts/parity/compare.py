@@ -30,6 +30,8 @@ def compare_rqvae(ref: dict, tpu: dict) -> dict:
     while reconstruction and collision match."""
     rows = {}
     r, t = ref["test"], tpu["test"]
+    # GATING metrics may never be silently absent: a run where the
+    # recorder failed to fire must read as a FAILED gate, not skip it.
     if "collision_rate" in r and "collision_rate" in t:
         d = t["collision_rate"] - r["collision_rate"]
         rows["collision_rate"] = {
@@ -38,6 +40,8 @@ def compare_rqvae(ref: dict, tpu: dict) -> dict:
             "delta": round(d, 4),
             "ok": abs(d) <= 0.05,
         }
+    else:
+        rows["collision_rate"] = {"ok": False, "missing": True}
     if "eval_reconstruction_loss" in r and "eval_reconstruction_loss" in t:
         m = "eval_reconstruction_loss"
         rel = (t[m] - r[m]) / max(abs(r[m]), 1e-9)
@@ -47,6 +51,8 @@ def compare_rqvae(ref: dict, tpu: dict) -> dict:
             "rel_delta": round(rel, 4),
             "ok": abs(rel) <= 0.10,
         }
+    else:
+        rows["eval_reconstruction_loss"] = {"ok": False, "missing": True}
     for m in ("eval_total_loss", "eval_rqvae_loss"):
         if m in r and m in t:
             rel = (t[m] - r[m]) / max(abs(r[m]), 1e-9)

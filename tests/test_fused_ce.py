@@ -106,6 +106,44 @@ def test_hstu_fused_ce_loss_matches():
     np.testing.assert_allclose(float(l1), float(l0), rtol=1e-5)
 
 
+def test_qwen_sft_fused_ce_matches_dense():
+    """sft_loss(use_fused_ce=True) == the materialized-logits sft_loss,
+    values AND grads, including valid_vocab row-slicing and -100 labels
+    (the LCRec SFT head at real vocab is the kernel's biggest win)."""
+    from genrec_tpu.models.backbones.qwen import QwenConfig, QwenLM
+    from genrec_tpu.models.lcrec import sft_loss
+
+    cfg = QwenConfig(
+        vocab_size=96, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=2, num_key_value_heads=1,
+        max_position_embeddings=32, rope_theta=10000.0,
+        tie_word_embeddings=False,
+    )
+    model = QwenLM(cfg)
+    rng = np.random.default_rng(8)
+    B, L = 4, 24
+    ids = jnp.asarray(rng.integers(0, 80, (B, L)), jnp.int32)
+    am = jnp.ones((B, L), jnp.int32)
+    labels = np.asarray(ids).copy()
+    labels[:, :6] = -100  # prompt-masked
+    labels = jnp.asarray(labels)
+    params = model.init(jax.random.key(0), jnp.zeros((1, 4), jnp.int32))["params"]
+
+    def dense(p):
+        return sft_loss(model, p, ids, am, labels, valid_vocab=80)
+
+    def fused(p):
+        return sft_loss(model, p, ids, am, labels, valid_vocab=80,
+                        use_fused_ce=True)
+
+    l0, g0 = jax.value_and_grad(dense)(params)
+    l1, g1 = jax.value_and_grad(fused)(params)
+    np.testing.assert_allclose(float(l1), float(l0), rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(g0), jax.tree_util.tree_leaves(g1)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   atol=2e-5, rtol=1e-4)
+
+
 def test_bf16_inputs():
     x, w, tgt = _inputs(R=128, V=600, d=64)
     got, _ = fused_linear_ce_fwd(
