@@ -63,6 +63,10 @@ PROBE_WINDOW_S = 90.0
 # Single source of truth for the benchmarked architecture/shapes — the
 # torch-reference measurement (scripts/bench_torch_ref.py) imports these
 # so the same-host comparison can never drift out of shape.
+# v5e (TPU v5 lite) bf16 peak — single source of truth for MFU math
+# (scripts/profile_tiger.py imports it).
+V5E_PEAK_FLOPS = 197e12
+
 TIGER_BENCH_ARCH = dict(
     embedding_dim=128, attn_dim=384, dropout=0.1, num_heads=6, n_layers=8,
     num_item_embeddings=256, num_user_embeddings=10_000, sem_id_dim=3,
@@ -147,6 +151,20 @@ def _measure(platform: str) -> None:
     )
     state = TrainState.create(params, optimizer, jax.random.key(1))
 
+    # XLA's own FLOP count for the compiled step -> MFU in the result.
+    # TPU-only: the CPU fallback would pay a discarded trace+compile, and
+    # the number is only meaningful against the chip peak. The AOT
+    # compile here is the SAME executable the timing loop uses (and hits
+    # the persistent cache), so it does not add a second compile.
+    flops_per_step = 0.0
+    if backend == "tpu":
+        try:
+            cost = step.lower(state, batch).compile().cost_analysis()
+            cost = cost[0] if isinstance(cost, (list, tuple)) else cost
+            flops_per_step = float(cost.get("flops", 0.0)) if cost else 0.0
+        except Exception:
+            pass
+
     # Warmup / compile. Synchronize by PULLING the loss to host: a real
     # device->host transfer is a true barrier, whereas block_until_ready
     # over the axon tunnel has been observed returning before execution
@@ -173,6 +191,8 @@ def _measure(platform: str) -> None:
         seq_per_sec=n_steps * B / dt,
         step_ms=dt / n_steps * 1e3,
     )
+    if backend == "tpu" and flops_per_step:
+        result["mfu"] = round(flops_per_step / (dt / n_steps) / V5E_PEAK_FLOPS, 4)
     # Headline number lands FIRST (the parent keeps the last complete
     # BENCH_RESULT line even from an abandoned child); the kernel
     # preflight — a few AOT compiles through the tunnel, cached after the
@@ -474,6 +494,8 @@ def main():
             batch_size=result["batch_size"],
             source=source,
         )
+        if "mfu" in result:
+            line["mfu"] = result["mfu"]
         # A preflight from the in-round cache is stale in the same way the
         # committed one is — only a LIVE run's preflight is current.
         if "kernel_preflight" in result and source == "live":

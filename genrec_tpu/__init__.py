@@ -13,8 +13,10 @@ built TPU-first:
   replace the reference's NCCL/Accelerate stack
 - decode loops (trie-constrained beam search) compiled on device with
   dense prefix legality tables instead of host-side Python tries
-- Pallas kernels for the hot ops (HSTU fused attention-bias, residual
-  quantizer distance/assign)
+- Pallas kernels for the hot ops: HSTU fused attention-bias (forward AND
+  flash-style backward), fused full-softmax linear+CE for the
+  SASRec/HSTU/LCRec heads (no materialized logits), residual quantizer
+  distance/assign
 """
 
 __version__ = "0.1.0"

@@ -22,8 +22,6 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# v5e (TPU v5 lite) peak: 197 TFLOP/s bf16.
-V5E_PEAK_FLOPS = 197e12
 
 
 def main():
@@ -46,7 +44,7 @@ def main():
     import numpy as np
     import optax
 
-    from bench import BENCH_ITEMS, TIGER_BENCH_ARCH
+    from bench import BENCH_ITEMS, TIGER_BENCH_ARCH, V5E_PEAK_FLOPS
     from genrec_tpu.core.harness import make_train_step
     from genrec_tpu.core.state import TrainState
     from genrec_tpu.models.tiger import Tiger
