@@ -14,6 +14,15 @@ The codeword gather is a one-hot matmul — TPU-friendly, no dynamic row
 gather. Applies to the raw-codebook configuration (no sim_vq projection /
 normalization — the shipped RQ-VAE configs); the general path falls back
 to the Flax model.
+
+MEASURED VERDICT (v5e, results/tpu/bench.json kernel_preflight): at
+rqvae scale (B=2048, D=32, L=3, K=256) the op is too small for a custom
+kernel to pay off — XLA 0.17 ms vs Pallas 1.50 ms; per-tile grid
+overhead dominates an op whose whole working set is ~0.3 MB. The kernel
+stays correct (ids match bitwise, preflight-gated) but OFF by default
+(`rqvae_trainer use_pallas=False`); the framework's winning kernels are
+the fused HSTU attention (fwd+bwd) and the fused linear+CE
+(kernels/fused_ce.py), which attack measured memory-bound costs.
 """
 
 from __future__ import annotations

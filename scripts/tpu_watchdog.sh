@@ -1,0 +1,23 @@
+#!/bin/bash
+# Probe the axon tunnel every ~9 min; the moment it is up, run the full
+# hardware evidence chain: live bench (seeds out/bench_tpu_last.json +
+# compile cache), kernel preflight (validates + times all four kernels,
+# incl. the new fused CE and HSTU backward), and the MFU profile sweep.
+# Writes /tmp/tpu_watchdog.status lines as it goes.
+cd "$(dirname "$0")/.."
+for i in $(seq 1 "${1:-12}"); do
+  if timeout 120 python -c "import jax; jax.devices()" > /dev/null 2>&1; then
+    echo "tunnel UP at attempt $i $(date -u +%H:%M:%S)" >> /tmp/tpu_watchdog.status
+    python bench.py > out/bench_live.json 2> out/bench_live.err
+    echo "bench rc=$? $(cat out/bench_live.json | head -c 200)" >> /tmp/tpu_watchdog.status
+    timeout 900 python -m genrec_tpu.kernels.preflight > out/preflight_live.json 2> out/preflight_live.err
+    echo "preflight rc=$?" >> /tmp/tpu_watchdog.status
+    timeout 1200 python scripts/profile_tiger.py --out results/tpu/profile_summary.json > out/profile_live.log 2>&1
+    echo "profile rc=$?" >> /tmp/tpu_watchdog.status
+    echo DONE >> /tmp/tpu_watchdog.status
+    exit 0
+  fi
+  echo "probe $i down $(date -u +%H:%M:%S)" >> /tmp/tpu_watchdog.status
+  sleep 540
+done
+echo "EXHAUSTED" >> /tmp/tpu_watchdog.status
