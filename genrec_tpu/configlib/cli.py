@@ -44,7 +44,9 @@ def parse_config(argv: Sequence[str] | None = None) -> argparse.Namespace:
     if args.platform:
         import jax
 
-        jax.config.update("jax_platforms", args.platform)
+        from genrec_tpu.parallel.mesh import pin_platform
+
+        pin_platform(args.platform)
 
     _parser.parse_file(args.config, substitutions={"split": args.split})
     for binding in args.gin:

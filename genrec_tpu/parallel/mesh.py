@@ -18,13 +18,37 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+_explicit_platform_pin = False
+
+
+def pin_platform(platform: str) -> None:
+    """Programmatic platform pin (``--platform`` flags, parity/profile
+    runners). Always wins: distributed_init() will NOT re-assert the
+    JAX_PLATFORMS env var over it."""
+    global _explicit_platform_pin
+    _explicit_platform_pin = True
+    jax.config.update("jax_platforms", platform)
+
+
 def distributed_init() -> None:
     """Initialize multi-host JAX if launched in a multi-process environment.
 
     Replaces `Accelerator(...)` process-group setup (reference
     tiger_trainer.py:124-128). Single-process runs are a no-op, so trainers
     call this unconditionally.
+
+    Also makes ``JAX_PLATFORMS`` behave as users expect: hosts with a
+    sitecustomize hook that imports jax at interpreter start pin the
+    platform via jax.config BEFORE the env var can take effect, so
+    ``JAX_PLATFORMS=cpu python -m genrec_tpu.trainers...`` would silently
+    ignore the variable (and hang on a dead TPU tunnel). Re-asserting the
+    env value here — trainers call this before first device use — restores
+    the standard semantics. An explicit ``pin_platform()`` call (the
+    ``--platform`` flag) takes precedence over the env var.
     """
+    env_platforms = os.environ.get("JAX_PLATFORMS")
+    if env_platforms and not _explicit_platform_pin:
+        jax.config.update("jax_platforms", env_platforms)
     if int(os.environ.get("JAX_PROCESS_COUNT", "1")) > 1 or "JAX_COORDINATOR_ADDRESS" in os.environ:
         jax.distributed.initialize()
 

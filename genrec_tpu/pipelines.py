@@ -79,7 +79,9 @@ def main(argv=None):
     if args.platform:
         import jax
 
-        jax.config.update("jax_platforms", args.platform)
+        from genrec_tpu.parallel.mesh import pin_platform
+
+        pin_platform(args.platform)
     return run_two_stage(
         f"{args.pipeline}_trainer",
         args.rqvae_config,

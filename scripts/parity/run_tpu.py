@@ -20,7 +20,9 @@ def run_model(model: str, root: str, split: str, out_path: str, epochs: int | No
     # var alone cannot unpin it (see tests/conftest.py).
     import jax
 
-    jax.config.update("jax_platforms", "cpu")
+    from genrec_tpu.parallel.mesh import pin_platform
+
+    pin_platform("cpu")
 
     from scripts.parity import hparams, synth
 

@@ -39,7 +39,9 @@ def main():
     import jax
 
     if args.platform:
-        jax.config.update("jax_platforms", args.platform)
+        from genrec_tpu.parallel.mesh import pin_platform
+
+        pin_platform(args.platform)
     import jax.numpy as jnp
     import numpy as np
     import optax
