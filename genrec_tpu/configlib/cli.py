@@ -42,8 +42,6 @@ def parse_config(argv: Sequence[str] | None = None) -> argparse.Namespace:
     args = ap.parse_args(argv)
 
     if args.platform:
-        import jax
-
         from genrec_tpu.parallel.mesh import pin_platform
 
         pin_platform(args.platform)

@@ -252,8 +252,9 @@ def _bwd_kernel(
     @pl.when(j == 0)
     def _init_tabs():
         dpt_ref[0] = jnp.zeros_like(dpt_ref[0])
-        if use_time:
-            dtt_ref[0] = jnp.zeros_like(dtt_ref[0])
+        # Zero even when use_time is False (1-wide dummy table): the output
+        # buffer is otherwise uninitialized memory for any future consumer.
+        dtt_ref[0] = jnp.zeros_like(dtt_ref[0])
 
     dpt = [jnp.sum(jnp.where(pbucket == b, ds, 0.0)) for b in range(num_pos_buckets)]
     dpt_ref[0] += jnp.stack(dpt)[None, :]

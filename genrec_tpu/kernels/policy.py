@@ -1,0 +1,45 @@
+"""Central auto-enable policy for the Pallas kernels.
+
+Every trainer exposing a three-state kernel flag ("auto" / True / False)
+resolves "auto" through this module so the policy — and the operational
+kill-switch — live in exactly one place.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def pallas_disabled() -> bool:
+    """GENREC_TPU_DISABLE_PALLAS=1 is the operational kill-switch: the TPU
+    watchdog (scripts/tpu_watchdog.sh) sets it when kernel preflight fails
+    so a broken Mosaic compile cannot wedge a bench or training run. It
+    only affects "auto" resolution; explicit True still opts in."""
+    return os.environ.get("GENREC_TPU_DISABLE_PALLAS", "").strip().lower() in (
+        "1",
+        "true",
+    )
+
+
+def auto_fused_ce(tensor_parallel: int = 1) -> bool:
+    """"auto" policy for the fused linear+CE kernel (kernels/fused_ce.py).
+
+    On for single-chip TPU runs only: compiled Mosaic partitioning under
+    multi-chip GSPMD is hardware-validated single-chip only (docs/PERF.md),
+    and tensor_parallel > 1 vocab-shards the head, which the dense kernel
+    cannot partition over (the sharded path is kernels/fused_ce.py
+    sharded_fused_linear_ce, wired separately by the trainers).
+    """
+    return (
+        not pallas_disabled()
+        and jax.default_backend() == "tpu"
+        and jax.device_count() == 1
+        and tensor_parallel == 1
+    )
+
+
+def auto_pallas_attention() -> bool:
+    """"auto" policy for the fused HSTU attention kernel (fwd + bwd)."""
+    return not pallas_disabled() and jax.default_backend() == "tpu"

@@ -219,6 +219,46 @@ def run(interpret: bool = False) -> dict:
     except Exception as e:  # noqa: BLE001
         res["kernels"]["fused_linear_ce"] = {"ok": False, "error": repr(e)}
 
+    # --- Vocab-sharded fused CE (the LCRec tp>1 head path): shard_map
+    # over a 1-wide "model" axis on whatever devices exist — single-chip
+    # this still exercises the full sharded code path (axis_index, the
+    # vlim scalar input, psum/pmax merge) under Mosaic compilation. ---
+    try:
+        from jax.sharding import Mesh
+
+        from genrec_tpu.kernels.fused_ce import sharded_fused_linear_ce
+
+        R, V, D = (256, 1000, 48) if interpret else (6400, 12160, 64)
+        x = jnp.asarray(rng.normal(size=(R, D)), jnp.float32)
+        w = jnp.asarray(rng.normal(size=(V, D)) * 0.1, jnp.float32)
+        tgt = jnp.asarray(rng.integers(0, V, (R,)), jnp.int32)
+        live = V - V // 16  # exercise the dynamic vocab limit
+        tgt = jnp.minimum(tgt, live - 1)
+        n_dev = jax.device_count()
+        mesh = Mesh(
+            np.array(jax.devices()).reshape(1, n_dev), ("data", "model")
+        )
+        got = jax.jit(
+            lambda x, w: sharded_fused_linear_ce(
+                x, w, tgt, mesh, "model", "data", 0, live
+            )
+        )(x, w)
+        ref = jax.jit(lambda x, w: linear_ce_xla(x, w[:live], tgt))(x, w)
+        err = float(np.max(np.abs(np.asarray(got) - np.asarray(ref))))
+        entry = {"max_abs_err": err, "ok": bool(err < 1e-3), "tp": n_dev}
+        if not interpret:
+            entry["pallas_ms"] = _bench_chained(
+                lambda x, w: jax.grad(
+                    lambda x: sharded_fused_linear_ce(
+                        x, w, tgt, mesh, "model", "data", 0, live
+                    ).sum()
+                )(x),
+                x, w,
+            )
+        res["kernels"]["sharded_fused_linear_ce"] = entry
+    except Exception as e:  # noqa: BLE001
+        res["kernels"]["sharded_fused_linear_ce"] = {"ok": False, "error": repr(e)}
+
     # --- RQ cascade (rqvae-scale: B2048 D32 L3 K256) ---
     try:
         Bq, Dq, Lq, Kq = (128, 16, 3, 20) if interpret else (2048, 32, 3, 256)

@@ -141,6 +141,67 @@ def sft_loss(model: QwenLM, params, input_ids, attention_mask, labels,
     return per_tok.sum() / jnp.maximum(valid.sum(), 1) + aux
 
 
+def make_tp_sharded_fused_sft_loss(model: QwenLM, mesh, valid_vocab: int):
+    """SFT loss with the fused CE running vocab-SHARDED over the "model"
+    mesh axis (tensor parallelism).
+
+    The backbone runs under GSPMD auto-sharding (qwen_rules constraints,
+    as the plain tp path does); only the head CE enters a shard_map region:
+    each model shard runs the dense fused kernel over its (Vpad/tp, d)
+    slice of the head with offset-mapped targets, and the per-shard online
+    softmax accumulators merge with one pmax + two psums
+    (kernels/fused_ce.sharded_fused_linear_ce — a global-level custom_vjp
+    whose fwd AND bwd each run their own primal-only shard_map). This is
+    the configuration the dense fused path must refuse (a pallas_call is
+    not GSPMD-partitionable over the vocab dim); inside shard_map the
+    kernel only ever sees per-device local shapes, so no GSPMD
+    partitioning of the Mosaic call is needed. Loss matches the replicated
+    fused path to fp32 rounding; reference semantics as in sft_loss (ref
+    lcrec_trainer.py SFT step with -100-masked labels).
+    """
+    from genrec_tpu.kernels.fused_ce import sharded_fused_linear_ce
+
+    d = model.cfg.hidden_size
+
+    def ce(h, w, t):
+        # Global arrays: rows shard over "data", head rows over "model".
+        return sharded_fused_linear_ce(
+            h.reshape(-1, d), w.astype(model.dtype), t.reshape(-1),
+            mesh, "model", "data", -100, valid_vocab,
+        )
+
+    def loss_fn(params, batch):
+        input_ids = batch["input_ids"]
+        attention_mask = batch["attention_mask"]
+        labels = batch["labels"]
+        if model.cfg.num_experts > 0:
+            from genrec_tpu.models.backbones.qwen import collect_moe_aux
+
+            out, mut = model.apply(
+                {"params": params}, input_ids, attention_mask=attention_mask,
+                mutable=["losses"], return_hidden=True, compute_logits=False,
+            )
+            aux = collect_moe_aux(mut)
+        else:
+            out = model.apply(
+                {"params": params}, input_ids, attention_mask=attention_mask,
+                return_hidden=True, compute_logits=False,
+            )
+            aux = 0.0
+        _, h = out
+        w = (
+            params["embed_tokens"]
+            if model.cfg.tie_word_embeddings
+            else params["lm_head"]
+        )
+        t = labels[:, 1:]
+        per_row = ce(h[:, :-1, :], w, t)
+        valid = (t.reshape(-1) != -100).astype(jnp.float32)
+        return per_row.sum() / jnp.maximum(valid.sum(), 1.0) + aux
+
+    return loss_fn
+
+
 def make_sp_sft_loss(
     cfg: QwenConfig,
     mesh,

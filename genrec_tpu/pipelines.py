@@ -77,8 +77,6 @@ def main(argv=None):
     )
     args = ap.parse_args(argv)
     if args.platform:
-        import jax
-
         from genrec_tpu.parallel.mesh import pin_platform
 
         pin_platform(args.platform)

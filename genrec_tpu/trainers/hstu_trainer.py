@@ -124,11 +124,13 @@ def train(
 
     compute_dtype = jnp.bfloat16 if (amp and mixed_precision_type == "bf16") else jnp.float32
     if use_pallas == "auto":
-        # The fused kernel compiles only under Mosaic; interpret mode on
-        # CPU is correct but slow, so auto = TPU-only.
-        use_pallas = jax.default_backend() == "tpu"
+        from genrec_tpu.kernels.policy import auto_pallas_attention
+
+        use_pallas = auto_pallas_attention()
     if use_fused_ce == "auto":
-        use_fused_ce = jax.default_backend() == "tpu"
+        from genrec_tpu.kernels.policy import auto_fused_ce
+
+        use_fused_ce = auto_fused_ce()
     model = HSTU(
         num_items=n_items,
         max_seq_len=max_seq_len,

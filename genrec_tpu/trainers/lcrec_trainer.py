@@ -474,17 +474,41 @@ def train(
             tp_rules=_qr() if tp_pp_combo else None, log_fn=logger.info,
         )
     else:
-        if use_fused_ce == "auto":
-            # TP>1 vocab-shards the head (qwen_rules dim 0); a pallas_call
-            # is not GSPMD-partitionable over it, so auto also requires
-            # tensor_parallel == 1 (the dense matmul stays partitionable).
-            use_fused_ce = (
-                jax.default_backend() == "tpu" and tensor_parallel == 1
+        def _dense_sft_loss(fused: bool):
+            return lambda p, batch: sft_loss(
+                model, p, batch["input_ids"], batch["attention_mask"],
+                batch["labels"], valid_vocab=live_vocab, use_fused_ce=fused,
             )
-        base_loss = lambda p, batch: sft_loss(
-            model, p, batch["input_ids"], batch["attention_mask"], batch["labels"],
-            valid_vocab=live_vocab, use_fused_ce=bool(use_fused_ce),
-        )
+
+        if tensor_parallel > 1:
+            # Vocab-sharded head: the dense fused kernel cannot be
+            # GSPMD-partitioned over the vocab dim, so fused CE routes
+            # through shard_map over the model axis instead (per-device
+            # pallas_calls, per-shard softmax stats merged with pmax/psum).
+            # Auto therefore needs no single-chip gate here — shard_map
+            # never asks GSPMD to split the Mosaic call.
+            if use_fused_ce == "auto":
+                from genrec_tpu.kernels.policy import pallas_disabled
+
+                use_fused_ce = (
+                    jax.default_backend() == "tpu" and not pallas_disabled()
+                )
+            if use_fused_ce:
+                from genrec_tpu.models.lcrec import (
+                    make_tp_sharded_fused_sft_loss,
+                )
+
+                base_loss = make_tp_sharded_fused_sft_loss(
+                    model, mesh, valid_vocab=live_vocab
+                )
+            else:
+                base_loss = _dense_sft_loss(False)
+        else:
+            if use_fused_ce == "auto":
+                from genrec_tpu.kernels.policy import auto_fused_ce
+
+                use_fused_ce = auto_fused_ce(tensor_parallel)
+            base_loss = _dense_sft_loss(bool(use_fused_ce))
 
     if use_lora:
         lora = lora_init(params, jax.random.fold_in(rng, 7), lora_rank, tuple(lora_targets))

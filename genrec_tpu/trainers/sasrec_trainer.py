@@ -125,7 +125,9 @@ def train(
         jnp.bfloat16 if (amp and mixed_precision_type == "bf16") else jnp.float32
     )
     if use_fused_ce == "auto":
-        use_fused_ce = jax.default_backend() == "tpu"
+        from genrec_tpu.kernels.policy import auto_fused_ce
+
+        use_fused_ce = auto_fused_ce()
     model = SASRec(
         num_items=n_items,
         max_seq_len=max_seq_len,

@@ -1,14 +1,24 @@
 #!/bin/bash
 # Probe the axon tunnel every ~9 min; the moment it is up, run the full
-# hardware evidence chain: live bench (seeds out/bench_tpu_last.json +
-# compile cache), kernel preflight (validates + times all four kernels,
-# incl. the new fused CE and HSTU backward), and the MFU profile sweep.
+# hardware evidence chain IN THIS ORDER:
+#   1. live bench — bench.py's TIGER train-step path uses NO Pallas kernels
+#      (grep: no use_fused_ce/use_pallas anywhere in bench.py), so it cannot
+#      be the first thing to compile the never-yet-Mosaic-compiled kernels,
+#      and it has its own careful dead-tunnel fallback ladder. Running it
+#      first banks the headline evidence (out/bench_tpu_last.json + compile
+#      cache) before anything riskier touches the chip.
+#   2. kernel preflight — validates + times all kernels incl. fused CE
+#      fwd/bwd, the sharded fused CE, and the HSTU backward. May hang in a
+#      Mosaic compile; by then backend init is proven good (bench ran), so
+#      a timeout kill is not the mid-backend-init wedge bench.py warns
+#      about (bench.py:16-18).
+#   3. MFU profile sweep (TIGER again — no Pallas kernels).
 # Writes /tmp/tpu_watchdog.status lines as it goes.
 cd "$(dirname "$0")/.."
 for i in $(seq 1 "${1:-12}"); do
   if timeout 120 python -c "import jax; jax.devices()" > /dev/null 2>&1; then
     echo "tunnel UP at attempt $i $(date -u +%H:%M:%S)" >> /tmp/tpu_watchdog.status
-    python bench.py > out/bench_live.json 2> out/bench_live.err
+    timeout 2400 python bench.py > out/bench_live.json 2> out/bench_live.err
     echo "bench rc=$? $(cat out/bench_live.json | head -c 200)" >> /tmp/tpu_watchdog.status
     timeout 900 python -m genrec_tpu.kernels.preflight > out/preflight_live.json 2> out/preflight_live.err
     echo "preflight rc=$?" >> /tmp/tpu_watchdog.status
