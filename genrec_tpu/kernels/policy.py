@@ -43,3 +43,11 @@ def auto_fused_ce(tensor_parallel: int = 1) -> bool:
 def auto_pallas_attention() -> bool:
     """"auto" policy for the fused HSTU attention kernel (fwd + bwd)."""
     return not pallas_disabled() and jax.default_backend() == "tpu"
+
+
+def auto_sharded_fused_ce() -> bool:
+    """"auto" policy for the vocab-SHARDED fused CE (LCRec tp>1 head,
+    kernels/fused_ce.sharded_fused_linear_ce). No single-chip gate:
+    shard_map hands each device a local pallas_call, so GSPMD never has
+    to partition the Mosaic call."""
+    return not pallas_disabled() and jax.default_backend() == "tpu"

@@ -183,6 +183,14 @@ def train(
     dataset_folder="dataset/amazon",
     split="beauty",
     sem_ids_path=None,
+    # History window for the task prompts (reference lcrec max_seq_len,
+    # amazon_lcrec.py:183 — caps every seqrec/fusionseqrec/itemsearch
+    # history and the eval history); amazon dataset path only.
+    max_history=20,
+    # Training samples drawn per user per task stream (our sampler's
+    # budget knob; the reference generates per-position samples and caps
+    # with max_train_samples instead).
+    samples_per_user=2,
     # Sampling weights over data.lcrec_tasks.TASKS (seqrec, item2index,
     # index2item, fusionseqrec, itemsearch, preferenceobtain); None = the
     # reference's default mix. The debug config pins seqrec-only, matching
@@ -198,6 +206,11 @@ def train(
     max_train_samples=0,
     max_eval_samples=0,
     resume_from_checkpoint=False,
+    # True: final evals use the best-valid-Recall@10 weights (the
+    # sasrec/hstu reference protocol). False: final-epoch weights — the
+    # reference LCRec protocol (lcrec_trainer.py:426-431 saves final only,
+    # no best tracking); the parity harness uses False.
+    test_on_best=True,
     eval_every_epoch=2,
     eval_batch_size=16,
     save_dir_root="out/lcrec",
@@ -339,7 +352,8 @@ def train(
             hf_tok = AutoTokenizer.from_pretrained(pretrained_path)
         data, tok = amazon_lcrec_data(
             dataset_folder, split, sem_ids_path,
-            tokenizer=hf_tok, max_len=max_text_len, seed=seed, **tw_extra,
+            tokenizer=hf_tok, max_len=max_text_len,
+            max_history=max_history, seed=seed, **tw_extra,
         )
         num_codebooks = int(data.sem_ids.shape[1])
         codebook_size = int(tok.codebook_size)
@@ -433,7 +447,7 @@ def train(
         + (f" (+{cfg.vocab_size - live_vocab} pad)" if cfg.vocab_size > live_vocab else "")
     )
 
-    train_arrays = data.train_arrays()
+    train_arrays = data.train_arrays(samples_per_user=samples_per_user)
     valid_arrays = data.eval_arrays("valid")
     test_arrays = data.eval_arrays("test")
     if max_train_samples > 0:
@@ -488,11 +502,9 @@ def train(
             # Auto therefore needs no single-chip gate here — shard_map
             # never asks GSPMD to split the Mosaic call.
             if use_fused_ce == "auto":
-                from genrec_tpu.kernels.policy import pallas_disabled
+                from genrec_tpu.kernels.policy import auto_sharded_fused_ce
 
-                use_fused_ce = (
-                    jax.default_backend() == "tpu" and not pallas_disabled()
-                )
+                use_fused_ce = auto_sharded_fused_ce()
             if use_fused_ce:
                 from genrec_tpu.models.lcrec import (
                     make_tp_sharded_fused_sft_loss,
@@ -634,7 +646,9 @@ def train(
             tracker.log({"epoch": epoch, **{f"eval/{k}": v for k, v in m.items()}})
             best.update(m["Recall@10"], state.params)
 
-    final_trainable = best.best_params(like=state.params)
+    final_trainable = (
+        best.best_params(like=state.params) if test_on_best else None
+    )
     if final_trainable is None:
         final_trainable = state.params
     final_params = params_of(final_trainable)

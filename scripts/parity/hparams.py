@@ -58,7 +58,26 @@ RQVAE = dict(
     eval_every=20, amp=False,
 )
 
+# LCRec stage 2 (SFT over the 6-task mix on a shared tiny local Qwen2
+# backbone — synth.ensure_tiny_qwen; both sides load the SAME checkpoint
+# + tokenizer dir, so backbone weights and text tokenization are
+# identical; the ~96 new codebook-token rows are independently random on
+# each side, as any two reference runs' would be). Reference defaults
+# (lcrec_trainer.py:271-285) except: tiny backbone, fewer epochs, amp off,
+# full fine-tune (use_lora=False on both sides), capped train/eval
+# samples — CPU debug scale, like every other family here.
+LCREC = dict(
+    epochs=4, batch_size=8, learning_rate=3e-4, weight_decay=0.01,
+    warmup_ratio=0.01, max_length=256, num_codebooks=3, codebook_size=32,
+    max_seq_len=10, eval_batch_size=16, eval_beam_width=10,
+    max_train_samples=8000, max_eval_samples=500, amp=False,
+    enabled_tasks=[
+        "seqrec", "item2index", "index2item", "fusionseqrec",
+        "itemsearch", "preferenceobtain",
+    ],
+)
+
 BY_MODEL = {
     "sasrec": SASREC, "hstu": HSTU, "tiger": TIGER, "cobra": COBRA,
-    "rqvae": RQVAE,
+    "rqvae": RQVAE, "lcrec": LCREC,
 }

@@ -33,16 +33,24 @@ def main():
     p.add_argument("--epochs", type=int, default=None)
     p.add_argument("--root", default="/tmp/genrec_parity_data")
     p.add_argument("--out-dir", default="results/parity")
+    # North-star-resolution runs (VERDICT r4 next #3): ~20k eval users
+    # drop σ to ~0.003 so the ±0.002 gate bites. Use a DIFFERENT --root —
+    # the stamp would otherwise regenerate over the 2k artifacts.
+    p.add_argument("--n-users", type=int, default=None)
     a = p.parse_args()
 
-    from scripts.parity import synth
+    from scripts.parity import hparams, synth
 
-    synth.generate(a.root)
-    # Eval-set size = users with len>=3 sequences = all of them.
-    n_eval = synth.N_USERS
+    synth.generate(a.root, n_users=a.n_users)
 
     py = [sys.executable, "-m"]
     for model in a.models:
+        # Eval-set size = users with len>=3 sequences = all of them,
+        # except where the family's protocol caps the eval set (lcrec).
+        n_eval = a.n_users or synth.N_USERS
+        cap = hparams.BY_MODEL[model].get("max_eval_samples")
+        if cap:
+            n_eval = min(n_eval, cap)
         ref_out = os.path.join(a.out_dir, f"ref_{model}.json")
         tpu_out = os.path.join(a.out_dir, f"tpu_{model}.json")
         summary = os.path.join(a.out_dir, f"{model}_summary.json")
@@ -55,6 +63,9 @@ def main():
                    "--n-eval", str(n_eval), "--out", summary])
         with open(os.path.join(REPO, summary)) as f:
             print(json.dumps(json.load(f)["test"], indent=1))
+
+    # One combined artifact for judging/CI (summary.json + SUMMARY.md).
+    _run(py + ["scripts.parity.summarize", "--dir", a.out_dir])
 
 
 if __name__ == "__main__":

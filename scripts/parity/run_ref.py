@@ -177,6 +177,107 @@ def _run_cobra(root: str, split: str, hp: dict, records: list):
         )
 
 
+def _run_lcrec(root: str, split: str, hp: dict, records: list):
+    """Reference LCRec via its own train() (lcrec_trainer.py:271-442): SFT
+    over the 6-task mix, constrained beam-10 seqrec eval per epoch with
+    per-codebook accuracy + TopK Recall/NDCG. The dataset class is a
+    train() parameter; the adapter injects the shared sem-id table in
+    place of the RQ-VAE-checkpoint load (amazon_lcrec.py:234-251) and lets
+    the reference's OWN meta/sequence loaders parse the shared synthetic
+    reviews + meta gzips. The backbone is the shared tiny local Qwen2
+    checkpoint (synth.ensure_tiny_qwen) — both frameworks start from
+    identical weights and tokenize with the same files. Eval metrics are
+    recorded by wrapping the module-level evaluate()."""
+    import random
+
+    import numpy as np
+
+    import genrec.trainers.lcrec_trainer as T
+    from genrec.data.amazon_lcrec import AmazonLCRecDataset
+
+    from genrec_tpu.data.sem_ids import load_sem_ids
+    from scripts.parity import synth
+
+    random.seed(0)
+    np.random.seed(0)
+    synth.ensure_meta(root, split)
+    qwen_dir = synth.ensure_tiny_qwen(root)
+    sem_ids, _ = load_sem_ids(
+        synth.ensure_sem_ids(
+            root, split, codebook_size=hp["codebook_size"],
+            sem_id_dim=hp["num_codebooks"],
+        )
+    )
+    shared_rows = [list(map(int, r)) for r in np.asarray(sem_ids)]
+
+    class ParityLCRecDataset(AmazonLCRecDataset):
+        def __init__(self, root, train_test_split="train", max_seq_len=20,
+                     max_text_len=128, **kw):
+            self.root = root
+            self.split = split.lower()
+            self.train_test_split = train_test_split
+            self._max_seq_len = max_seq_len
+            self.max_text_len = max_text_len
+            self.n_codebooks = hp["num_codebooks"]
+            self.codebook_size = hp["codebook_size"]
+            self.enabled_tasks = set(hp["enabled_tasks"])
+            # The reference's default mix (amazon_lcrec.py:214-221).
+            self.task_sample_weights = {
+                "seqrec": 1.0, "item2index": 0.5, "index2item": 0.5,
+                "fusionseqrec": 0.5, "itemsearch": 0.3,
+                "preferenceobtain": 0.3,
+            }
+            self.sem_ids_list = shared_rows
+            # The reference's own loaders parse the shared synthetic meta
+            # + reviews gzips (they also set self.num_items).
+            self._load_item_metadata()
+            self._load_sequences()
+            self._generate_samples()
+
+    orig_eval = T.evaluate
+
+    def recording_eval(*a, **k):
+        metrics, topk = orig_eval(*a, **k)
+        flat = {k2: float(v) for k2, v in topk.items()}
+        sq = metrics.get("seqrec", {})
+        if sq.get("total"):
+            flat["seqrec_exact"] = sq["exact"] / sq["total"]
+            for c, v in enumerate(sq["correct"]):
+                # genrec_tpu's name for the same quantity.
+                flat[f"codebook_acc_{c}"] = v / sq["total"]
+        i2i = metrics.get("item2index", {})
+        if i2i.get("total"):
+            flat["item2index_exact"] = i2i["exact"] / i2i["total"]
+        idx2 = metrics.get("index2item", {})
+        if idx2.get("total"):
+            flat["index2item_match"] = idx2["exact"] / idx2["total"]
+        records.append(flat)
+        return metrics, topk
+
+    T.evaluate = recording_eval
+
+    with tempfile.TemporaryDirectory() as td:
+        T.train(
+            dataset=ParityLCRecDataset, dataset_folder=root,
+            save_dir_root=td, wandb_logging=False,
+            epochs=hp["epochs"], batch_size=hp["batch_size"],
+            learning_rate=hp["learning_rate"],
+            weight_decay=hp["weight_decay"],
+            warmup_ratio=hp["warmup_ratio"],
+            gradient_accumulate_every=1, max_length=hp["max_length"],
+            pretrained_path=qwen_dir, use_lora=False,
+            num_codebooks=hp["num_codebooks"],
+            codebook_size=hp["codebook_size"],
+            max_seq_len=hp["max_seq_len"], max_text_len=hp["max_length"],
+            do_eval=True, eval_every_epoch=1,
+            eval_batch_size=hp["eval_batch_size"],
+            eval_beam_width=hp["eval_beam_width"],
+            save_every_epoch=10_000, amp=hp["amp"],
+            max_train_samples=hp["max_train_samples"],
+            max_eval_samples=hp["max_eval_samples"],
+        )
+
+
 def _run_rqvae(root: str, split: str, hp: dict, records: list):
     """Reference RQ-VAE stage 1 via its own train(): the dataset class is
     a train() parameter (rqvae_trainer.py:60, 109). The adapter serves
@@ -300,6 +401,8 @@ def run_model(model: str, root: str, split: str, out_path: str, epochs: int | No
         _run_tiger(root, split, hp, records)
     elif model == "cobra":
         _run_cobra(root, split, hp, records)
+    elif model == "lcrec":
+        _run_lcrec(root, split, hp, records)
     elif model == "rqvae":
         _run_rqvae(root, split, hp, records)
         collisions = [r for r in records if "collision_rate" in r]
@@ -361,6 +464,12 @@ def run_model(model: str, root: str, split: str, out_path: str, epochs: int | No
             "reference COBRA has no test eval; 'test' is the final-epoch "
             "valid eval (beam_fusion)"
         )
+    if model == "lcrec":
+        out["protocol_note"] = (
+            "reference LCRec has no test eval (final save only, "
+            "lcrec_trainer.py:426-431); 'test' is the final-epoch valid "
+            "eval (constrained beam-10 seqrec)"
+        )
     os.makedirs(os.path.dirname(out_path), exist_ok=True)
     with open(out_path, "w") as f:
         json.dump(out, f, indent=1)
@@ -369,7 +478,10 @@ def run_model(model: str, root: str, split: str, out_path: str, epochs: int | No
 
 def main():
     p = argparse.ArgumentParser()
-    p.add_argument("model", choices=["sasrec", "hstu", "tiger", "cobra", "rqvae"])
+    p.add_argument(
+        "model",
+        choices=["sasrec", "hstu", "tiger", "cobra", "rqvae", "lcrec"],
+    )
     p.add_argument("--root", default="dataset/parity")
     p.add_argument("--split", default="beauty")
     p.add_argument("--out", required=True)

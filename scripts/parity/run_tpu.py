@@ -85,6 +85,54 @@ def run_model(model: str, root: str, split: str, out_path: str, epochs: int | No
             eval_batch_size=hp["batch_size"],
             test_on_best=False,  # reference protocol: final-epoch weights
         )
+    elif model == "lcrec":
+        from genrec_tpu.trainers.lcrec_trainer import train
+
+        synth.ensure_meta(root, split)
+        qwen_dir = synth.ensure_tiny_qwen(root)
+        sem_path = synth.ensure_sem_ids(
+            root, split, codebook_size=hp["codebook_size"],
+            sem_id_dim=hp["num_codebooks"],
+        )
+        # Reference warmup is a ratio of total steps
+        # (lcrec_trainer.py:343-344); ours takes absolute steps.
+        steps_per_epoch = hp["max_train_samples"] // hp["batch_size"]
+        num_warmup = int(hp["warmup_ratio"] * steps_per_epoch * hp["epochs"])
+        # The reference's task-opportunity weights
+        # (amazon_lcrec.py:214-221), normalized onto our per-sample
+        # categorical over data.lcrec_tasks.TASKS (same task order).
+        ref_w = (1.0, 0.5, 0.5, 0.5, 0.3, 0.3)
+        task_weights = tuple(w / sum(ref_w) for w in ref_w)
+        # samples_per_user so OUR sampler can fill the same train budget
+        # the reference's per-position generator is capped to.
+        spu = max(1, -(-hp["max_train_samples"] // synth.N_USERS))
+        hp_map = dict(
+            epochs=hp["epochs"], batch_size=hp["batch_size"],
+            learning_rate=hp["learning_rate"],
+            weight_decay=hp["weight_decay"],
+            num_warmup_steps=num_warmup,
+            num_codebooks=hp["num_codebooks"],
+            codebook_size=hp["codebook_size"],
+            beam_width=hp["eval_beam_width"],
+            max_text_len=hp["max_length"],
+            max_history=hp["max_seq_len"],
+            samples_per_user=spu,
+            max_train_samples=hp["max_train_samples"],
+            max_eval_samples=hp["max_eval_samples"],
+            eval_batch_size=hp["eval_batch_size"],
+            amp=hp["amp"],
+        )
+        hp.clear()
+        hp.update(hp_map)
+        extra = dict(
+            sem_ids_path=sem_path,
+            pretrained_path=qwen_dir,
+            task_weights=task_weights,
+            eval_every_epoch=1,
+            save_every_epoch=10_000,
+            use_fused_ce=False,  # CPU parity run; auto would be off anyway
+            test_on_best=False,  # reference protocol: final-epoch weights
+        )
     elif model == "rqvae":
         _run_rqvae(root, split, out_path, hp)
         return
@@ -126,13 +174,14 @@ def run_model(model: str, root: str, split: str, out_path: str, epochs: int | No
         "valid_final": valid_metrics,
         "test": test_metrics,
     }
-    if model == "cobra":
-        # The reference COBRA trainer has no test eval; compare on the
-        # final-epoch valid eval (same weights, same split on both sides).
+    if model in ("cobra", "lcrec"):
+        # The reference COBRA and LCRec trainers have no test eval;
+        # compare on the final-epoch valid eval (same weights, same split
+        # on both sides).
         out["test"] = valid_metrics
         out["protocol_note"] = (
             "'test' is the final-epoch valid eval to match the reference "
-            "COBRA trainer (which never evaluates its test split)"
+            f"{model} trainer (which never evaluates its test split)"
         )
     os.makedirs(os.path.dirname(out_path), exist_ok=True)
     with open(out_path, "w") as f:
@@ -211,7 +260,10 @@ def _run_rqvae(root: str, split: str, out_path: str, hp: dict):
 
 def main():
     p = argparse.ArgumentParser()
-    p.add_argument("model", choices=["sasrec", "hstu", "tiger", "cobra", "rqvae"])
+    p.add_argument(
+        "model",
+        choices=["sasrec", "hstu", "tiger", "cobra", "rqvae", "lcrec"],
+    )
     p.add_argument("--root", default="dataset/parity")
     p.add_argument("--split", default="beauty")
     p.add_argument("--out", required=True)

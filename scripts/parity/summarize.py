@@ -1,0 +1,117 @@
+"""Combine the per-family parity summaries into ONE machine-readable file
+(results/parity/summary.json) plus a generated markdown table
+(results/parity/SUMMARY.md) — so judging and CI read a single artifact
+instead of six (VERDICT r4 next #8).
+
+Usage: python -m scripts.parity.summarize [--dir results/parity]
+(also invoked automatically at the end of run_all).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+
+FAMILIES = ("sasrec", "hstu", "tiger", "rqvae", "cobra", "lcrec")
+
+
+def combine(out_dir: str) -> dict:
+    combined: dict = {"families": {}, "all_gates_pass": True}
+    for path in sorted(glob.glob(os.path.join(out_dir, "*_summary.json"))):
+        name = os.path.basename(path)[: -len("_summary.json")]
+        if name not in FAMILIES:
+            continue
+        with open(path) as f:
+            s = json.load(f)
+        # gate_pass (one-sided, outperforming passes) where present;
+        # legacy artifacts only carry the symmetric all_within_2_std.
+        gate = bool(s.get("gate_pass", s.get("all_within_2_std")))
+        rows = {}
+        for metric, row in s.get("test", {}).items():
+            if not isinstance(row, dict):
+                continue
+            entry = {
+                k: row[k]
+                for k in (
+                    "reference", "genrec_tpu", "delta", "rel_delta",
+                    "eval_noise_std", "within_2_std", "ok",
+                    "informational", "missing",
+                )
+                if k in row
+            }
+            rows[metric] = entry
+        combined["families"][name] = {
+            "gate": gate,
+            "n_eval": s.get("n_eval"),
+            "note": s.get("note"),
+            "metrics": rows,
+        }
+        combined["all_gates_pass"] = combined["all_gates_pass"] and gate
+    return combined
+
+
+def to_markdown(combined: dict) -> str:
+    lines = [
+        "# Parity summary (generated — do not edit)",
+        "",
+        "Regenerate: `python -m scripts.parity.summarize`. Full context "
+        "and per-epoch curves: `README.md` + `{model}_summary.json`.",
+        "",
+        "| family | gate | metric | reference | genrec_tpu | delta | 2σ |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    for fam in FAMILIES:
+        info = combined["families"].get(fam)
+        if not info:
+            continue
+        gate = "PASS" if info["gate"] else "FAIL"
+        for metric, row in info["metrics"].items():
+            if row.get("informational"):
+                gate_cell = "info"
+            elif row.get("missing"):
+                gate_cell = "missing"
+            else:
+                gate_cell = gate
+            delta = row.get("delta", row.get("rel_delta", ""))
+            two_sigma = (
+                round(2 * row["eval_noise_std"], 4)
+                if "eval_noise_std" in row
+                else ""
+            )
+            lines.append(
+                f"| {fam} | {gate_cell} | {metric} "
+                f"| {row.get('reference', '')} | {row.get('genrec_tpu', '')} "
+                f"| {delta} | {two_sigma} |"
+            )
+    lines.append("")
+    lines.append(
+        f"Overall: {'ALL GATES PASS' if combined['all_gates_pass'] else 'GATE FAILURES PRESENT'} "
+        f"({len(combined['families'])} families)."
+    )
+    return "\n".join(lines) + "\n"
+
+
+def write(out_dir: str) -> dict:
+    combined = combine(out_dir)
+    with open(os.path.join(out_dir, "summary.json"), "w") as f:
+        json.dump(combined, f, indent=1)
+    with open(os.path.join(out_dir, "SUMMARY.md"), "w") as f:
+        f.write(to_markdown(combined))
+    return combined
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--dir", default="results/parity")
+    a = p.parse_args()
+    combined = write(a.dir)
+    print(json.dumps(
+        {"all_gates_pass": combined["all_gates_pass"],
+         "families": {k: v["gate"] for k, v in combined["families"].items()}}
+    ))
+
+
+if __name__ == "__main__":
+    main()

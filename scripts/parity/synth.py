@@ -31,11 +31,18 @@ MIN_LEN, MAX_LEN = 5, 28
 STAY_P, PREF_P = 0.55, 0.35  # remaining 0.10 = uniform exploration
 
 
-def generate(root: str, split: str = "beauty", seed: int = 7) -> str:
+def generate(root: str, split: str = "beauty", seed: int = 7,
+             n_users: int | None = None) -> str:
     """Write the reviews gzip (idempotent per parameter set) and return its
     path. A params-stamp sidecar invalidates the cache when the generator
     constants or seed change, so a stale file can never silently feed a
-    run labeled with the new parameters."""
+    run labeled with the new parameters.
+
+    ``n_users`` overrides N_USERS (same item/cluster structure): the
+    north-star-resolution runs (VERDICT r4 next #3) use ~20k eval users in
+    a SEPARATE root so σ on a recall estimate drops to ~0.003 and the
+    ±0.002 gate (BASELINE.md) actually bites."""
+    n_users = N_USERS if n_users is None else n_users
     fname = {
         "beauty": "reviews_Beauty_5.json.gz",
         "sports": "reviews_Sports_and_Outdoors_5.json.gz",
@@ -45,7 +52,7 @@ def generate(root: str, split: str = "beauty", seed: int = 7) -> str:
     stamp_path = path + ".params.json"
     stamp = json.dumps(
         {
-            "n_items": N_ITEMS, "n_clusters": N_CLUSTERS, "n_users": N_USERS,
+            "n_items": N_ITEMS, "n_clusters": N_CLUSTERS, "n_users": n_users,
             "min_len": MIN_LEN, "max_len": MAX_LEN, "stay_p": STAY_P,
             "pref_p": PREF_P, "seed": seed,
         },
@@ -73,7 +80,7 @@ def generate(root: str, split: str = "beauty", seed: int = 7) -> str:
     pop /= pop.sum()
 
     records = []
-    for u in range(N_USERS):
+    for u in range(n_users):
         n_pref = rng.integers(2, 4)
         prefs = rng.choice(N_CLUSTERS, size=n_pref, replace=False)
         length = int(rng.integers(MIN_LEN, MAX_LEN + 1))
@@ -146,6 +153,149 @@ def item_token_table(max_text_len: int = 16, vocab: int = 2048,
     table = np.zeros((N_ITEMS, max_text_len), np.int64)
     table[:, :n_real] = rng.integers(2, vocab, (N_ITEMS, n_real))
     return table.astype(np.int32)
+
+
+def ensure_meta(root: str, split: str = "beauty", seed: int = 23) -> str:
+    """Write the meta gzip (meta_Beauty.json.gz shape) both LCRec data
+    layers parse with their OWN loaders (reference amazon_lcrec.py
+    _load_item_metadata; ours data/lcrec_tasks.load_lcrec_item_meta):
+    JSON lines with asin / title / brand / categories. Titles are
+    item-unique word strings drawn from a small vocabulary; categories
+    encode the item's cluster, so item text carries the same structure the
+    sequences follow. A few items are deliberately ABSENT so both sides'
+    missing-item fallbacks (item_<i>) get exercised identically."""
+    meta_name = {
+        "beauty": "meta_Beauty.json.gz",
+        "sports": "meta_Sports_and_Outdoors.json.gz",
+        "toys": "meta_Toys_and_Games.json.gz",
+    }[split]
+    path = os.path.join(root, "raw", split, meta_name)
+    stamp_path = path + ".params.json"
+    stamp = json.dumps({"n_items": N_ITEMS, "seed": seed}, sort_keys=True)
+    if os.path.exists(path):
+        try:
+            with open(stamp_path) as f:
+                if f.read() == stamp:
+                    return path
+        except OSError:
+            pass
+        os.remove(path)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+
+    rng = np.random.default_rng(seed)
+    adjectives = [
+        "gentle", "daily", "classic", "fresh", "pure", "golden", "silky",
+        "rich", "light", "deep", "soft", "bright", "calm", "warm",
+    ]
+    nouns = [
+        "cream", "serum", "balm", "cleanser", "lotion", "oil", "mask",
+        "toner", "scrub", "mist", "gel", "butter", "soap", "wash",
+    ]
+    brands = ["Aurelle", "Bloomcare", "Clearbay", "Dermia", "Everglow"]
+    per_cluster = N_ITEMS // N_CLUSTERS
+    with gzip.open(path, "wt", encoding="utf-8") as f:
+        for item in range(N_ITEMS):
+            if rng.random() < 0.05:
+                continue  # missing meta: both sides fall back to item_<i>
+            cluster = item // per_cluster
+            title = (
+                f"{adjectives[int(rng.integers(len(adjectives)))]} "
+                f"{nouns[int(rng.integers(len(nouns)))]} no {item}"
+            )
+            rec = {
+                "asin": f"I{item:05d}",
+                "title": title,
+                "categories": [["Beauty", f"Cluster {cluster}"]],
+            }
+            if rng.random() < 0.7:
+                rec["brand"] = brands[int(rng.integers(len(brands)))]
+            f.write(json.dumps(rec) + "\n")
+    with open(stamp_path, "w") as f:
+        f.write(stamp)
+    return path
+
+
+def ensure_tiny_qwen(root: str, hidden: int = 64, layers: int = 2,
+                     heads: int = 4, kv_heads: int = 2, inter: int = 128,
+                     vocab: int = 1024, seed: int = 29) -> str:
+    """Build a LOCAL tiny random-init Qwen2 HF checkpoint + byte-level BPE
+    tokenizer dir (zero egress — nothing downloads). BOTH LCRec parity
+    sides load this one directory: the reference via
+    AutoModelForCausalLM/AutoTokenizer (models/lcrec.py:38-40), genrec_tpu
+    via backbones/qwen.params_from_hf_state_dict — so the two frameworks
+    start from IDENTICAL backbone weights and tokenize text identically."""
+    out_dir = os.path.join(root, "tiny_qwen")
+    stamp_path = os.path.join(out_dir, "params.stamp.json")
+    stamp = json.dumps(
+        {"hidden": hidden, "layers": layers, "heads": heads,
+         "kv": kv_heads, "inter": inter, "vocab": vocab, "seed": seed},
+        sort_keys=True,
+    )
+    if os.path.exists(stamp_path):
+        with open(stamp_path) as f:
+            if f.read() == stamp:
+                return out_dir
+
+    import torch
+    from tokenizers import Tokenizer, models, pre_tokenizers, trainers
+    from transformers import PreTrainedTokenizerFast, Qwen2Config, Qwen2ForCausalLM
+
+    os.makedirs(out_dir, exist_ok=True)
+
+    # Corpus: the synthetic item texts (titles/brands/clusters) plus both
+    # frameworks' instruction-template wording, so neither side pays a
+    # byte-fallback penalty for its own prompts.
+    from genrec_tpu.data.lcrec_tasks import load_lcrec_item_meta
+
+    ensure_meta(root)
+    titles, texts, cats = load_lcrec_item_meta(root, "beauty")
+    corpus = list(texts) + list(titles) + list(cats)
+    corpus += [
+        "### Instruction: ### Response: Below is an instruction that "
+        "describes a task. Write a response that appropriately completes "
+        "the request.",
+        "user interaction history items viewed so far in order predict "
+        "the next item index title description brand category query "
+        "search preference summarize recommend purchase",
+        "0 1 2 3 4 5 6 7 8 9 10 11 12 13 14 15 16 17 18 19 20",
+    ]
+
+    tok = Tokenizer(models.BPE(unk_token=None, byte_fallback=False))
+    tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    trainer = trainers.BpeTrainer(
+        vocab_size=vocab - 2,  # leave room for eos/pad specials
+        special_tokens=[],
+        initial_alphabet=pre_tokenizers.ByteLevel.alphabet(),
+        show_progress=False,
+    )
+    tok.train_from_iterator(corpus, trainer)
+    hf_tok = PreTrainedTokenizerFast(
+        tokenizer_object=tok,
+        eos_token="<|endoftext|>",
+        pad_token="<|pad|>",
+    )
+    hf_tok.save_pretrained(out_dir)
+    true_vocab = len(hf_tok)
+
+    torch.manual_seed(seed)
+    cfg = Qwen2Config(
+        vocab_size=true_vocab,
+        hidden_size=hidden,
+        intermediate_size=inter,
+        num_hidden_layers=layers,
+        num_attention_heads=heads,
+        num_key_value_heads=kv_heads,
+        max_position_embeddings=512,
+        rope_theta=10000.0,
+        tie_word_embeddings=False,
+        eos_token_id=hf_tok.eos_token_id,
+        pad_token_id=hf_tok.pad_token_id,
+    )
+    model = Qwen2ForCausalLM(cfg)
+    model.save_pretrained(out_dir)
+    with open(stamp_path, "w") as f:
+        f.write(stamp)
+    return out_dir
 
 
 def item_embedding_matrix(n_items: int = 2000, dim: int = 768,
