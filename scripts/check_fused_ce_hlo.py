@@ -1,0 +1,156 @@
+"""Hardware checklist (VERDICT r4 next #7, docs/PERF.md): does XLA
+partition the compiled fused-CE train step without wrapping the
+pallas_call in unexpected full-gathers?
+
+Jit the SASRec fused-CE train step under a {"data": n_devices} mesh with
+sharded-batch annotations and inspect the optimized HLO around the
+Mosaic custom call:
+
+  - `all-gather` results feeding a `tpu_custom_call` operand — a
+    full-gather of activations or head weights around the kernel would
+    mean GSPMD chose to unshard rather than partition, the failure mode
+    the single-chip auto gate guards against (kernels/policy.py).
+  - the custom call's operand shapes vs the logical batch: per-device
+    row counts equal to the GLOBAL row count on a >1-device mesh mean
+    replicated (gathered) inputs even without a literal all-gather op.
+
+HONESTY NOTE (single-chip): on a 1-device mesh XLA elides every
+collective, so both checks are vacuous there — the script then reports
+`conclusive: false` and only certifies that the Mosaic kernel compiled
+inside the sharded-jit program. The partitioning question itself needs
+>= 2 devices (a real slice, or an AOT topology compile once supported);
+the verdict text and the docs/PERF.md note say which of the two cases
+was actually observed.
+
+Run on the TPU host:  python scripts/check_fused_ce_hlo.py
+Appends a verdict line to docs/PERF.md when --write-note is passed
+(the watchdog does).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--write-note", action="store_true",
+                    help="append the verdict to docs/PERF.md")
+    ap.add_argument("--platform", default=None)
+    args = ap.parse_args(argv)
+
+    import jax
+
+    if args.platform:
+        from genrec_tpu.parallel.mesh import pin_platform
+
+        pin_platform(args.platform)
+
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from genrec_tpu.core.harness import make_train_step
+    from genrec_tpu.core.state import TrainState
+    from genrec_tpu.models.sasrec import SASRec
+
+    backend = jax.default_backend()
+    n_dev = jax.device_count()
+    mesh = Mesh(np.array(jax.devices()).reshape(n_dev), ("data",))
+
+    B, L, V, D = 64, 50, 12160, 64
+    model = SASRec(
+        num_items=V, max_seq_len=L, embed_dim=D, num_heads=2, num_blocks=2,
+        ffn_dim=256, dropout=0.0, fused_ce=True, dtype=jnp.bfloat16,
+    )
+    rng = jax.random.key(0)
+    ids = jnp.zeros((B, L), jnp.int32)
+    params = model.init(rng, ids, deterministic=True)["params"]
+    optimizer = optax.adamw(1e-3)
+
+    def loss_fn(p, batch, step_rng):
+        _, loss = model.apply(
+            {"params": p}, batch["input_ids"], targets=batch["targets"],
+            deterministic=True,
+        )
+        return loss, {}
+
+    step = make_train_step(loss_fn, optimizer, clip_norm=1.0)
+    state = TrainState.create(params, optimizer, rng)
+    batch = {
+        "input_ids": jax.device_put(ids, NamedSharding(mesh, P("data"))),
+        "targets": jax.device_put(ids, NamedSharding(mesh, P("data"))),
+    }
+    lowered = jax.jit(step).lower(state, batch)
+    hlo = lowered.compile().as_text()
+
+    custom_calls = re.findall(r".*custom-call.*tpu_custom_call.*", hlo)
+    gathers = re.findall(r".*(all-gather|all-reduce|collective-permute).*", hlo)
+    gather_ids = {
+        m.group(1)
+        for m in re.finditer(r"(\S+) = \S+ all-gather", hlo)
+    }
+    suspicious = [
+        line for line in custom_calls
+        if any(g in line for g in gather_ids)
+    ]
+    # Shape check: the fused-CE row-block inputs should carry the
+    # PER-DEVICE row count (B*L/n_dev rows after padding), not the global
+    # one — global-sized operands on a >1-device mesh mean replicated
+    # (gathered) inputs even without a literal all-gather op.
+    rows_global = B * L
+    global_sized = [
+        line
+        for line in custom_calls
+        if n_dev > 1 and re.search(rf"\b{rows_global}\b", line)
+    ]
+
+    conclusive = n_dev > 1
+    ok = bool(custom_calls) and not suspicious and not global_sized
+    verdict = {
+        "backend": backend,
+        "devices": n_dev,
+        "conclusive": conclusive,
+        "mosaic_custom_calls": len(custom_calls),
+        "collectives_in_module": len(gathers),
+        "all_gather_feeding_custom_call": len(suspicious),
+        "global_sized_custom_call_operands": len(global_sized),
+        "ok": ok,
+    }
+    print(json.dumps(verdict))
+
+    if args.write_note:
+        if not conclusive:
+            msg = (
+                "single-chip run: Mosaic kernel "
+                f"{'compiled inside the sharded-jit program' if custom_calls else 'NOT found in the compiled module'}; "
+                "collectives elided at 1 device, partitioning question "
+                "still open (needs >= 2 chips)"
+            )
+        elif ok:
+            msg = ("OK: kernel partitioned — no all-gather feeds it and "
+                   "operands are per-device-sized")
+        else:
+            msg = "ATTENTION: inspect out/fused_ce_hlo.txt"
+        note = (
+            f"\n- HLO check (scripts/check_fused_ce_hlo.py, backend="
+            f"{backend}, {n_dev} device(s)): {len(custom_calls)} Mosaic "
+            f"custom-call(s) -> {msg}\n"
+        )
+        with open("docs/PERF.md", "a") as f:
+            f.write(note)
+        os.makedirs("out", exist_ok=True)
+        with open("out/fused_ce_hlo.txt", "w") as f:
+            f.write(hlo)
+    return 0 if (custom_calls and not suspicious and not global_sized) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

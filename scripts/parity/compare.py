@@ -89,12 +89,26 @@ def compare(ref_path: str, tpu_path: str, n_eval: int) -> dict:
     # LCRec additionally gates the per-codebook seqrec accuracies (the
     # reference's own eval quantities, lcrec_trainer.py:180-189) — same
     # binomial noise model, they are per-sample hit rates over n_eval.
-    extra = sorted(
-        k for k in ref["test"] if k.startswith("codebook_acc_")
-    )
+    # Union of BOTH sides' keys: a side whose recorder silently dropped a
+    # metric must fail that row, not remove it from the gate. Scoped to
+    # lcrec — other families (cobra) report them on one side only as
+    # extra information, not as a reference-eval quantity.
+    extra = ()
+    if ref.get("model") == "lcrec":
+        extra = sorted(
+            k
+            for k in set(ref["test"]) | set(tpu["test"])
+            if k.startswith("codebook_acc_")
+        )
     for m in METRICS + tuple(extra):
         r, t = ref["test"].get(m), tpu["test"].get(m)
+        if r is None and t is None:
+            continue  # metric genuinely absent from this family's eval
         if r is None or t is None:
+            # One side recorded it, the other didn't: a broken recorder
+            # must read as a FAILED gate, not a skipped row (same
+            # invariant compare_rqvae enforces).
+            rows[m] = {"ok": False, "within_2_std": False, "missing": True}
             continue
         p = (r + t) / 2
         noise = math.sqrt(max(p * (1 - p), 1e-9) / n_eval)

@@ -104,8 +104,11 @@ def run_model(model: str, root: str, split: str, out_path: str, epochs: int | No
         ref_w = (1.0, 0.5, 0.5, 0.5, 0.3, 0.3)
         task_weights = tuple(w / sum(ref_w) for w in ref_w)
         # samples_per_user so OUR sampler can fill the same train budget
-        # the reference's per-position generator is capped to.
-        spu = max(1, -(-hp["max_train_samples"] // synth.N_USERS))
+        # the reference's per-position generator is capped to; scaled to
+        # the root's ACTUAL user count (run_all --n-users roots differ).
+        spu = max(
+            1, -(-hp["max_train_samples"] // synth.users_in(root, split))
+        )
         hp_map = dict(
             epochs=hp["epochs"], batch_size=hp["batch_size"],
             learning_rate=hp["learning_rate"],

@@ -86,10 +86,17 @@ def to_markdown(combined: dict) -> str:
                 f"| {delta} | {two_sigma} |"
             )
     lines.append("")
-    lines.append(
-        f"Overall: {'ALL GATES PASS' if combined['all_gates_pass'] else 'GATE FAILURES PRESENT'} "
-        f"({len(combined['families'])} families)."
+    failing = sorted(
+        f for f, info in combined["families"].items() if not info["gate"]
     )
+    if combined["all_gates_pass"]:
+        overall = f"ALL GATES PASS ({len(combined['families'])} families)"
+    else:
+        overall = (
+            f"{len(failing)}/{len(combined['families'])} families failing: "
+            + ", ".join(failing)
+        )
+    lines.append(f"Overall: {overall}.")
     return "\n".join(lines) + "\n"
 
 

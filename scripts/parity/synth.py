@@ -113,6 +113,23 @@ def generate(root: str, split: str = "beauty", seed: int = 7,
     return path
 
 
+def users_in(root: str, split: str = "beauty") -> int:
+    """User count of the generated reviews file, read from its params
+    stamp — so budget computations (run_tpu's samples_per_user) track the
+    ACTUAL scale of the root (run_all --n-users), not the module default."""
+    fname = {
+        "beauty": "reviews_Beauty_5.json.gz",
+        "sports": "reviews_Sports_and_Outdoors_5.json.gz",
+        "toys": "reviews_Toys_and_Games_5.json.gz",
+    }[split]
+    stamp_path = os.path.join(root, "raw", split, fname + ".params.json")
+    try:
+        with open(stamp_path) as f:
+            return int(json.load(f)["n_users"])
+    except (OSError, KeyError, ValueError):
+        return N_USERS
+
+
 def ensure_sem_ids(root: str, split: str = "beauty", codebook_size: int = 256,
                    sem_id_dim: int = 3, seed: int = 11) -> str:
     """Shared random-unique sem-id artifact for the TIGER parity run.

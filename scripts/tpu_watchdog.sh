@@ -13,6 +13,9 @@
 #      a timeout kill is not the mid-backend-init wedge bench.py warns
 #      about (bench.py:16-18).
 #   3. MFU profile sweep (TIGER again — no Pallas kernels).
+#   4. fused-CE HLO partitioning check (docs/PERF.md hardware checklist):
+#      compiles the fused-CE train step under a 1-chip data mesh and greps
+#      the optimized HLO for all-gathers feeding the Mosaic custom call.
 # Writes /tmp/tpu_watchdog.status lines as it goes.
 cd "$(dirname "$0")/.."
 for i in $(seq 1 "${1:-12}"); do
@@ -24,6 +27,8 @@ for i in $(seq 1 "${1:-12}"); do
     echo "preflight rc=$?" >> /tmp/tpu_watchdog.status
     timeout 1200 python scripts/profile_tiger.py --out results/tpu/profile_summary.json > out/profile_live.log 2>&1
     echo "profile rc=$?" >> /tmp/tpu_watchdog.status
+    timeout 600 python scripts/check_fused_ce_hlo.py --write-note > out/hlo_check.log 2>&1
+    echo "hlo-check rc=$? $(tail -c 200 out/hlo_check.log)" >> /tmp/tpu_watchdog.status
     echo DONE >> /tmp/tpu_watchdog.status
     exit 0
   fi
