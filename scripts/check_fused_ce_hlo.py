@@ -35,7 +35,8 @@ import os
 import re
 import sys
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
 
 
 def main(argv=None):
@@ -113,7 +114,14 @@ def main(argv=None):
     ]
 
     conclusive = n_dev > 1
-    ok = bool(custom_calls) and not suspicious and not global_sized
+    # ok answers "is partitioning VERIFIED good" — inconclusive runs must
+    # not read as a pass to automation keying on ok/rc.
+    ok = (
+        conclusive
+        and bool(custom_calls)
+        and not suspicious
+        and not global_sized
+    )
     verdict = {
         "backend": backend,
         "devices": n_dev,
@@ -144,12 +152,16 @@ def main(argv=None):
             f"{backend}, {n_dev} device(s)): {len(custom_calls)} Mosaic "
             f"custom-call(s) -> {msg}\n"
         )
-        with open("docs/PERF.md", "a") as f:
+        with open(os.path.join(REPO, "docs", "PERF.md"), "a") as f:
             f.write(note)
-        os.makedirs("out", exist_ok=True)
-        with open("out/fused_ce_hlo.txt", "w") as f:
+        os.makedirs(os.path.join(REPO, "out"), exist_ok=True)
+        with open(os.path.join(REPO, "out", "fused_ce_hlo.txt"), "w") as f:
             f.write(hlo)
-    return 0 if (custom_calls and not suspicious and not global_sized) else 1
+    # rc: 0 = verified good; 2 = ran fine but inconclusive (1 device);
+    # 1 = a check failed.
+    if ok:
+        return 0
+    return 2 if (not conclusive and custom_calls) else 1
 
 
 if __name__ == "__main__":

@@ -30,6 +30,19 @@ N_USERS = 2000
 MIN_LEN, MAX_LEN = 5, 28
 STAY_P, PREF_P = 0.55, 0.35  # remaining 0.10 = uniform exploration
 
+# One filename map for generate()/users_in() — the Amazon-2014 names both
+# data layers expect (reference amazon.py DATASET_CONFIGS; ours
+# data/amazon.py DATASET_FILES).
+_SPLIT_FNAME = {
+    "beauty": "reviews_Beauty_5.json.gz",
+    "sports": "reviews_Sports_and_Outdoors_5.json.gz",
+    "toys": "reviews_Toys_and_Games_5.json.gz",
+}
+
+
+def _reviews_stamp_path(root: str, split: str) -> str:
+    return os.path.join(root, "raw", split, _SPLIT_FNAME[split] + ".params.json")
+
 
 def generate(root: str, split: str = "beauty", seed: int = 7,
              n_users: int | None = None) -> str:
@@ -43,13 +56,8 @@ def generate(root: str, split: str = "beauty", seed: int = 7,
     a SEPARATE root so σ on a recall estimate drops to ~0.003 and the
     ±0.002 gate (BASELINE.md) actually bites."""
     n_users = N_USERS if n_users is None else n_users
-    fname = {
-        "beauty": "reviews_Beauty_5.json.gz",
-        "sports": "reviews_Sports_and_Outdoors_5.json.gz",
-        "toys": "reviews_Toys_and_Games_5.json.gz",
-    }[split]
-    path = os.path.join(root, "raw", split, fname)
-    stamp_path = path + ".params.json"
+    path = os.path.join(root, "raw", split, _SPLIT_FNAME[split])
+    stamp_path = _reviews_stamp_path(root, split)
     stamp = json.dumps(
         {
             "n_items": N_ITEMS, "n_clusters": N_CLUSTERS, "n_users": n_users,
@@ -117,14 +125,8 @@ def users_in(root: str, split: str = "beauty") -> int:
     """User count of the generated reviews file, read from its params
     stamp — so budget computations (run_tpu's samples_per_user) track the
     ACTUAL scale of the root (run_all --n-users), not the module default."""
-    fname = {
-        "beauty": "reviews_Beauty_5.json.gz",
-        "sports": "reviews_Sports_and_Outdoors_5.json.gz",
-        "toys": "reviews_Toys_and_Games_5.json.gz",
-    }[split]
-    stamp_path = os.path.join(root, "raw", split, fname + ".params.json")
     try:
-        with open(stamp_path) as f:
+        with open(_reviews_stamp_path(root, split)) as f:
             return int(json.load(f)["n_users"])
     except (OSError, KeyError, ValueError):
         return N_USERS

@@ -1,0 +1,46 @@
+"""Central Pallas auto-enable policy (kernels/policy.py)."""
+
+import os
+
+import jax
+
+from genrec_tpu.kernels import policy
+
+
+def test_cpu_backend_disables_all_autos():
+    # conftest pins the cpu backend, so every auto resolves False here.
+    assert jax.default_backend() == "cpu"
+    assert policy.auto_fused_ce() is False
+    assert policy.auto_fused_ce(tensor_parallel=2) is False
+    assert policy.auto_pallas_attention() is False
+    assert policy.auto_sharded_fused_ce() is False
+
+
+def test_kill_switch_env(monkeypatch):
+    monkeypatch.setenv("GENREC_TPU_DISABLE_PALLAS", "1")
+    assert policy.pallas_disabled() is True
+    assert policy.auto_fused_ce() is False
+    assert policy.auto_sharded_fused_ce() is False
+    monkeypatch.setenv("GENREC_TPU_DISABLE_PALLAS", "true")
+    assert policy.pallas_disabled() is True
+    monkeypatch.setenv("GENREC_TPU_DISABLE_PALLAS", "0")
+    assert policy.pallas_disabled() is False
+    monkeypatch.delenv("GENREC_TPU_DISABLE_PALLAS")
+    assert policy.pallas_disabled() is False
+
+
+def test_dense_auto_requires_single_chip_and_tp1(monkeypatch):
+    # Simulate a TPU backend: the dense kernel additionally requires a
+    # single device and tensor_parallel == 1 (docs/training.md policy);
+    # the sharded variant requires neither.
+    monkeypatch.setattr(policy.jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(policy.jax, "device_count", lambda: 1)
+    assert policy.auto_fused_ce() is True
+    assert policy.auto_fused_ce(tensor_parallel=2) is False
+    monkeypatch.setattr(policy.jax, "device_count", lambda: 8)
+    assert policy.auto_fused_ce() is False
+    assert policy.auto_pallas_attention() is True
+    assert policy.auto_sharded_fused_ce() is True
+    monkeypatch.setenv("GENREC_TPU_DISABLE_PALLAS", "1")
+    assert policy.auto_pallas_attention() is False
+    assert policy.auto_sharded_fused_ce() is False
