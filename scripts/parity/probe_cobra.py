@@ -1,0 +1,79 @@
+"""COBRA learning-scale probe: re-run the parity pair with overridden
+hyperparameters into a SEPARATE out-dir, so the committed
+results/parity artifacts are only replaced if the probe protocol is an
+improvement (both sides higher, gate still green).
+
+Context (VERDICT r4 next #4): at the baseline recipe the reference's
+beam_fusion eval trails its own train-side retrieval ~2x and sits near
+the 10/300 item floor even at 24 epochs (R@10 0.0305); the observed
+epoch trend extrapolates 3x-floor to ~100 epochs on this host. This
+probe tests the cheaper lever — learning rate — at the same epoch
+budget.
+
+Usage: python -m scripts.parity.probe_cobra [--lr 1e-3] [--epochs 24]
+           [--out-dir results/parity_probe] [--root dataset/parity]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--epochs", type=int, default=24)
+    p.add_argument("--root", default="dataset/parity")
+    p.add_argument("--out-dir", default="results/parity_probe")
+    a = p.parse_args()
+
+    from scripts.parity import synth
+
+    synth.generate(a.root)  # idempotent (params-stamped)
+    n_eval = synth.users_in(a.root)
+
+    os.makedirs(os.path.join(REPO, a.out_dir), exist_ok=True)
+    ref_out = os.path.join(a.out_dir, "ref_cobra.json")
+    tpu_out = os.path.join(a.out_dir, "tpu_cobra.json")
+    summary = os.path.join(a.out_dir, "cobra_summary.json")
+
+    # Each side runs in its own subprocess (torch without jax pinning vs
+    # jax-on-CPU), with the lr override injected through a tiny driver
+    # that mutates the shared hparams in-process — run_ref/run_tpu only
+    # expose --epochs on their CLIs.
+    tmpl = (
+        "import scripts.parity.hparams as H\n"
+        "hp = dict(H.COBRA); hp['learning_rate'] = {lr}; hp['epochs'] = {ep}\n"
+        "H.BY_MODEL['cobra'] = hp\n"
+        "from scripts.parity import {mod}\n"
+        "{mod}.run_model('cobra', {root!r}, 'beauty', {out!r}, None)\n"
+    )
+    for mod, out in (("run_ref", ref_out), ("run_tpu", tpu_out)):
+        code = tmpl.format(lr=a.lr, ep=a.epochs, mod=mod, root=a.root, out=out)
+        print(f"+ probe stage {mod} (lr={a.lr}, epochs={a.epochs})",
+              file=sys.stderr, flush=True)
+        subprocess.run([sys.executable, "-c", code], cwd=REPO, check=True)
+
+    subprocess.run(
+        [sys.executable, "-m", "scripts.parity.compare", "--ref", ref_out,
+         "--tpu", tpu_out, "--n-eval", str(n_eval), "--out", summary],
+        cwd=REPO, check=True,
+    )
+    with open(os.path.join(REPO, summary)) as f:
+        s = json.load(f)
+    print(json.dumps({"gate_pass": s.get("gate_pass"),
+                      "test": s["test"]}, indent=1))
+    print(
+        "Promote with: cp", os.path.join(a.out_dir, "*cobra*"),
+        "results/parity/ && python -m scripts.parity.summarize",
+    )
+
+
+if __name__ == "__main__":
+    main()

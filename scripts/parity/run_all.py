@@ -28,8 +28,8 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("--models", nargs="+", default=["sasrec", "hstu"])
     # None = each model's protocol epochs from hparams.py (sasrec/hstu 12,
-    # tiger 6, cobra 8) — overriding globally would silently change the
-    # committed tables' protocols.
+    # tiger 6, cobra 24, lcrec 4) — overriding globally would silently
+    # change the committed tables' protocols.
     p.add_argument("--epochs", type=int, default=None)
     p.add_argument("--root", default="/tmp/genrec_parity_data")
     p.add_argument("--out-dir", default="results/parity")
