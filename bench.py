@@ -73,6 +73,10 @@ TIGER_BENCH_ARCH = dict(
 )
 BENCH_ITEMS = 20
 CPU_BATCH, TPU_BATCH = 32, 256
+# Decode (beam generate) benchmark shapes: the eval/serving hot path the
+# KV-cached incremental engine (models/t5transformer.py) accelerates.
+DECODE_BATCH, DECODE_BEAM_K = 64, 10
+DECODE_TRIE_ITEMS = 1000
 
 
 def host_fingerprint() -> str:
@@ -194,10 +198,64 @@ def _measure(platform: str) -> None:
     if backend == "tpu" and flops_per_step:
         result["mfu"] = round(flops_per_step / (dt / n_steps) / V5E_PEAK_FLOPS, 4)
     # Headline number lands FIRST (the parent keeps the last complete
-    # BENCH_RESULT line even from an abandoned child); the kernel
-    # preflight — a few AOT compiles through the tunnel, cached after the
-    # first run — then enriches it with a second line if it completes.
+    # BENCH_RESULT line even from an abandoned child); the decode bench
+    # and — on TPU — the kernel preflight then enrich it with further
+    # lines as they complete.
     _emit(result)
+
+    # Decode throughput: trie-constrained beam generate over a synthetic
+    # eval batch (KV-cached engine, the default), plus the uncached path
+    # once for the speedup ratio.
+    from genrec_tpu.models.tiger import tiger_generate
+    from genrec_tpu.ops.trie import build_trie
+
+    Bd, K = DECODE_BATCH, DECODE_BEAM_K
+    Kcb = TIGER_BENCH_ARCH["num_item_embeddings"]
+    valid_ids = np.unique(rng.integers(0, Kcb, (DECODE_TRIE_ITEMS, D)), axis=0)
+    trie = build_trie(valid_ids, Kcb)
+    dbatch = dict(
+        user_ids=jnp.asarray(rng.integers(0, 10_000, (Bd,)), jnp.int32),
+        item_input_ids=jnp.asarray(rng.integers(0, Kcb, (Bd, L)), jnp.int32),
+        token_type_ids=jnp.asarray(np.tile(np.arange(D), (Bd, items)), jnp.int32),
+        seq_mask=jnp.ones((Bd, L), jnp.int32),
+    )
+
+    def time_generate(use_cache: bool) -> float:
+        gen = jax.jit(
+            lambda p, key: tiger_generate(
+                model, p, trie, dbatch["user_ids"], dbatch["item_input_ids"],
+                dbatch["token_type_ids"], dbatch["seq_mask"], key,
+                n_top_k_candidates=K, use_cache=use_cache,
+            ).sem_ids
+        )
+        key = jax.random.key(2)
+        np.asarray(gen(state.params, key))  # warmup/compile + host sync
+        t0 = time.perf_counter()
+        np.asarray(gen(state.params, key))
+        per = time.perf_counter() - t0
+        n = max(3, min(50, int(10.0 / max(per, 1e-4))))
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = gen(state.params, key)
+        np.asarray(out)
+        return (time.perf_counter() - t0) / n
+
+    # Guarded like the cost_analysis enrichment above: a decode-bench
+    # failure must not kill the kernel preflight below.
+    try:
+        cached_s = time_generate(True)
+        uncached_s = time_generate(False)
+        result.update(
+            decode_batch_size=Bd,
+            decode_beam_k=K,
+            decode_seq_per_sec=Bd / cached_s,
+            # Whole beam-generate call (all sem_id_dim steps), not one step.
+            decode_call_ms=round(cached_s * 1e3, 2),
+            decode_vs_uncached=round(uncached_s / cached_s, 3),
+        )
+        _emit(result)
+    except Exception as e:
+        print(f"bench: decode benchmark failed: {e!r}", file=sys.stderr)
 
     if backend == "tpu":
         from genrec_tpu.kernels.preflight import run as preflight_run
@@ -496,6 +554,16 @@ def main():
         )
         if "mfu" in result:
             line["mfu"] = result["mfu"]
+        # Second metric: beam-decode throughput (KV-cached engine) and its
+        # speedup over the uncached path, same JSON line so the driver's
+        # single-object parse keeps working.
+        if result.get("decode_seq_per_sec"):
+            line["tiger_decode_seq_per_sec_per_chip"] = round(
+                result["decode_seq_per_sec"] / max(result["n_chips"], 1), 2
+            )
+            line["decode_vs_uncached"] = result.get("decode_vs_uncached")
+            line["decode_batch_size"] = result.get("decode_batch_size")
+            line["decode_beam_k"] = result.get("decode_beam_k")
         # A preflight from the in-round cache is stale in the same way the
         # committed one is — only a LIVE run's preflight is current.
         if "kernel_preflight" in result and source == "live":

@@ -72,6 +72,24 @@ def prefetch_to_device(iterator, mesh, size: int = 2, axis: str = "data"):
         stop.set()  # unblocks + retires the producer on early exit
 
 
+def prefetch_eval_batches(iterator, mesh, size: int = 2, axis: str = "data"):
+    """`prefetch_to_device` for eval loops: yields (sharded, host_batch,
+    valid) so metrics read targets from the EXACT numpy batch that was
+    evaluated — no re-slicing of the source arrays by running offset,
+    which would silently misalign if iteration order ever changed."""
+    packed = ((batch, (valid, batch)) for batch, valid in iterator)
+    for sharded, (valid, host) in prefetch_to_device(packed, mesh, size, axis):
+        yield sharded, host, valid
+
+
+def fold_valid(iterator):
+    """Fold the valid mask into the batch (int32 key "valid") so it ships
+    to device with the prefetching iterator — for eval steps that consume
+    the mask on device."""
+    for batch, valid in iterator:
+        yield {**batch, "valid": valid.astype(np.int32)}, valid
+
+
 def cycle(iterable_factory):
     """Infinite iterator over a re-creatable iterable (reference
     genrec/data/utils.py:7-12, which cycles a DataLoader). Takes a

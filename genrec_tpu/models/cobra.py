@@ -19,8 +19,15 @@ TPU redesign:
   no scatter, no dynamic shapes (SURVEY.md §7 build item 8);
 - the dense-InfoNCE boolean compression (cobra.py:478-479) becomes
   where-masking with a valid-row denominator — static shapes under jit;
-- generation is deterministic top-k beam search composed of C full
-  decoder calls, jit-friendly (static loop, static shapes per step).
+- generation is deterministic top-k beam search, jit-friendly (static
+  loop, static shapes per step). The default cached engine runs the
+  decoder over the dense user-history positions ONCE per eval batch
+  (`decode_prefill`, KV cached per layer at batch size B), then advances
+  only the sem-id suffix per codebook step (`decode_suffix_step`) with
+  the B*K beams resolved by einsum against the shared history K/V —
+  O(B*T^2 + C*B*K*T) instead of the uncached O(C*B*K*T^2) full
+  re-decodes (still available via use_cache=False; parity pinned by
+  tests/test_decode_cache.py).
 """
 
 from __future__ import annotations
@@ -119,11 +126,24 @@ class _TorchMHA(nn.Module):
     dropout: float = 0.0
     dtype: jnp.dtype = jnp.float32
 
-    @nn.compact
+    def setup(self):
+        self.in_proj = nn.Dense(3 * self.dim, dtype=self.dtype, name="in_proj")
+        self.out_proj = nn.Dense(self.dim, dtype=self.dtype, name="out_proj")
+        self.attn_drop = nn.Dropout(self.dropout)
+
     def __call__(self, x, attn_mask=None, key_padding_mask=None, deterministic=True):
+        out, _ = self._full(x, attn_mask, key_padding_mask, deterministic)
+        return out
+
+    def prefill(self, x, attn_mask=None, key_padding_mask=None):
+        """Full forward that also returns (k, v) each (B, H, L, hd) for the
+        incremental-decode cache."""
+        return self._full(x, attn_mask, key_padding_mask, True)
+
+    def _full(self, x, attn_mask, key_padding_mask, deterministic):
         B, L, D = x.shape
         H, hd = self.num_heads, D // self.num_heads
-        qkv = nn.Dense(3 * D, dtype=self.dtype, name="in_proj")(x)
+        qkv = self.in_proj(x)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         split = lambda t: t.reshape(B, L, H, hd).transpose(0, 2, 1, 3)
         q, k, v = split(q), split(k), split(v)
@@ -139,10 +159,44 @@ class _TorchMHA(nn.Module):
         if key_padding_mask is not None:
             scores = jnp.where(key_padding_mask[:, None, None, :], -1e9, scores)
         attn = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
-        attn = nn.Dropout(self.dropout)(attn, deterministic=deterministic)
+        attn = self.attn_drop(attn, deterministic=deterministic)
         out = jnp.einsum("bhqk,bhkd->bhqd", attn, v)
         out = out.transpose(0, 2, 1, 3).reshape(B, L, D)
-        return nn.Dense(D, dtype=self.dtype, name="out_proj")(out)
+        return self.out_proj(out), (k, v)
+
+    def decode(self, x, hist_kv, hist_pad, cache, slot: int):
+        """One suffix position for K beams against the shared history K/V.
+
+        x: (B, K, dim). hist_kv: (k, v) each (B, H, Lh, hd) — batch-sized,
+        never expanded to B*K. hist_pad: (B, Lh) True = padding.
+        cache {"k","v"}: (B, K, S, H, hd) suffix cache written at ``slot``
+        (static). Scores over [history ++ suffix] concatenated in the same
+        key order as the full forward, softmaxed jointly in fp32.
+        """
+        B, K, D = x.shape
+        H, hd = self.num_heads, D // self.num_heads
+        q, k_new, v_new = jnp.split(self.in_proj(x), 3, axis=-1)
+        q = q.reshape(B, K, H, hd)
+        ck = jax.lax.dynamic_update_slice(
+            cache["k"], k_new.reshape(B, K, 1, H, hd), (0, 0, slot, 0, 0)
+        )
+        cv = jax.lax.dynamic_update_slice(
+            cache["v"], v_new.reshape(B, K, 1, H, hd), (0, 0, slot, 0, 0)
+        )
+        hk, hv = hist_kv
+        Lh, S = hk.shape[2], ck.shape[2]
+        s_hist = jnp.einsum("bkhd,bhmd->bkhm", q, hk).astype(jnp.float32) * (hd**-0.5)
+        s_hist = jnp.where(hist_pad[:, None, None, :], -1e9, s_hist)
+        s_suf = jnp.einsum("bkhd,bkshd->bkhs", q, ck).astype(jnp.float32) * (hd**-0.5)
+        s_suf = jnp.where(jnp.arange(S)[None, None, None, :] > slot, -1e9, s_suf)
+        attn = jax.nn.softmax(
+            jnp.concatenate([s_hist, s_suf], axis=-1), axis=-1
+        ).astype(x.dtype)
+        out = (
+            jnp.einsum("bkhm,bhmd->bkhd", attn[..., :Lh], hv)
+            + jnp.einsum("bkhs,bkshd->bkhd", attn[..., Lh:], cv)
+        ).reshape(B, K, D)
+        return self.out_proj(out), {"k": ck, "v": cv}
 
 
 class _PostNormEncoderLayer(nn.Module):
@@ -181,26 +235,51 @@ class _PostNormDecoderLayer(nn.Module):
     dropout: float
     dtype: jnp.dtype = jnp.float32
 
-    @nn.compact
+    def setup(self):
+        self.self_attn = _TorchMHA(
+            self.dim, self.num_heads, self.dropout, self.dtype, name="self_attn"
+        )
+        self.norm1 = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="norm1")
+        self.norm2 = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="norm2")
+        self.norm3 = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="norm3")
+        self.linear1 = nn.Dense(self.ff_dim, dtype=self.dtype, name="linear1")
+        self.linear2 = nn.Dense(self.dim, dtype=self.dtype, name="linear2")
+        self.drop1 = nn.Dropout(self.dropout)
+        self.drop2 = nn.Dropout(self.dropout)
+        self.drop3 = nn.Dropout(self.dropout)
+
     def __call__(self, x, attn_mask, key_padding_mask, deterministic):
-        h = _TorchMHA(self.dim, self.num_heads, self.dropout, self.dtype, name="self_attn")(
+        h = self.self_attn(
             x, attn_mask=attn_mask, key_padding_mask=key_padding_mask,
             deterministic=deterministic,
         )
-        x = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="norm1")(
-            x + nn.Dropout(self.dropout)(h, deterministic=deterministic)
+        return self._post_attn(x, h, deterministic)
+
+    def _post_attn(self, x, h, deterministic):
+        x = self.norm1(
+            x + self.drop1(h, deterministic=deterministic)
         ).astype(x.dtype)
         # Cross-attention over empty memory == +0, then norm2. The (unused)
         # cross projection params still exist in torch; they are omitted
         # here deliberately — they receive no gradient either way.
-        x = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="norm2")(x).astype(x.dtype)
-        h = nn.Dense(self.ff_dim, dtype=self.dtype, name="linear1")(x)
-        h = nn.Dropout(self.dropout)(nn.relu(h), deterministic=deterministic)
-        h = nn.Dense(self.dim, dtype=self.dtype, name="linear2")(h)
-        x = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="norm3")(
-            x + nn.Dropout(self.dropout)(h, deterministic=deterministic)
+        x = self.norm2(x).astype(x.dtype)
+        h = self.linear1(x)
+        h = self.drop2(nn.relu(h), deterministic=deterministic)
+        h = self.linear2(h)
+        x = self.norm3(
+            x + self.drop3(h, deterministic=deterministic)
         ).astype(x.dtype)
         return x
+
+    def prefill(self, x, attn_mask, key_padding_mask):
+        h, kv = self.self_attn.prefill(
+            x, attn_mask=attn_mask, key_padding_mask=key_padding_mask
+        )
+        return self._post_attn(x, h, True), kv
+
+    def decode(self, x, hist_kv, hist_pad, cache, slot: int):
+        h, new_cache = self.self_attn.decode(x, hist_kv, hist_pad, cache, slot)
+        return self._post_attn(x, h, True), new_cache
 
 
 class CobraDecoder(nn.Module):
@@ -211,17 +290,40 @@ class CobraDecoder(nn.Module):
     dropout: float = 0.1
     dtype: jnp.dtype = jnp.float32
 
-    @nn.compact
+    def setup(self):
+        self.layers = [
+            _PostNormDecoderLayer(
+                self.hidden_dim, self.n_heads, self.ff_dim, self.dropout,
+                dtype=self.dtype, name=f"layer_{i}",
+            )
+            for i in range(self.n_layers)
+        ]
+
     def __call__(self, tgt, tgt_key_padding_mask=None, deterministic=True):
         L = tgt.shape[1]
         causal = jnp.triu(jnp.ones((L, L), bool), k=1)
         x = tgt
-        for i in range(self.n_layers):
-            x = _PostNormDecoderLayer(
-                self.hidden_dim, self.n_heads, self.ff_dim, self.dropout,
-                dtype=self.dtype, name=f"layer_{i}",
-            )(x, causal, tgt_key_padding_mask, deterministic)
+        for layer in self.layers:
+            x = layer(x, causal, tgt_key_padding_mask, deterministic)
         return x
+
+    def prefill(self, tgt, tgt_key_padding_mask=None):
+        """Forward over the history once, returning per-layer (k, v)."""
+        L = tgt.shape[1]
+        causal = jnp.triu(jnp.ones((L, L), bool), k=1)
+        x, kvs = tgt, []
+        for layer in self.layers:
+            x, kv = layer.prefill(x, causal, tgt_key_padding_mask)
+            kvs.append(kv)
+        return x, kvs
+
+    def decode(self, x, hist_kvs, hist_pad, caches, slot: int):
+        """Advance one suffix position for K beams: x (B, K, dim)."""
+        new_caches = []
+        for layer, hkv, cache in zip(self.layers, hist_kvs, caches):
+            x, nc = layer.decode(x, hkv, hist_pad, cache, slot)
+            new_caches.append(nc)
+        return x, new_caches
 
 
 class CobraEmbedding(nn.Module):
@@ -289,6 +391,17 @@ class CobraEmbedding(nn.Module):
         h = h * m
         h = h + self.pos_embed[None, :out_len].astype(self.dtype) * m
         h = h + self.type_embed[type_row][None].astype(self.dtype) * m
+        return h
+
+    def suffix_token(self, tok, slot: int, base_pos: int):
+        """Embed ONE generated sem-id token per beam: tok (B, K) ints at
+        suffix position ``slot`` (absolute position base_pos + slot).
+        Matches __call__'s layout for appended sparse tokens: codebook
+        offset slot % C, sparse type row, never padding."""
+        offset = tok + (slot % self.n_codebooks) * self.id_vocab_size
+        h = self.id_embed[offset].astype(self.dtype)
+        h = h + self.pos_embed[base_pos + slot].astype(self.dtype)
+        h = h + self.type_embed[0].astype(self.dtype)
         return h
 
 
@@ -460,6 +573,25 @@ class Cobra(nn.Module):
         h = self.decoder(emb, tgt_key_padding_mask=~seq_mask, deterministic=True)
         return h, seq_mask
 
+    def decode_prefill(self, input_ids, vecs, n_complete_items):
+        """`decode_hidden` over the user history ONCE per eval batch, also
+        returning the per-layer K/V for cached suffix decoding."""
+        sparse_mask = input_ids != self.pad_id
+        seq_mask = interleave_seq_mask(sparse_mask, self.n_codebooks, n_complete_items)
+        emb = self.cobra_emb(input_ids, vecs, seq_mask, n_complete_items)
+        h, kvs = self.decoder.prefill(emb, tgt_key_padding_mask=~seq_mask)
+        return h, seq_mask, kvs
+
+    def decode_suffix_step(self, tok, slot, base_pos, hist_kvs, hist_pad, caches):
+        """Advance the sem-id suffix by one codebook position for K beams.
+
+        tok: (B, K) tokens chosen at the previous step; slot/base_pos are
+        static ints (suffix index and history length). Returns
+        (h (B, K, d_model), new_caches).
+        """
+        x = self.cobra_emb.suffix_token(tok, slot, base_pos)
+        return self.decoder.decode(x, hist_kvs, hist_pad, caches, slot)
+
 
 def cobra_generate(
     model: Cobra,
@@ -469,9 +601,16 @@ def cobra_generate(
     n_candidates: int = 10,
     temperature: float = 1.0,
     item_vecs=None,
+    use_cache: bool = True,
 ) -> CobraGenerationOutput:
-    """Deterministic top-k beam search over the C codebooks (jit-friendly:
-    C full decoder calls on static shapes, mirroring cobra.py:531-665)."""
+    """Deterministic top-k beam search over the C codebooks (jit-friendly,
+    static shapes per step, mirroring cobra.py:531-665).
+
+    use_cache=True (default) decodes the dense user history ONCE per eval
+    batch and advances only the sem-id suffix per codebook step against
+    per-layer KV caches; use_cache=False re-runs the full decoder per step
+    (the original path, kept as the parity reference).
+    """
     C = model.n_codebooks
     K = n_candidates
     V = model.id_vocab_size
@@ -483,6 +622,8 @@ def cobra_generate(
         else model.apply({"params": params}, encoder_input_ids, method=Cobra.encode_items)
     )
     T_items = vecs.shape[1]
+    if use_cache and input_ids.shape[1] == C * T_items:
+        return _cobra_generate_cached(model, params, input_ids, vecs, K, temperature)
 
     beam_tokens = None  # (B, K, c)
     beam_scores = None
@@ -542,6 +683,83 @@ def cobra_generate(
     )
 
 
+def _cobra_generate_cached(
+    model: Cobra, params, input_ids, vecs, K: int, temperature: float
+) -> CobraGenerationOutput:
+    """KV-cached beam search: one prefill over the interleaved history at
+    batch size B, then one suffix position per codebook step at (B, K).
+
+    Semantics match the uncached path exactly, including its read position
+    `h[seq_lens - 1]`: for full histories that is the newly appended beam
+    token (computed incrementally); for partially-padded rows it lands
+    INSIDE the causal history, where the hidden state is unaffected by
+    appended tokens — so it is served from the prefill activations.
+    """
+    from genrec_tpu.models.t5transformer import gather_beam_caches, init_decode_caches
+
+    C = model.n_codebooks
+    V = model.id_vocab_size
+    B = input_ids.shape[0]
+    T_items = vecs.shape[1]
+
+    h_pre, seq_mask, hist_kvs = model.apply(
+        {"params": params}, input_ids, vecs, T_items, method=Cobra.decode_prefill
+    )
+    Lint = seq_mask.shape[1]
+    n_valid = seq_mask.sum(axis=1)
+    rows = jnp.arange(B)
+
+    h_c = h_pre[rows, n_valid - 1]  # (B, d) last dense position
+    logits = _apply_head(model, params, 0, h_c) / temperature
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    beam_scores, tok = jax.lax.top_k(logp, K)
+    beam_tokens = tok[..., None]  # (B, K, 1)
+    if C == 1:
+        h_last = jnp.broadcast_to(h_c[:, None], (B, K, h_c.shape[-1]))
+        return CobraGenerationOutput(
+            sem_ids=beam_tokens,
+            dense_vecs=l2norm(h_last.astype(jnp.float32)),
+            scores=beam_scores,
+        )
+
+    full = n_valid == Lint  # (B,) histories with no padding
+    hist_pad = ~seq_mask
+    caches = init_decode_caches(
+        model.decoder_n_layers, B, K, C - 1, model.decoder_num_heads,
+        model.d_model, model.dtype,
+    )
+    h_last = None
+    for c in range(1, C):
+        h_new, caches = model.apply(
+            {"params": params}, beam_tokens[:, :, c - 1], c - 1, Lint,
+            hist_kvs, hist_pad, caches, method=Cobra.decode_suffix_step,
+        )  # (B, K, d)
+        pos = jnp.clip(n_valid + c - 1, 0, Lint - 1)
+        h_c = jnp.where(full[:, None, None], h_new, h_pre[rows, pos][:, None, :])
+        logits = _apply_head(model, params, c, h_c) / temperature
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)  # (B, K, V)
+        combined = (beam_scores[..., None] + logp).reshape(B, K * V)
+        beam_scores, idx = jax.lax.top_k(combined, K)
+        parent = idx // V
+        tok = idx % V
+        beam_tokens = jnp.concatenate(
+            [
+                jnp.take_along_axis(beam_tokens, parent[..., None], axis=1),
+                tok[..., None],
+            ],
+            axis=-1,
+        )
+        caches = gather_beam_caches(caches, parent)
+        if c == C - 1:
+            h_last = jnp.take_along_axis(h_c, parent[..., None], axis=1)
+
+    return CobraGenerationOutput(
+        sem_ids=beam_tokens,
+        dense_vecs=l2norm(h_last.astype(jnp.float32)),
+        scores=beam_scores,
+    )
+
+
 def _apply_head(model: Cobra, params, c: int, x):
     k = params[f"sparse_head_{c}"]
     return x @ k["kernel"] + k["bias"]
@@ -559,6 +777,7 @@ def beam_fusion(
     temperature: float = 1.0,
     alpha: float = 0.5,
     item_vecs=None,
+    use_cache: bool = True,
 ) -> BeamFusionOutput:
     """Beam candidates + dense nearest-neighbour, alpha-fused (cobra.py:679-760).
 
@@ -567,6 +786,7 @@ def beam_fusion(
     gen = cobra_generate(
         model, params, input_ids, encoder_input_ids,
         n_candidates=n_beam, temperature=temperature, item_vecs=item_vecs,
+        use_cache=use_cache,
     )
     item_vecs_n = l2norm(item_dense_vecs.astype(jnp.float32))
     sim = jnp.einsum("bkd,nd->bkn", gen.dense_vecs, item_vecs_n)

@@ -11,6 +11,16 @@ TPU notes: all shapes static; softmax in fp32; the (H, Lq, Lk) bias grid is
 computed once per layer from integer buckets — for TIGER's tiny sequences
 XLA fuses it into the attention; longer-sequence models use the Pallas
 fused-bias attention kernel in genrec_tpu.kernels instead.
+
+Incremental decode (the KV-cached engine behind `tiger_generate`):
+beam-search generation keeps all decode tensors in (B, K, ...) layout —
+self-attention K/V live in a static (B, K, S, H, hd) cache written one
+position per step (`decode_step`), and cross-attention K/V are projected
+ONCE per eval batch from the *un-expanded* (B, Lm) encoder memory
+(`precompute_cross_kv`) and attended by all K beams via einsum, so the
+K-fold memory broadcast of the naive decoder never materializes. Beam
+reordering is a `take_along_axis` on the cache's beam axis
+(`gather_beam_caches`). Pattern proven in models/backbones/qwen.py.
 """
 
 from __future__ import annotations
@@ -54,8 +64,8 @@ class T5Attention(nn.Module):
             )
         self.attn_drop = nn.Dropout(self.dropout)
 
-    def _position_bias(self, q_len: int, k_len: int):
-        ctx = jnp.arange(q_len)[:, None]
+    def _position_bias(self, q_len: int, k_len: int, q_offset: int = 0):
+        ctx = q_offset + jnp.arange(q_len)[:, None]
         mem = jnp.arange(k_len)[None, :]
         buckets = t5_relative_position_bucket(
             mem - ctx, self.num_relative_buckets, self.max_distance, bidirectional=True
@@ -99,6 +109,65 @@ class T5Attention(nn.Module):
         attn = self.attn_drop(attn, deterministic=deterministic)
         out = jnp.einsum("bhqk,bhkd->bhqd", attn, v)
         out = out.transpose(0, 2, 1, 3).reshape(B, Lq, self.d_model)
+        return self.o(out)
+
+    # ---- incremental decode ------------------------------------------------
+
+    def decode_self(self, x, cache, step: int):
+        """One self-attention decode step against a static KV cache.
+
+        x: (B, K, d_model) — the current position for each of K beams.
+        cache: {"k", "v"}: (B, K, S, H, hd). ``step`` is the static write
+        slot; slots > step are masked out (exp underflows to exactly 0, so
+        the padded softmax matches the uncached prefix softmax).
+        """
+        B, K, _ = x.shape
+        H, hd = self.n_heads, self.d_model // self.n_heads
+        k_new, v_new = jnp.split(self.kv(x), 2, axis=-1)
+        q = self.q(x).reshape(B, K, H, hd)
+        ck = jax.lax.dynamic_update_slice(
+            cache["k"], k_new.reshape(B, K, 1, H, hd), (0, 0, step, 0, 0)
+        )
+        cv = jax.lax.dynamic_update_slice(
+            cache["v"], v_new.reshape(B, K, 1, H, hd), (0, 0, step, 0, 0)
+        )
+        S = ck.shape[2]
+        scores = jnp.einsum("bkhd,bkshd->bkhs", q, ck) * (hd**-0.5)
+        scores = scores.astype(jnp.float32)
+        if self.has_relative_bias:
+            # (1, H, 1, S) bias at query position ``step`` -> (1, 1, H, S).
+            scores = scores + self._position_bias(1, S, q_offset=step)[:, :, 0][:, None]
+        scores = jnp.where(jnp.arange(S)[None, None, None, :] > step, _NEG, scores)
+        attn = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+        out = jnp.einsum("bkhs,bkshd->bkhd", attn, cv).reshape(B, K, self.d_model)
+        return self.o(out), {"k": ck, "v": cv}
+
+    def project_kv(self, memory):
+        """Cross-attention K/V from the un-expanded encoder memory, computed
+        once per eval batch: (B, Lm, d) -> two (B, H, Lm, hd)."""
+        B, Lm, _ = memory.shape
+        H, hd = self.n_heads, self.d_model // self.n_heads
+        k = self.k(memory).reshape(B, Lm, H, hd).transpose(0, 2, 1, 3)
+        v = self.v(memory).reshape(B, Lm, H, hd).transpose(0, 2, 1, 3)
+        return k, v
+
+    def decode_cross(self, x, kv, key_padding_mask=None):
+        """Cross-attention of K beams against shared cached K/V.
+
+        x: (B, K, d_model); kv: pair of (B, H, Lm, hd);
+        key_padding_mask: (B, Lm), True = padding. The einsum resolves the
+        beam axis against the batch-sized memory — no K-fold broadcast.
+        """
+        B, K, _ = x.shape
+        H, hd = self.n_heads, self.d_model // self.n_heads
+        k, v = kv
+        q = self.q(x).reshape(B, K, H, hd)
+        scores = jnp.einsum("bkhd,bhmd->bkhm", q, k) * (hd**-0.5)
+        scores = scores.astype(jnp.float32)
+        if key_padding_mask is not None:
+            scores = jnp.where(key_padding_mask[:, None, None, :], _NEG, scores)
+        attn = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+        out = jnp.einsum("bkhm,bhmd->bkhd", attn, v).reshape(B, K, self.d_model)
         return self.o(out)
 
 
@@ -169,6 +238,19 @@ class TransformerBlock(nn.Module):
         h = self.ff(self.norm2(x), deterministic=deterministic)
         return x + self.drop2(h, deterministic=deterministic)
 
+    def decode_step(self, x, cache, cross_kv=None, memory_key_padding_mask=None,
+                    step: int = 0):
+        """Cached one-position decode: x (B, K, dim) -> (out, new_cache)."""
+        h, new_cache = self.self_attn.decode_self(self.norm1(x), cache, step)
+        x = x + h
+        if self.cross_attn and cross_kv is not None:
+            h = self.cross.decode_cross(
+                self.norm_cross(x), cross_kv, memory_key_padding_mask
+            )
+            x = x + h
+        h = self.ff(self.norm2(x), deterministic=True)
+        return x + h, new_cache
+
 
 class TransformerEncoder(nn.Module):
     dim: int
@@ -232,6 +314,49 @@ class TransformerDecoder(nn.Module):
                 deterministic=deterministic,
             )
         return tgt
+
+    def precompute_cross_kv(self, memory):
+        """Per-layer cross-attention K/V from the (B, Lm, d) memory — the
+        once-per-eval-batch projection the uncached decoder re-ran every
+        step over a K-fold-expanded memory."""
+        return [layer.cross.project_kv(memory) for layer in self.layers]
+
+    def decode_step(self, x, caches, cross_kvs, memory_key_padding_mask=None,
+                    step: int = 0):
+        """Advance all layers one position: x (B, K, dim) ->
+        (out, new_caches)."""
+        new_caches = []
+        for layer, cache, ckv in zip(self.layers, caches, cross_kvs):
+            x, nc = layer.decode_step(
+                x, cache, ckv, memory_key_padding_mask, step=step
+            )
+            new_caches.append(nc)
+        return x, new_caches
+
+
+def init_decode_caches(depth: int, batch: int, beams: int, max_len: int,
+                       n_heads: int, d_model: int, dtype=jnp.float32):
+    """Static per-layer self-attention KV caches, (B, K, S, H, hd)."""
+    hd = d_model // n_heads
+    return [
+        {
+            "k": jnp.zeros((batch, beams, max_len, n_heads, hd), dtype),
+            "v": jnp.zeros((batch, beams, max_len, n_heads, hd), dtype),
+        }
+        for _ in range(depth)
+    ]
+
+
+def gather_beam_caches(caches, sel_parent):
+    """Reorder every cache leaf along the beam axis after a beam-search
+    top-k: sel_parent (B, K) indexes the surviving parents. The KV rows of
+    slot s were written by the parent's prefix, so a gather keeps cache
+    and beam_seqs consistent."""
+    idx = sel_parent[:, :, None, None, None]
+    return [
+        {k: jnp.take_along_axis(v, idx, axis=1) for k, v in cache.items()}
+        for cache in caches
+    ]
 
 
 def causal_mask(T: int) -> jax.Array:

@@ -28,7 +28,12 @@ import jax.numpy as jnp
 from genrec_tpu.models.embeddings import SemIdEmbedding, UserIdEmbedding
 from genrec_tpu.ops.losses import cross_entropy_with_ignore
 from genrec_tpu.models.layers import RMSNorm
-from genrec_tpu.models.t5transformer import TransformerEncoderDecoder, causal_mask
+from genrec_tpu.models.t5transformer import (
+    TransformerEncoderDecoder,
+    causal_mask,
+    gather_beam_caches,
+    init_decode_caches,
+)
 
 
 class TigerOutput(NamedTuple):
@@ -194,6 +199,46 @@ class Tiger(nn.Module):
         logits = self._mask_pad_logits(self.output_head(out))
         return logits[:, -1, :].astype(jnp.float32)
 
+    # ---- KV-cached incremental generation ----------------------------------
+
+    def encode_for_decode(self, user_input_ids, item_input_ids, token_type_ids, seq_mask):
+        """Encoder pass + once-per-batch cross-attention K/V projection.
+
+        Returns (cross_kvs, pad) with everything batch-sized (B, not B*K):
+        the decode steps resolve the beam axis by einsum instead of
+        broadcasting the memory K-fold into HBM.
+        """
+        memory, pad = self.encode_context(
+            user_input_ids, item_input_ids, token_type_ids, seq_mask
+        )
+        cross_kvs = self.transformer.decoder.precompute_cross_kv(memory)
+        return cross_kvs, pad
+
+    def decode_step_cached(self, last_tok, caches, cross_kvs, memory_pad, step: int):
+        """Logits for decode position ``step`` given only the PREVIOUS
+        token (None at step 0 = BOS), against the KV caches.
+
+        last_tok: (B, K) int or None. Returns (logits (B, K, V) fp32,
+        new_caches). Position-wise pieces (embedding, norm, in_proj,
+        output head) match the uncached `decode_step` exactly; attention
+        reads the cache instead of re-running the prefix.
+        """
+        B = memory_pad.shape[0]
+        K = caches[0]["k"].shape[1]
+        if last_tok is None:
+            x = jnp.broadcast_to(
+                self.bos_embedding.astype(self.dtype), (B, K, self.embedding_dim)
+            )
+        else:
+            tok_type = jnp.full_like(last_tok, step - 1)
+            x = self.sem_id_embedding(last_tok, tok_type)
+        x = self.in_proj(self.norm(x))
+        x, new_caches = self.transformer.decoder.decode_step(
+            x, caches, cross_kvs, memory_key_padding_mask=memory_pad, step=step
+        )
+        logits = self._mask_pad_logits(self.output_head(x))
+        return logits.astype(jnp.float32), new_caches
+
 
 def _dedup_top_k(scores, keys, k):
     """Per-row: keep the best-scoring instance of each key, return top-k.
@@ -224,16 +269,24 @@ def tiger_generate(
     n_top_k_candidates: int = 10,
     sample_factor: int = 6,
     deterministic: bool = False,
+    use_cache: bool = True,
 ) -> TigerGenerationOutput:
     """Trie-constrained beam search, fully on device and jit-friendly.
 
-    Matches the reference's procedure (tiger.py:312-452): encoder cached
-    once and expanded to B*K beams; at each of sem_id_dim steps sample
-    KK = K*sample_factor candidates WITHOUT replacement from
-    softmax(masked_logits / temperature) (Gumbel-top-k == multinomial
-    without replacement), accumulate log-probs, dedup by full sequence,
-    keep top K. With deterministic=True the sampling noise is dropped
-    (pure beam search).
+    Matches the reference's procedure (tiger.py:312-452): at each of
+    sem_id_dim steps sample KK = K*sample_factor candidates WITHOUT
+    replacement from softmax(masked_logits / temperature) (Gumbel-top-k ==
+    multinomial without replacement), accumulate log-probs, dedup by full
+    sequence, keep top K. With deterministic=True the sampling noise is
+    dropped (pure beam search).
+
+    use_cache=True (default) runs the KV-cached incremental engine:
+    self-attention appends one position per step, cross-attention K/V are
+    projected once from the batch-sized memory, and beam reorders gather
+    the cache — O(1) attention per step instead of re-running the whole
+    prefix over a K-fold-expanded memory. Both paths share the sampling /
+    dedup loop below, so their outputs are identical up to float
+    association (parity pinned by tests/test_decode_cache.py).
     """
     B = item_input_ids.shape[0]
     K = n_top_k_candidates
@@ -241,28 +294,45 @@ def tiger_generate(
     D = model.sem_id_dim
     KK = min(K * sample_factor, Kcb)
 
-    memory, pad = model.apply(
-        {"params": params}, user_input_ids, item_input_ids, token_type_ids,
-        seq_mask, method=Tiger.encode_context,
-    )
-    Lm = memory.shape[1]
-    memory = jnp.broadcast_to(memory[:, None], (B, K, Lm, memory.shape[-1])).reshape(B * K, Lm, -1)
-    pad = jnp.broadcast_to(pad[:, None], (B, K, Lm)).reshape(B * K, Lm)
+    if use_cache:
+        cross_kvs, pad = model.apply(
+            {"params": params}, user_input_ids, item_input_ids, token_type_ids,
+            seq_mask, method=Tiger.encode_for_decode,
+        )
+        caches = init_decode_caches(
+            len(cross_kvs), B, K, D, model.num_heads, model.attn_dim, model.dtype
+        )
+    else:
+        memory, pad = model.apply(
+            {"params": params}, user_input_ids, item_input_ids, token_type_ids,
+            seq_mask, method=Tiger.encode_context,
+        )
+        Lm = memory.shape[1]
+        memory = jnp.broadcast_to(memory[:, None], (B, K, Lm, memory.shape[-1])).reshape(B * K, Lm, -1)
+        pad = jnp.broadcast_to(pad[:, None], (B, K, Lm)).reshape(B * K, Lm)
 
     beam_seqs = jnp.zeros((B, K, D), jnp.int32)
     beam_logps = jnp.zeros((B, K), jnp.float32)
     prefix_idx = jnp.zeros((B, K), jnp.int32)
 
     for step in range(D):
-        if step == 0:
-            tgt_ids, tgt_type = None, None
+        if use_cache:
+            last_tok = None if step == 0 else beam_seqs[:, :, step - 1]
+            logits, caches = model.apply(
+                {"params": params}, last_tok, caches, cross_kvs, pad, step,
+                method=Tiger.decode_step_cached,
+            )
+            logits = logits.reshape(B * K, -1)
         else:
-            tgt_ids = beam_seqs[:, :, :step].reshape(B * K, step)
-            tgt_type = jnp.broadcast_to(jnp.arange(step), (B * K, step))
-        logits = model.apply(
-            {"params": params}, memory, pad, tgt_ids, tgt_type,
-            method=Tiger.decode_step,
-        )  # (B*K, V)
+            if step == 0:
+                tgt_ids, tgt_type = None, None
+            else:
+                tgt_ids = beam_seqs[:, :, :step].reshape(B * K, step)
+                tgt_type = jnp.broadcast_to(jnp.arange(step), (B * K, step))
+            logits = model.apply(
+                {"params": params}, memory, pad, tgt_ids, tgt_type,
+                method=Tiger.decode_step,
+            )  # (B*K, V)
         window = jax.lax.dynamic_slice_in_dim(logits, step * Kcb, Kcb, axis=1)
         legal = trie.legal_mask(prefix_idx.reshape(B * K), step)  # (B*K, Kcb)
         masked = jnp.where(legal, window, -1e32)
@@ -296,5 +366,7 @@ def tiger_generate(
         sel_prefix = jnp.take_along_axis(prefix_idx, sel_parent, axis=1)
         prefix_idx = trie.advance(sel_prefix, sel_tok, step)
         beam_logps = top_scores
+        if use_cache:
+            caches = gather_beam_caches(caches, sel_parent)
 
     return TigerGenerationOutput(sem_ids=beam_seqs, log_probas=beam_logps)

@@ -20,12 +20,17 @@ from genrec_tpu.core.harness import make_train_step
 from genrec_tpu.core.logging import Tracker, setup_logger
 from genrec_tpu.core.profiling import ProfileWindow, StepTimer, log_epoch_perf
 from genrec_tpu.core.state import TrainState
-from genrec_tpu.data.batching import batch_iterator, pad_to_batch, prefetch_to_device
+from genrec_tpu.data.batching import (
+    batch_iterator,
+    pad_to_batch,
+    prefetch_eval_batches,
+    prefetch_to_device,
+)
 from genrec_tpu.data.cobra_seq import CobraSeqData, synthetic_cobra_data
 from genrec_tpu.models.cobra import Cobra, beam_fusion
 from genrec_tpu.ops.metrics import TopKAccumulator
 from genrec_tpu.ops.schedules import cosine_schedule_with_warmup
-from genrec_tpu.parallel import distributed_init, get_mesh, replicate, shard_batch
+from genrec_tpu.parallel import distributed_init, get_mesh, replicate
 
 
 import functools
@@ -68,11 +73,15 @@ def evaluate(fusion_fn, params, arrays, item_vecs, batch_size, mesh, C):
     acc = TopKAccumulator(ks=(1, 5, 10))
     cb_correct = np.zeros(C)
     cb_total = 0
-    for batch, valid in batch_iterator(arrays, batch_size):
-        out = fusion_fn(params, shard_batch(mesh, batch), item_vecs)
+    # Same prefetching iterator as the train loop: host batch assembly and
+    # H2D transfer overlap the previous batch's beam fusion.
+    for sharded, host, valid in prefetch_eval_batches(
+        batch_iterator(arrays, batch_size), mesh
+    ):
+        out = fusion_fn(params, sharded, item_vecs)
         n = int(valid.sum())
         topk = np.asarray(out.sem_ids)[:n]
-        target = batch["target_sem_ids"][:n]
+        target = host["target_sem_ids"][:n]
         acc.accumulate(jnp.asarray(target), jnp.asarray(topk))
         top1 = topk[:, 0, :]
         for c in range(C):

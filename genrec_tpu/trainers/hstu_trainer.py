@@ -18,11 +18,11 @@ from genrec_tpu.core.harness import make_train_step
 from genrec_tpu.core.logging import Tracker, setup_logger
 from genrec_tpu.core.profiling import ProfileWindow, StepTimer, log_epoch_perf
 from genrec_tpu.core.state import TrainState
-from genrec_tpu.data.batching import batch_iterator, prefetch_to_device
+from genrec_tpu.data.batching import batch_iterator, fold_valid, prefetch_to_device
 from genrec_tpu.data.synthetic import SyntheticSeqDataset
 from genrec_tpu.models.hstu import HSTU
 from genrec_tpu.ops.metrics import first_match_ranks
-from genrec_tpu.parallel import distributed_init, get_mesh, metric_allreduce, replicate, shard_batch
+from genrec_tpu.parallel import distributed_init, get_mesh, metric_allreduce, replicate
 
 
 def make_eval_step(model):
@@ -48,8 +48,11 @@ def make_eval_step(model):
 
 def evaluate(eval_step, params, arrays, batch_size, mesh):
     sums: dict[str, float] = {}
-    for batch, valid in batch_iterator(arrays, batch_size):
-        sharded = shard_batch(mesh, {**batch, "valid": valid.astype(np.int32)})
+    # Prefetching iterator (valid mask folded in): eval overlaps H2D
+    # transfer with compute like training.
+    for sharded, _ in prefetch_to_device(
+        fold_valid(batch_iterator(arrays, batch_size)), mesh
+    ):
         got = eval_step(params, sharded, sharded["valid"])
         for k, v in got.items():
             sums[k] = sums.get(k, 0.0) + float(v)

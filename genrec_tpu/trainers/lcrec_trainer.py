@@ -26,7 +26,11 @@ from genrec_tpu.core.logging import Tracker, setup_logger
 from genrec_tpu.core.profiling import ProfileWindow, StepTimer, log_epoch_perf
 from genrec_tpu.core.lora import lora_init, lora_merge, lora_param_count
 from genrec_tpu.core.state import TrainState
-from genrec_tpu.data.batching import batch_iterator, prefetch_to_device
+from genrec_tpu.data.batching import (
+    batch_iterator,
+    prefetch_eval_batches,
+    prefetch_to_device,
+)
 from genrec_tpu.data.lcrec_tasks import synthetic_lcrec_data
 from genrec_tpu.models.backbones.qwen import QwenConfig, QwenLM
 from genrec_tpu.models.lcrec import (
@@ -37,7 +41,7 @@ from genrec_tpu.models.lcrec import (
 )
 from genrec_tpu.ops.metrics import TopKAccumulator
 from genrec_tpu.ops.schedules import cosine_schedule_with_warmup
-from genrec_tpu.parallel import distributed_init, get_mesh, shard_batch
+from genrec_tpu.parallel import distributed_init, get_mesh
 
 
 def make_generate_fn(model, base_vocab, num_codebooks, codebook_size, beam_width, max_cache):
@@ -61,11 +65,15 @@ def evaluate_item2index(gen_fn, params, arrays, batch_size, mesh, num_codebooks)
     correct = np.zeros(num_codebooks)
     exact = 0
     total = 0
-    for batch, valid in batch_iterator(arrays, batch_size):
-        top = np.asarray(gen_fn(params, shard_batch(mesh, batch)))  # (B, W, C)
+    # Eval uses the same prefetching iterator as the train loop so host
+    # batching + H2D transfer overlap the previous batch's generate.
+    for sharded, host, valid in prefetch_eval_batches(
+        batch_iterator(arrays, batch_size), mesh
+    ):
+        top = np.asarray(gen_fn(params, sharded))  # (B, W, C)
         n = int(valid.sum())
         pred = top[:n, 0, :]
-        target = batch["target_ids"][:n]
+        target = host["target_ids"][:n]
         correct += (pred == target).sum(axis=0)
         exact += int((pred == target).all(axis=1).sum())
         total += n
@@ -90,8 +98,8 @@ def evaluate_index2item(free_fn, params, arrays, target_texts, batch_size, mesh,
     match = 0
     total = 0
     offset = 0
-    for batch, valid in batch_iterator(arrays, batch_size):
-        toks = np.asarray(free_fn(params, shard_batch(mesh, batch)))  # (B, T)
+    for sharded, valid in prefetch_to_device(batch_iterator(arrays, batch_size), mesh):
+        toks = np.asarray(free_fn(params, sharded))  # (B, T)
         n = int(valid.sum())
         for i in range(n):
             tgt = target_texts[offset + i].strip().lower()
@@ -110,10 +118,12 @@ def evaluate(gen_fn, params, arrays, batch_size, mesh, num_codebooks):
     acc = TopKAccumulator(ks=(1, 5, 10))
     cb_correct = np.zeros(num_codebooks)
     cb_total = 0
-    for batch, valid in batch_iterator(arrays, batch_size):
-        top = np.asarray(gen_fn(params, shard_batch(mesh, batch)))
+    for sharded, host, valid in prefetch_eval_batches(
+        batch_iterator(arrays, batch_size), mesh
+    ):
+        top = np.asarray(gen_fn(params, sharded))
         n = int(valid.sum())
-        target = batch["target_ids"][:n]
+        target = host["target_ids"][:n]
         acc.accumulate(jnp.asarray(target), jnp.asarray(top[:n]))
         top1 = top[:n, 0, :]
         for c in range(num_codebooks):

@@ -32,7 +32,7 @@ from genrec_tpu.data.notellm_pairs import NoteLLMPairData
 from genrec_tpu.models.backbones.qwen import QwenConfig, QwenLM
 from genrec_tpu.models.notellm import paired_topk_accuracy, query2embedding_forward
 from genrec_tpu.ops.schedules import cosine_schedule_with_warmup
-from genrec_tpu.parallel import distributed_init, get_mesh, shard_batch, to_host
+from genrec_tpu.parallel import distributed_init, get_mesh, to_host
 
 
 def _flatten_pairs(batch):
@@ -59,8 +59,9 @@ def evaluate_retrieval(embed_fn, params, arrays, batch_pairs, mesh, topk=5):
     """Paired top-k accuracy over the full eval set (embeddings gathered
     on host; the sim matrix spans every eval pair, not one batch)."""
     embs = []
-    for batch, valid in batch_iterator(arrays, batch_pairs):
-        e = to_host(embed_fn(params, _flatten_pairs(shard_batch(mesh, batch))))
+    # Prefetching iterator: H2D transfer overlaps the embed compute.
+    for sharded, valid in prefetch_to_device(batch_iterator(arrays, batch_pairs), mesh):
+        e = to_host(embed_fn(params, _flatten_pairs(sharded)))
         n = int(valid.sum())
         embs.append(e.reshape(-1, 2, e.shape[-1])[:n])
     flat = jnp.asarray(np.concatenate(embs).reshape(-1, embs[0].shape[-1]))

@@ -23,13 +23,17 @@ from genrec_tpu.core.harness import make_train_step
 from genrec_tpu.core.logging import Tracker, setup_logger
 from genrec_tpu.core.profiling import ProfileWindow, StepTimer, log_epoch_perf
 from genrec_tpu.core.state import TrainState
-from genrec_tpu.data.batching import batch_iterator, prefetch_to_device
+from genrec_tpu.data.batching import (
+    batch_iterator,
+    prefetch_eval_batches,
+    prefetch_to_device,
+)
 from genrec_tpu.data.tiger_seq import TigerSeqData, synthetic_tiger_data
 from genrec_tpu.models.tiger import Tiger, tiger_generate
 from genrec_tpu.ops.metrics import TopKAccumulator
 from genrec_tpu.ops.schedules import cosine_schedule_with_warmup
 from genrec_tpu.ops.trie import build_trie
-from genrec_tpu.parallel import distributed_init, get_mesh, make_mesh, shard_batch
+from genrec_tpu.parallel import distributed_init, get_mesh, make_mesh
 
 
 def make_generate_fn(model, trie, temperature, n_candidates):
@@ -48,12 +52,15 @@ def make_generate_fn(model, trie, temperature, n_candidates):
 
 def evaluate(gen_fn, params, arrays, batch_size, mesh, rng):
     acc = TopKAccumulator(ks=(5, 10))
-    for batch, valid in batch_iterator(arrays, batch_size):
+    # Same prefetching iterator as the train loop: host batch assembly and
+    # H2D transfer overlap the previous batch's generate.
+    for sharded, host, valid in prefetch_eval_batches(
+        batch_iterator(arrays, batch_size), mesh
+    ):
         rng, sub = jax.random.split(rng)
-        sharded = shard_batch(mesh, batch)
         top = np.asarray(gen_fn(params, sharded, sub))  # (B, K, D)
         n = int(valid.sum())
-        acc.accumulate(jnp.asarray(batch["target_ids"][:n]), jnp.asarray(top[:n]))
+        acc.accumulate(jnp.asarray(host["target_ids"][:n]), jnp.asarray(top[:n]))
     return acc.reduce(cross_process=True)
 
 

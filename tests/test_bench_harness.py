@@ -120,6 +120,22 @@ def test_main_falls_back_to_committed_artifact(tmp_path, monkeypatch, capsys):
     assert "error" in line
 
 
+def test_main_includes_decode_metric_fields(monkeypatch, capsys):
+    """A result carrying decode measurements must surface the second
+    metric (tiger_decode_seq_per_sec_per_chip + vs_uncached ratio) on the
+    same single JSON line."""
+    monkeypatch.setattr(bench, "_measure_tpu", lambda *a, **k: {
+        "backend": "tpu", "n_chips": 1, "seq_per_sec": 100.0, "step_ms": 1.0,
+        "batch_size": 256, "decode_seq_per_sec": 640.0,
+        "decode_vs_uncached": 4.6, "decode_batch_size": 64, "decode_beam_k": 10,
+    })
+    bench.main()
+    line = json.loads(capsys.readouterr().out)
+    assert line["tiger_decode_seq_per_sec_per_chip"] == 640.0
+    assert line["decode_vs_uncached"] == 4.6
+    assert line["decode_batch_size"] == 64
+
+
 def _fake_child_cls(behaviors):
     """behaviors: list consumed per spawn; each is 'hang' | 'crash' | dict."""
 

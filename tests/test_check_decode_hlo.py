@@ -1,0 +1,34 @@
+"""Fast CI wrapper for scripts/check_decode_hlo.py (--small shapes).
+
+Catches regressions where the cached decode loop re-grows a
+(B*K, Lm, ...) memory-length activation (the K-fold broadcast the cached
+engine exists to remove) or stops compiling as a single executable.
+"""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location(
+        "check_decode_hlo",
+        os.path.join(os.path.dirname(__file__), "..", "scripts", "check_decode_hlo.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_cached_decode_hlo_has_no_memory_broadcast(capsys):
+    mod = _load()
+    rc = mod.main(["--small"])
+    verdict = json.loads(capsys.readouterr().out)
+    assert verdict["regex_bites"], (
+        "self-test failed: the uncached path no longer shows the broadcast "
+        "pattern, so the check is vacuous"
+    )
+    assert verdict["cached_broadcast_hits"] == 0, verdict
+    assert rc == 0
