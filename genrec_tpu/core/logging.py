@@ -46,6 +46,28 @@ def setup_logger(save_dir: str | None = None, name: str = "genrec_tpu") -> loggi
     return logger
 
 
+def log_occupancy(logger, tracker, epoch: int, real_tokens: float,
+                  slot_tokens: float) -> float:
+    """Per-epoch packed-batch occupancy (real tokens / padded slots), so
+    padding waste is visible in wandb/stdout without a profiler.
+
+    Called by the trainers that pack, with the epoch's device-accumulated
+    real-token count and the static slot count they fed the step. Returns
+    the occupancy fraction."""
+    occ = float(real_tokens) / max(float(slot_tokens), 1.0)
+    logger.info(
+        f"epoch {epoch} batch occupancy {occ:.1%} "
+        f"({int(real_tokens)} real tokens / {int(slot_tokens)} slots)"
+    )
+    tracker.log({
+        "epoch": epoch,
+        "perf/occupancy": occ,
+        "perf/real_tokens": float(real_tokens),
+        "perf/slot_tokens": float(slot_tokens),
+    })
+    return occ
+
+
 class Tracker:
     """wandb-compatible metric tracker with a JSONL fallback.
 

@@ -70,18 +70,30 @@ def perf_summary(timer: "StepTimer") -> dict:
     return s
 
 
-def log_epoch_perf(logger, tracker, epoch, epoch_loss, n_batches, timer) -> float:
+def log_epoch_perf(logger, tracker, epoch, epoch_loss, n_batches, timer,
+                   tokens_per_step: float | None = None) -> float:
     """Shared epoch-end summary used by every trainer: block once on the
     chained loss scalar (closing the async-dispatch timing window), log
-    loss + throughput, feed the Tracker. Returns the mean loss."""
+    loss + throughput, feed the Tracker. Returns the mean loss.
+
+    ``tokens_per_step``: mean REAL tokens per step (packed trainers pass
+    the epoch's device-accumulated count / n_batches) — adds tokens/sec
+    and tokens/sec/chip to the perf metrics."""
     if epoch_loss is not None:
         jax.block_until_ready(epoch_loss)
     perf = perf_summary(timer)
+    if tokens_per_step is not None:
+        tps = perf["steps_per_sec"] * tokens_per_step
+        perf["tokens_per_sec"] = tps
+        perf["tokens_per_sec_per_chip"] = tps / max(jax.device_count(), 1)
     mean_loss = float(epoch_loss) / n_batches if n_batches else 0.0
+    extra = (
+        f", {perf['tokens_per_sec']:.0f} tok/s" if "tokens_per_sec" in perf else ""
+    )
     logger.info(
         f"epoch {epoch} loss {mean_loss:.4f} "
         f"[{perf['seq_per_sec']:.1f} seq/s, "
-        f"{perf['seq_per_sec_per_chip']:.1f} seq/s/chip]"
+        f"{perf['seq_per_sec_per_chip']:.1f} seq/s/chip{extra}]"
     )
     tracker.log({
         "epoch": epoch, "train/loss": mean_loss,

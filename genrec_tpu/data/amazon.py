@@ -211,25 +211,45 @@ class AmazonSASRecData:
         return out
 
     def train_arrays(self) -> dict:
+        """Left-padded rows derived from `train_examples` — the single
+        copy of the sliding-window sampling protocol."""
+        exs = self.train_examples()
+        out = {
+            "input_ids": np.stack(
+                [self._left_pad(e["input_ids"]) for e in exs]
+            ).astype(np.int32),
+            "targets": np.stack(
+                [self._left_pad(e["targets"]) for e in exs]
+            ).astype(np.int32),
+        }
+        if self.with_timestamps:
+            out["timestamps"] = np.stack(
+                [self._left_pad(e["timestamps"], np.int64) for e in exs]
+            )
+        return out
+
+    def train_examples(self) -> list[dict]:
+        """Raw variable-length train samples for the sequence packer —
+        the same sliding-window expansion as `train_arrays` (one sample
+        per position, so most are SHORT prefixes), unpadded."""
         L = self.max_seq_len
-        inputs, targets, times = [], [], []
+        out = []
         for seq, ts in zip(self.sequences, self.timestamps):
             body, tbody = seq[:-2], ts[:-2]
             if len(body) < 2:
                 continue
             for i in range(1, len(body)):
-                hist = body[max(0, i - L) : i]
+                hist = body[max(0, i - L): i]
                 full = np.append(hist, body[i])
-                inputs.append(self._left_pad(full[:-1]))
-                targets.append(self._left_pad(full[1:]))
+                ex = {
+                    "input_ids": full[:-1].astype(np.int32),
+                    "targets": full[1:].astype(np.int32),
+                }
                 if self.with_timestamps:
-                    times.append(self._left_pad(tbody[max(0, i - L) : i], np.int64))
-        out = {
-            "input_ids": np.stack(inputs).astype(np.int32),
-            "targets": np.stack(targets).astype(np.int32),
-        }
-        if self.with_timestamps:
-            out["timestamps"] = np.stack(times)
+                    ex["timestamps"] = np.asarray(
+                        tbody[max(0, i - L): i], np.int64
+                    )
+                out.append(ex)
         return out
 
     def eval_arrays(self, split: str = "valid") -> dict:

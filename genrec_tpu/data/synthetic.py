@@ -57,18 +57,37 @@ class SyntheticSeqDataset:
         return out
 
     def train_arrays(self) -> dict:
-        """input = seq[:-3], target = shifted by one (next-item at each pos)."""
-        inputs, targets = [], []
-        for seq in self.sequences:
-            body = seq[:-2]
+        """input = seq[:-3], target = shifted by one (next-item at each pos).
+
+        Derived from `train_examples` (the single copy of the sampling
+        protocol) by left-padding each example into its own row."""
+        exs = self.train_examples()
+        return {
+            "input_ids": np.stack(
+                [self._left_pad(e["input_ids"]) for e in exs]
+            ).astype(np.int32),
+            "targets": np.stack(
+                [self._left_pad(e["targets"]) for e in exs]
+            ).astype(np.int32),
+        }
+
+    def train_examples(self, with_time: bool = False) -> list[dict]:
+        """Raw variable-length train examples for the sequence packer
+        (data/batching.pack_examples): same (input, shifted-target) samples
+        as `train_arrays`, but unpadded — the packer owns layout."""
+        out = []
+        for seq, ts in zip(self.sequences, self.timestamps):
+            body, tbody = seq[:-2], ts[:-2]
             if len(body) < 2:
                 continue
-            inputs.append(self._left_pad(body[:-1]))
-            targets.append(self._left_pad(body[1:]))
-        return {
-            "input_ids": np.stack(inputs).astype(np.int32),
-            "targets": np.stack(targets).astype(np.int32),
-        }
+            ex = {
+                "input_ids": body[:-1][-self.max_seq_len:].astype(np.int32),
+                "targets": body[1:][-self.max_seq_len:].astype(np.int32),
+            }
+            if with_time:
+                ex["timestamps"] = tbody[:-1][-self.max_seq_len:].astype(np.int64)
+            out.append(ex)
+        return out
 
     def eval_arrays(self, split: str = "valid") -> dict:
         """valid: history=seq[:-2], target=seq[-2]; test: seq[:-1] -> seq[-1]."""
@@ -86,18 +105,17 @@ class SyntheticSeqDataset:
         }
 
     def train_arrays_with_time(self) -> dict:
-        out_in, out_tgt, out_ts = [], [], []
-        for seq, ts in zip(self.sequences, self.timestamps):
-            body, tbody = seq[:-2], ts[:-2]
-            if len(body) < 2:
-                continue
-            out_in.append(self._left_pad(body[:-1]))
-            out_tgt.append(self._left_pad(body[1:]))
-            out_ts.append(self._left_pad(tbody[:-1]))
+        exs = self.train_examples(with_time=True)
         return {
-            "input_ids": np.stack(out_in).astype(np.int32),
-            "targets": np.stack(out_tgt).astype(np.int32),
-            "timestamps": np.stack(out_ts).astype(np.int64),
+            "input_ids": np.stack(
+                [self._left_pad(e["input_ids"]) for e in exs]
+            ).astype(np.int32),
+            "targets": np.stack(
+                [self._left_pad(e["targets"]) for e in exs]
+            ).astype(np.int32),
+            "timestamps": np.stack(
+                [self._left_pad(e["timestamps"]) for e in exs]
+            ).astype(np.int64),
         }
 
     def eval_arrays_with_time(self, split: str = "valid") -> dict:

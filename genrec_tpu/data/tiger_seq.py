@@ -92,6 +92,44 @@ class TigerSeqData:
     def train_arrays(self):
         return self._samples("train")
 
+    def train_examples(self) -> list[dict]:
+        """Raw variable-length train samples for the sequence packer.
+
+        Each example is the ENCODER token stream with the user token
+        inline at slot 0 (the packer has no per-segment prepend hook):
+        ``user_mask`` marks that slot, ``user_token_ids`` carries the
+        hashed user id there, and ``item_input_ids``/``token_type_ids``
+        carry the flattened sem-id history after it. ``target_ids`` is a
+        per-segment key (one (D,) tuple per example)."""
+        out = []
+        for u, seq in enumerate(self.sequences):
+            body = seq[:-2]
+            if len(body) < 2:
+                continue
+            for i in range(1, len(body)):
+                # One copy of the tokenization: _flatten_history, with its
+                # padded tail sliced off (the packer owns layout).
+                flat_ids, flat_types, flat_mask = self._flatten_history(
+                    np.asarray(body[:i])
+                )
+                n = int(flat_mask.sum())
+                ids = np.zeros(1 + n, np.int32)
+                types = np.zeros(1 + n, np.int32)
+                ids[1:] = flat_ids[:n]
+                types[1:] = flat_types[:n]
+                user_tok = np.zeros(1 + n, np.int32)
+                user_tok[0] = u % self.user_hash_size
+                user_mask = np.zeros(1 + n, np.int32)
+                user_mask[0] = 1
+                out.append({
+                    "item_input_ids": ids,
+                    "token_type_ids": types,
+                    "user_token_ids": user_tok,
+                    "user_mask": user_mask,
+                    "target_ids": self.sem_ids[body[i] - 1],
+                })
+        return out
+
     def eval_arrays(self, split: str = "valid"):
         return self._samples(split)
 

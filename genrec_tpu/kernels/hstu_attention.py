@@ -58,9 +58,10 @@ def _time_bucket_f(diff, num_buckets):
 
 
 def _kernel(
-    q_ref, k_ref, v_ref, ts_ref, tsq_ref, mask_ref, ptab_ref, ttab_ref, out_ref,
+    q_ref, k_ref, v_ref, ts_ref, tsq_ref, mask_ref, seg_ref, segq_ref,
+    ptab_ref, ttab_ref, out_ref,
     *, blk_q: int, num_pos_buckets: int, num_time_buckets: int,
-    max_position_distance: int, use_time: bool,
+    max_position_distance: int, use_time: bool, use_seg: bool,
 ):
     j = pl.program_id(1)
     L = k_ref.shape[1]
@@ -93,6 +94,13 @@ def _kernel(
         scores = scores + tbias
 
     causal_or_pad = jnp.logical_or(k_pos > q_pos, mask_ref[0, 0][None, :] != 0)
+    if use_seg:
+        # Packed rows: a query must not see keys from another segment
+        # (same in-register fold as the causal/padding mask — packing does
+        # not force the unfused fallback).
+        seg_k = seg_ref[0, 0][None, :]  # (1, L)
+        seg_q = segq_ref[0, 0][:, None]  # (blk_q, 1)
+        causal_or_pad = jnp.logical_or(causal_or_pad, seg_q != seg_k)
     scores = jnp.where(causal_or_pad, NEG, scores)
     attn = scores * jax.nn.sigmoid(scores)  # silu
     out_ref[0] = jnp.dot(
@@ -110,13 +118,14 @@ def _pad(x, target_len, axis, value=0):
     return jnp.pad(x, cfg, constant_values=value)
 
 
-def _pad_inputs(q, k, v, timestamps, padding_mask, time_table, blk_q):
+def _pad_inputs(q, k, v, timestamps, padding_mask, time_table, blk_q,
+                segment_ids=None):
     """Shared fwd/bwd input prep: flatten (B,H) and pad L to the q-block
     multiple and hd to the 128-lane multiple. Padded key positions are
-    masked (value=1); absent timestamps/time_table get inert zeros so the
-    operand list keeps a static shape. The forward and backward kernels
-    recompute identical scores only because they run through this ONE
-    helper."""
+    masked (value=1); absent timestamps/time_table/segment_ids get inert
+    zeros so the operand list keeps a static shape. The forward and
+    backward kernels recompute identical scores only because they run
+    through this ONE helper."""
     B, H, L, hd = q.shape
     Lp = _round_up(L, blk_q)
     hp = _round_up(hd, 128)
@@ -129,12 +138,17 @@ def _pad_inputs(q, k, v, timestamps, padding_mask, time_table, blk_q):
     else:
         tsf = jnp.zeros((B, Lp), jnp.int32)
         time_table = jnp.zeros((H, 1), jnp.float32)
-    return qf, kf, vf, maskf, tsf, time_table, Lp, hp
+    if segment_ids is not None:
+        segf = _pad(segment_ids.astype(jnp.int32), Lp, 1)
+    else:
+        segf = jnp.zeros((B, Lp), jnp.int32)
+    return qf, kf, vf, maskf, tsf, segf, time_table, Lp, hp
 
 
 def hstu_attention_pallas(
     q, k, v, timestamps, padding_mask, pos_table, time_table,
     max_position_distance: int = 128, blk_q: int = 128, interpret: bool = False,
+    segment_ids=None,
 ):
     """Fused SiLU attention.
 
@@ -144,16 +158,19 @@ def hstu_attention_pallas(
         padding_mask: (B, L) bool/int — True/1 = padding
         pos_table: (H, num_pos_buckets)
         time_table: (H, num_time_buckets) or None
+        segment_ids: (B, L) int32 or None — packed-row segments (0 = pad);
+            cross-segment pairs are masked in-register.
     Returns:
         (B, H, L, hd) attention output (same dtype as v).
     """
     B, H, L, hd = q.shape
     use_time = timestamps is not None and time_table is not None
+    use_seg = segment_ids is not None
     # Mosaic compiles only on TPU; elsewhere fall back to the interpreter
     # so use_pallas=True models stay runnable (slowly) in CI.
     interpret = interpret or jax.default_backend() != "tpu"
-    qf, kf, vf, maskf, tsf, time_table, Lp, hp = _pad_inputs(
-        q, k, v, timestamps, padding_mask, time_table, blk_q
+    qf, kf, vf, maskf, tsf, segf, time_table, Lp, hp = _pad_inputs(
+        q, k, v, timestamps, padding_mask, time_table, blk_q, segment_ids
     )
     n_q = Lp // blk_q
     grid = (B * H, n_q)
@@ -165,6 +182,7 @@ def hstu_attention_pallas(
         num_time_buckets=time_table.shape[1],
         max_position_distance=max_position_distance,
         use_time=use_time,
+        use_seg=use_seg,
     )
     out = pl.pallas_call(
         kernel,
@@ -182,21 +200,24 @@ def hstu_attention_pallas(
             pl.BlockSpec((1, 1, Lp), lambda i, j: (i // H, 0, 0)),  # timestamps (keys)
             pl.BlockSpec((1, 1, blk_q), lambda i, j: (i // H, 0, j)),  # ts q-tile
             pl.BlockSpec((1, 1, Lp), lambda i, j: (i // H, 0, 0)),  # padding mask
+            pl.BlockSpec((1, 1, Lp), lambda i, j: (i // H, 0, 0)),  # segments (keys)
+            pl.BlockSpec((1, 1, blk_q), lambda i, j: (i // H, 0, j)),  # seg q-tile
             pl.BlockSpec((1, 1, pos_table.shape[1]), lambda i, j: (i % H, 0, 0)),
             pl.BlockSpec((1, 1, time_table.shape[1]), lambda i, j: (i % H, 0, 0)),
         ],
         out_specs=pl.BlockSpec((1, blk_q, hp), lambda i, j: (i, j, 0)),
         interpret=interpret,
     )(qf, kf, vf, tsf[:, None], tsf[:, None], maskf[:, None],
-      pos_table[:, None], time_table[:, None])
+      segf[:, None], segf[:, None], pos_table[:, None], time_table[:, None])
     return out.reshape(B, H, Lp, hp)[:, :, :L, :hd]
 
 
 def _bwd_kernel(
-    q_ref, k_ref, v_ref, do_ref, ts_ref, tsq_ref, mask_ref, ptab_ref, ttab_ref,
+    q_ref, k_ref, v_ref, do_ref, ts_ref, tsq_ref, mask_ref, seg_ref, segq_ref,
+    ptab_ref, ttab_ref,
     dq_ref, dk_ref, dv_ref, dpt_ref, dtt_ref,
     *, blk_q: int, num_pos_buckets: int, num_time_buckets: int,
-    max_position_distance: int, use_time: bool,
+    max_position_distance: int, use_time: bool, use_seg: bool,
 ):
     j = pl.program_id(1)
     L = k_ref.shape[1]
@@ -226,6 +247,10 @@ def _bwd_kernel(
         scores = scores + tbias
 
     masked = jnp.logical_or(k_pos > q_pos, mask_ref[0, 0][None, :] != 0)
+    if use_seg:
+        masked = jnp.logical_or(
+            masked, segq_ref[0, 0][:, None] != seg_ref[0, 0][None, :]
+        )
     s = jnp.where(masked, NEG, scores)
 
     # --- Local grads. silu(s) = s*sig(s); silu'(s) = sig(s)*(1 + s*(1-sig(s))).
@@ -269,14 +294,16 @@ def _bwd_kernel(
 def hstu_attention_bwd_pallas(
     q, k, v, timestamps, padding_mask, pos_table, time_table, g,
     max_position_distance: int = 128, blk_q: int = 128, interpret: bool = False,
+    segment_ids=None,
 ):
     """Fused flash-style backward. Returns (dq, dk, dv, dpos_table,
     dtime_table) with input dtypes; accumulation is fp32 in-kernel."""
     B, H, L, hd = q.shape
     use_time = timestamps is not None and time_table is not None
+    use_seg = segment_ids is not None
     interpret = interpret or jax.default_backend() != "tpu"
-    qf, kf, vf, maskf, tsf, ttab, Lp, hp = _pad_inputs(
-        q, k, v, timestamps, padding_mask, time_table, blk_q
+    qf, kf, vf, maskf, tsf, segf, ttab, Lp, hp = _pad_inputs(
+        q, k, v, timestamps, padding_mask, time_table, blk_q, segment_ids
     )
     gf = _pad(_pad(g.reshape(B * H, L, hd), Lp, 1), hp, 2)
     n_q = Lp // blk_q
@@ -290,6 +317,7 @@ def hstu_attention_bwd_pallas(
         num_time_buckets=ntb,
         max_position_distance=max_position_distance,
         use_time=use_time,
+        use_seg=use_seg,
     )
     dq, dk, dv, dpt, dtt = pl.pallas_call(
         kernel,
@@ -309,6 +337,8 @@ def hstu_attention_bwd_pallas(
             pl.BlockSpec((1, 1, Lp), lambda i, j: (i // H, 0, 0)),  # ts (keys)
             pl.BlockSpec((1, 1, blk_q), lambda i, j: (i // H, 0, j)),  # ts q-tile
             pl.BlockSpec((1, 1, Lp), lambda i, j: (i // H, 0, 0)),  # padding mask
+            pl.BlockSpec((1, 1, Lp), lambda i, j: (i // H, 0, 0)),  # segments (keys)
+            pl.BlockSpec((1, 1, blk_q), lambda i, j: (i // H, 0, j)),  # seg q-tile
             pl.BlockSpec((1, 1, nb), lambda i, j: (i % H, 0, 0)),
             pl.BlockSpec((1, 1, ntb), lambda i, j: (i % H, 0, 0)),
         ],
@@ -321,7 +351,7 @@ def hstu_attention_bwd_pallas(
         ],
         interpret=interpret,
     )(qf, kf, vf, gf, tsf[:, None], tsf[:, None], maskf[:, None],
-      pos_table[:, None], ttab[:, None])
+      segf[:, None], segf[:, None], pos_table[:, None], ttab[:, None])
 
     dq = dq.reshape(B, H, Lp, hp)[:, :, :L, :hd].astype(q.dtype)
     dk = dk.reshape(B, H, Lp, hp)[:, :, :L, :hd].astype(k.dtype)
@@ -336,7 +366,7 @@ def hstu_attention_bwd_pallas(
 
 def hstu_attention_xla(
     q, k, v, timestamps, padding_mask, pos_table, time_table,
-    max_position_distance: int = 128,
+    max_position_distance: int = 128, segment_ids=None,
 ):
     """Reference-shaped XLA implementation (materializes the bias); used as
     fallback and as the source of the backward pass."""
@@ -355,35 +385,42 @@ def hstu_attention_xla(
     causal = jnp.triu(jnp.ones((L, L), bool), k=1)
     scores = jnp.where(causal[None, None], NEG, scores)
     scores = jnp.where(padding_mask.astype(bool)[:, None, None, :], NEG, scores)
+    if segment_ids is not None:
+        cross = segment_ids[:, :, None] != segment_ids[:, None, :]  # (B, L, L)
+        scores = jnp.where(cross[:, None], NEG, scores)
     attn = jax.nn.silu(scores).astype(v.dtype)
     return jnp.einsum("bhqk,bhkd->bhqd", attn, v)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(7,))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(8,))
 def hstu_attention(q, k, v, timestamps, padding_mask, pos_table, time_table,
-                   max_position_distance=128):
+                   segment_ids=None, max_position_distance=128):
     """Kernel forward + fused flash-style Pallas backward."""
     return hstu_attention_pallas(
         q, k, v, timestamps, padding_mask, pos_table, time_table,
-        max_position_distance,
+        max_position_distance, segment_ids=segment_ids,
     )
 
 
-def _fwd(q, k, v, timestamps, padding_mask, pos_table, time_table, mpd):
+def _fwd(q, k, v, timestamps, padding_mask, pos_table, time_table, segment_ids,
+         mpd):
     out = hstu_attention_pallas(
-        q, k, v, timestamps, padding_mask, pos_table, time_table, mpd
+        q, k, v, timestamps, padding_mask, pos_table, time_table, mpd,
+        segment_ids=segment_ids,
     )
-    return out, (q, k, v, timestamps, padding_mask, pos_table, time_table)
+    return out, (q, k, v, timestamps, padding_mask, pos_table, time_table,
+                 segment_ids)
 
 
 def _bwd(mpd, res, g):
-    q, k, v, timestamps, padding_mask, pos_table, time_table = res
+    q, k, v, timestamps, padding_mask, pos_table, time_table, segment_ids = res
     dq, dk, dv, dpt, dtt = hstu_attention_bwd_pallas(
-        q, k, v, timestamps, padding_mask, pos_table, time_table, g, mpd
+        q, k, v, timestamps, padding_mask, pos_table, time_table, g, mpd,
+        segment_ids=segment_ids,
     )
     if dtt is None and time_table is not None:
         dtt = jnp.zeros_like(time_table)
-    return dq, dk, dv, None, None, dpt, dtt
+    return dq, dk, dv, None, None, dpt, dtt, None
 
 
 hstu_attention.defvjp(_fwd, _bwd)

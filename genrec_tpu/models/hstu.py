@@ -108,7 +108,8 @@ class HSTULayer(nn.Module):
         self.ffn_out = nn.Dense(self.embed_dim, dtype=self.dtype, name="ffn_out")
         self.drop = nn.Dropout(self.dropout)
 
-    def __call__(self, x, padding_mask, timestamps=None, deterministic: bool = True):
+    def __call__(self, x, padding_mask, timestamps=None, deterministic: bool = True,
+                 segment_ids=None):
         B, L, D = x.shape
         H, hd = self.num_heads, D // self.num_heads
         residual = x
@@ -129,7 +130,8 @@ class HSTULayer(nn.Module):
             )
             out = hstu_attention(
                 Q, K, V, timestamps if ttab is not None else None, padding_mask,
-                self.position_bias.table(), ttab, self.max_position_distance,
+                self.position_bias.table(), ttab, segment_ids,
+                self.max_position_distance,
             )
         else:
             from genrec_tpu.kernels.hstu_attention import hstu_attention_xla
@@ -142,6 +144,7 @@ class HSTULayer(nn.Module):
             out = hstu_attention_xla(
                 Q, K, V, timestamps if ttab is not None else None, padding_mask,
                 self.position_bias.table(), ttab, self.max_position_distance,
+                segment_ids=segment_ids,
             ).astype(x.dtype)
         out = out.transpose(0, 2, 1, 3).reshape(B, L, D)
         out = self.attn_norm(out).astype(x.dtype) * U
@@ -187,7 +190,15 @@ class HSTU(nn.Module):
         ]
         self.final_norm = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="final_norm")
 
-    def __call__(self, input_ids, timestamps=None, targets=None, deterministic=True):
+    def __call__(self, input_ids, timestamps=None, targets=None, deterministic=True,
+                 segment_ids=None):
+        """``segment_ids`` ((B, L) int32, 0 = pad) switches attention to
+        (causal ∧ same-segment) for packed rows. HSTU's position bias is
+        relative-only (and its temporal bias reads pairwise diffs), so
+        within-segment distances are preserved without an explicit
+        positions operand; cross-segment pairs — including their temporal
+        buckets — are masked outright. segment_ids=None is exactly the
+        original forward."""
         padding_mask = input_ids == 0
         # padding_idx=0 semantics: pad row reads zero, no lookup gradient.
         x = self.item_embedding[input_ids].astype(self.dtype)
@@ -195,7 +206,7 @@ class HSTU(nn.Module):
         x = self.emb_dropout(x, deterministic=deterministic)
 
         for layer in self.layers:
-            x = layer(x, padding_mask, timestamps, deterministic)
+            x = layer(x, padding_mask, timestamps, deterministic, segment_ids)
 
         x = self.final_norm(x).astype(self.dtype)
         if targets is not None and self.fused_ce:

@@ -40,7 +40,8 @@ class _Attention(nn.Module):
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, query, key_value, mask, deterministic: bool):
+    def __call__(self, query, key_value, mask, deterministic: bool,
+                 segment_ids=None):
         B, L, D = query.shape
         H = self.num_heads
         hd = D // H
@@ -54,6 +55,10 @@ class _Attention(nn.Module):
         scores = jnp.where(key_mask == 0, _NEG, scores)
         causal = jnp.triu(jnp.ones((L, L), bool), k=1)
         scores = jnp.where(causal[None, None], _NEG, scores)
+        if segment_ids is not None:
+            # Packed rows: attention stays within (causal ∧ same-segment).
+            cross = segment_ids[:, :, None] != segment_ids[:, None, :]
+            scores = jnp.where(cross[:, None], _NEG, scores)
 
         attn = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(query.dtype)
         # Query-side mask after softmax — official-impl quirk.
@@ -89,12 +94,13 @@ class SASRecBlock(nn.Module):
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, x, mask, deterministic: bool):
+    def __call__(self, x, mask, deterministic: bool, segment_ids=None):
         # LayerNorm statistics stay fp32 (autocast-equivalent).
         normed = nn.LayerNorm(epsilon=1e-8, name="norm1", dtype=jnp.float32)(x)
         x = _Attention(
             self.embed_dim, self.num_heads, self.dropout, self.dtype, name="attention"
-        )(normed.astype(self.dtype), x.astype(self.dtype), mask, deterministic)
+        )(normed.astype(self.dtype), x.astype(self.dtype), mask, deterministic,
+          segment_ids)
         normed = nn.LayerNorm(epsilon=1e-8, name="norm2", dtype=jnp.float32)(x)
         x = _FFN(self.embed_dim, self.ffn_dim, self.dropout, self.dtype, name="ffn")(
             normed.astype(self.dtype), x, deterministic
@@ -137,17 +143,26 @@ class SASRec(nn.Module):
         self.final_norm = nn.LayerNorm(epsilon=1e-8, name="final_norm", dtype=jnp.float32)
         self.emb_dropout = nn.Dropout(self.dropout)
 
-    def __call__(self, input_ids, targets=None, deterministic: bool = True):
+    def __call__(self, input_ids, targets=None, deterministic: bool = True,
+                 segment_ids=None, positions=None):
+        """``segment_ids``/``positions`` (both (B, L) int32) switch on the
+        packed-row path: attention becomes (causal ∧ same-segment) and the
+        learned position embedding is looked up at the WITHIN-SEGMENT
+        position instead of the row slot. With both None the behavior is
+        exactly the original single-example-per-row forward."""
         B, L = input_ids.shape
         mask = (input_ids != 0)[..., None].astype(self.dtype)
 
         x = self.item_embedding[input_ids].astype(self.dtype) * (self.embed_dim**0.5)
-        x = x + self.position_embedding[None, :L].astype(self.dtype)
+        if positions is None:
+            x = x + self.position_embedding[None, :L].astype(self.dtype)
+        else:
+            x = x + self.position_embedding[positions].astype(self.dtype)
         x = self.emb_dropout(x, deterministic=deterministic)
         x = x * mask
 
         for block in self.blocks:
-            x = block(x, mask, deterministic)
+            x = block(x, mask, deterministic, segment_ids)
             x = x * mask  # re-mask after every block (official-impl quirk)
 
         x = self.final_norm(x)

@@ -30,7 +30,10 @@ import jax
 import jax.numpy as jnp
 
 from genrec_tpu.models.layers import RMSNorm
-from genrec_tpu.ops.buckets import t5_relative_position_bucket
+from genrec_tpu.ops.buckets import (
+    t5_bucket_grid_from_positions,
+    t5_relative_position_bucket,
+)
 
 _NEG = -1e9
 
@@ -74,6 +77,19 @@ class T5Attention(nn.Module):
         idx = buckets[None] + head_offset  # (H, q, k)
         return self.rel_bias[idx, 0][None]  # (1, H, q, k)
 
+    def _position_bias_packed(self, positions):
+        """Per-batch bias grid from explicit per-token positions
+        ((B, L) int32, within-segment for packed rows) -> (B, H, L, L).
+        Cross-segment pairs get arbitrary buckets here; the caller masks
+        them before softmax so they never contribute."""
+        buckets = t5_bucket_grid_from_positions(
+            positions, self.num_relative_buckets, self.max_distance,
+            bidirectional=True,
+        )  # (B, L, L)
+        head_offset = jnp.arange(self.n_heads)[:, None, None] * self.num_relative_buckets
+        idx = buckets[:, None] + head_offset[None]  # (B, H, L, L)
+        return self.rel_bias[idx, 0]
+
     def __call__(
         self,
         query,
@@ -82,6 +98,7 @@ class T5Attention(nn.Module):
         attn_mask=None,
         key_padding_mask=None,
         deterministic: bool = True,
+        positions=None,
     ):
         B, Lq, _ = query.shape
         H, hd = self.n_heads, self.d_model // self.n_heads
@@ -99,7 +116,10 @@ class T5Attention(nn.Module):
         scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * (hd**-0.5)
         scores = scores.astype(jnp.float32)
         if self.has_relative_bias and not self.is_cross_attention:
-            scores = scores + self._position_bias(Lq, Lk)
+            if positions is not None:
+                scores = scores + self._position_bias_packed(positions)
+            else:
+                scores = scores + self._position_bias(Lq, Lk)
         if key_padding_mask is not None:  # True = padding
             scores = jnp.where(key_padding_mask[:, None, None, :], _NEG, scores)
         if attn_mask is not None:  # additive, (Lq, Lk) or broadcastable
@@ -220,12 +240,14 @@ class TransformerBlock(nn.Module):
         key_padding_mask=None,
         memory_key_padding_mask=None,
         deterministic: bool = True,
+        positions=None,
     ):
         h = self.self_attn(
             self.norm1(x),
             attn_mask=attn_mask,
             key_padding_mask=key_padding_mask,
             deterministic=deterministic,
+            positions=positions,
         )
         x = x + self.drop1(h, deterministic=deterministic)
         if self.cross_attn and context is not None:
@@ -270,11 +292,12 @@ class TransformerEncoder(nn.Module):
             for i in range(self.depth)
         ]
 
-    def __call__(self, src, attn_mask=None, key_padding_mask=None, deterministic=True):
+    def __call__(self, src, attn_mask=None, key_padding_mask=None, deterministic=True,
+                 positions=None):
         for layer in self.layers:
             src = layer(
                 src, attn_mask=attn_mask, key_padding_mask=key_padding_mask,
-                deterministic=deterministic,
+                deterministic=deterministic, positions=positions,
             )
         return src
 

@@ -46,6 +46,12 @@ class TigerGenerationOutput(NamedTuple):
     log_probas: jax.Array  # (B, K)
 
 
+class TigerPackedOutput(NamedTuple):
+    per_example_loss: jax.Array  # (R, S) token-sum CE per segment
+    loss: Optional[jax.Array]  # mean over valid segments
+    real_tokens: jax.Array  # scalar: non-pad encoder slots in the batch
+
+
 class Tiger(nn.Module):
     embedding_dim: int
     attn_dim: int
@@ -176,6 +182,83 @@ class Tiger(nn.Module):
             # Per-sequence SUM over tokens, then batch mean (tiger.py:232-240).
             loss = jnp.mean(jnp.sum(per_tok, axis=1))
         return TigerOutput(logits=logits, loss=loss)
+
+    # ---- packed-sequence training ------------------------------------------
+
+    def forward_packed(
+        self,
+        item_input_ids,
+        token_type_ids,
+        user_token_ids,
+        user_mask,
+        segment_ids,
+        positions,
+        target_ids,
+        segment_valid,
+        deterministic: bool = True,
+    ) -> TigerPackedOutput:
+        """Training forward over PACKED encoder rows.
+
+        Multiple (user, history) examples share one encoder row: each
+        segment starts with its user token (``user_mask`` marks the slot,
+        ``user_token_ids`` carries the hashed id there), followed by the
+        flattened sem-id history. Encoder self-attention is restricted to
+        same-segment pairs and the T5 relative bias reads WITHIN-SEGMENT
+        positions, so each segment's encoder output equals the unpacked
+        forward's exactly. Decoders stay per example — (R*S, D+1) rows
+        cross-attending into their own segment of the packed memory via a
+        per-example memory mask.
+
+        Shapes: token operands (R, L); ``target_ids`` (R, S, D);
+        ``segment_valid`` (R, S) with S = max segments per row. Loss is the
+        reference per-sequence token-sum CE averaged over VALID segments —
+        identical to the unpacked batch mean over the same examples.
+        """
+        R, L = item_input_ids.shape
+        item_emb = self.sem_id_embedding(item_input_ids, token_type_ids)
+        user_emb = self.user_id_embedding(user_token_ids)
+        enc = jnp.where(user_mask[..., None] == 1, user_emb, item_emb)
+        pad = segment_ids == 0  # True = padding slot
+        cross = segment_ids[:, :, None] != segment_ids[:, None, :]
+        seg_mask = jnp.where(cross, -1e9, 0.0)[:, None]  # additive (R,1,L,L)
+        enc = self.in_proj_context(
+            self.drop(self.norm_context(enc), deterministic=deterministic)
+        )
+        memory = self.transformer.encoder(
+            enc, attn_mask=seg_mask, key_padding_mask=pad,
+            deterministic=deterministic, positions=positions,
+        )
+
+        _, S, D = target_ids.shape
+        N = R * S
+        tgt_flat = target_ids.reshape(N, D)
+        tgt_types = jnp.broadcast_to(jnp.arange(D), (N, D))
+        dec = self._decoder_input(N, tgt_flat, tgt_types)
+        dec = self.in_proj(self.drop(self.norm(dec), deterministic=deterministic))
+        # Per-example memory: segment s of row r, selected by mask. The
+        # repeat is decoder-side only (N ≈ examples, same as the unpacked
+        # decoder batch) — the packed ENCODER ran R rows, which is the win.
+        mem = jnp.repeat(memory, S, axis=0)  # (N, L, attn_dim)
+        seg_of = jnp.tile(jnp.arange(1, S + 1), R)  # (N,)
+        mem_pad = jnp.repeat(segment_ids, S, axis=0) != seg_of[:, None]
+        out = self.transformer.decoder(
+            dec, mem,
+            attn_mask=causal_mask(dec.shape[1]),
+            memory_key_padding_mask=mem_pad,
+            deterministic=deterministic,
+        )
+        logits = self._mask_pad_logits(self.output_head(out))
+        target_vocab = tgt_types * self.num_item_embeddings + tgt_flat
+        per_tok, _ = cross_entropy_with_ignore(
+            logits[:, :-1, :], target_vocab, ignore_index=-1
+        )
+        seq_loss = per_tok.sum(axis=1).reshape(R, S)
+        valid = segment_valid.astype(jnp.float32)
+        loss = (seq_loss * valid).sum() / jnp.maximum(valid.sum(), 1.0)
+        return TigerPackedOutput(
+            per_example_loss=seq_loss, loss=loss,
+            real_tokens=jnp.sum(segment_ids != 0),
+        )
 
     # ---- generation --------------------------------------------------------
 

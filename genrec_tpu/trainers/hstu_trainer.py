@@ -15,10 +15,15 @@ import optax
 
 from genrec_tpu import configlib
 from genrec_tpu.core.harness import make_train_step
-from genrec_tpu.core.logging import Tracker, setup_logger
+from genrec_tpu.core.logging import Tracker, log_occupancy, setup_logger
 from genrec_tpu.core.profiling import ProfileWindow, StepTimer, log_epoch_perf
 from genrec_tpu.core.state import TrainState
-from genrec_tpu.data.batching import batch_iterator, fold_valid, prefetch_to_device
+from genrec_tpu.data.batching import (
+    batch_iterator,
+    fold_valid,
+    pack_examples,
+    prefetch_to_device,
+)
 from genrec_tpu.data.synthetic import SyntheticSeqDataset
 from genrec_tpu.models.hstu import HSTU
 from genrec_tpu.ops.metrics import first_match_ranks
@@ -84,6 +89,12 @@ def train(
     # (kernels/fused_ce.py): same loss, no (B,L,V) logits in HBM.
     # auto = on when running on TPU (Mosaic-compiled only).
     use_fused_ce="auto",
+    # First-fit-decreasing sequence packing: segment-aware attention keeps
+    # multiple short histories per row (temporal/positional buckets never
+    # bridge segments — the Pallas kernel masks cross-segment pairs
+    # in-register). HSTU's biases are relative-only, so eval stays on the
+    # original left-padded rows. False restores the unpacked layout.
+    pack_sequences=True,
     dataset="synthetic",
     dataset_folder="dataset/amazon",
     split="beauty",
@@ -110,9 +121,10 @@ def train(
     if dataset == "synthetic":
         ds = SyntheticSeqDataset(max_seq_len=max_seq_len, seed=seed)
         n_items = num_items or ds.num_items
-        train_arrays = ds.train_arrays_with_time()
         valid_arrays = ds.eval_arrays_with_time("valid")
         test_arrays = ds.eval_arrays_with_time("test")
+        train_examples = lambda: ds.train_examples(with_time=True)
+        padded_train = ds.train_arrays_with_time
     else:
         from genrec_tpu.data.amazon import AmazonSASRecData
 
@@ -121,9 +133,32 @@ def train(
             with_timestamps=True,
         )
         n_items = ds.num_items
-        train_arrays = ds.train_arrays()
         valid_arrays = ds.eval_arrays("valid")
         test_arrays = ds.eval_arrays("test")
+        train_examples = ds.train_examples
+        padded_train = ds.train_arrays
+
+    if pack_sequences:
+        # Raw examples only — never materialize the padded train matrix
+        # just to discard it for the packed layout. Re-packed per epoch
+        # (epoch-seeded shuffle) so example co-location is re-mixed like
+        # the padded layout's per-epoch permutation.
+        examples = train_examples()
+
+        def repack(epoch: int):
+            arrays, rep = pack_examples(
+                examples, row_len=max_seq_len, seed=(seed, epoch)
+            )
+            # HSTU has no absolute positions and segment_valid has no
+            # consumer in its token-level CE — don't ship them to device.
+            arrays.pop("positions")
+            arrays.pop("segment_valid")
+            return arrays, rep
+
+        train_arrays, pack_report = repack(0)
+        logger.info(str(pack_report))
+    else:
+        train_arrays = padded_train()
 
     compute_dtype = jnp.bfloat16 if (amp and mixed_precision_type == "bf16") else jnp.float32
     if use_pallas == "auto":
@@ -165,9 +200,13 @@ def train(
     def loss_fn(p, batch, step_rng):
         _, loss = model.apply(
             {"params": p}, batch["input_ids"], batch.get("timestamps"),
-            batch["targets"], deterministic=False, rngs={"dropout": step_rng},
+            batch["targets"], deterministic=False,
+            segment_ids=batch.get("segment_ids"), rngs={"dropout": step_rng},
         )
-        return loss, {}
+        aux = {}
+        if "segment_ids" in batch:
+            aux["real_tokens"] = jnp.sum(batch["segment_ids"] != 0).astype(jnp.float32)
+        return loss, aux
 
     step_fn = jax.jit(make_train_step(loss_fn, optimizer, clip_norm=None), donate_argnums=0)
     state = replicate(mesh, TrainState.create(params, optimizer, state_rng))
@@ -203,8 +242,15 @@ def train(
             tracker.finish()
             logger.info(f"preempted: exiting before epoch {epoch}")
             return {}, {}
-        epoch_loss, n_batches = None, 0
-        timer = StepTimer(batch_size, skip_first=1 if epoch == start_epoch else 0)
+        if pack_sequences and epoch > 0:
+            train_arrays, _ = repack(epoch)  # re-mix example co-location
+        epoch_loss, epoch_tokens, n_batches = None, None, 0
+        # seq/s keeps meaning EXAMPLES under packing (rows hold several).
+        examples_per_step = (
+            batch_size * pack_report.n_examples / pack_report.n_rows
+            if pack_sequences else batch_size
+        )
+        timer = StepTimer(examples_per_step, skip_first=1 if epoch == start_epoch else 0)
         for sharded, _ in prefetch_to_device(
             batch_iterator(train_arrays, batch_size, shuffle=True,
                            seed=seed, epoch=epoch, drop_last=True),
@@ -212,13 +258,29 @@ def train(
         ):
             state, m = step_fn(state, sharded)
             epoch_loss = m["loss"] if epoch_loss is None else epoch_loss + m["loss"]
+            if "real_tokens" in m:
+                epoch_tokens = (
+                    m["real_tokens"] if epoch_tokens is None
+                    else epoch_tokens + m["real_tokens"]
+                )
             timer.tick()
             n_batches += 1
             global_step += 1
             prof.tick(global_step)
             if global_step % wandb_log_interval == 0:
                 tracker.log({"global_step": global_step, "train/loss": float(m["loss"])})
-        log_epoch_perf(logger, tracker, epoch, epoch_loss, n_batches, timer)
+        log_epoch_perf(
+            logger, tracker, epoch, epoch_loss, n_batches, timer,
+            tokens_per_step=(
+                float(epoch_tokens) / n_batches
+                if (epoch_tokens is not None and n_batches) else None
+            ),
+        )
+        if epoch_tokens is not None and n_batches:
+            log_occupancy(
+                logger, tracker, epoch, float(epoch_tokens),
+                n_batches * batch_size * max_seq_len,
+            )
 
         if ckpt is not None and (epoch + 1) % save_every_epoch == 0:
             ckpt.save(epoch, state)

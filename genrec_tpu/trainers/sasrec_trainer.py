@@ -19,21 +19,34 @@ import optax
 
 from genrec_tpu import configlib
 from genrec_tpu.core.harness import make_train_step
-from genrec_tpu.core.logging import Tracker, setup_logger
+from genrec_tpu.core.logging import Tracker, log_occupancy, setup_logger
 from genrec_tpu.core.profiling import ProfileWindow, StepTimer, log_epoch_perf
 from genrec_tpu.core.state import TrainState
-from genrec_tpu.data.batching import batch_iterator, fold_valid, prefetch_to_device
+from genrec_tpu.data.batching import (
+    batch_iterator,
+    fold_valid,
+    pack_examples,
+    prefetch_to_device,
+    right_align,
+)
 from genrec_tpu.data.synthetic import SyntheticSeqDataset
 from genrec_tpu.models.sasrec import SASRec
 from genrec_tpu.ops.metrics import first_match_ranks
 from genrec_tpu.parallel import distributed_init, get_mesh, metric_allreduce, replicate
 
 
-def make_eval_step(model):
+def make_eval_step(model, last_from_length: bool = False):
     @jax.jit
     def eval_step(params, batch, valid):
         logits, _ = model.apply({"params": params}, batch["input_ids"])
-        last = logits[:, -1, :].at[:, 0].set(-jnp.inf)
+        if last_from_length:
+            # Right-padded eval rows (packed training's position indexing):
+            # the prediction sits at the last VALID slot, not slot -1.
+            idx = jnp.maximum(jnp.sum(batch["input_ids"] != 0, axis=1) - 1, 0)
+            last = jnp.take_along_axis(logits, idx[:, None, None], axis=1)[:, 0]
+        else:
+            last = logits[:, -1, :]
+        last = last.at[:, 0].set(-jnp.inf)
         _, top = jax.lax.top_k(last, 10)
         # Padded rows (valid=0) are masked out of every sum.
         ranks = first_match_ranks(batch["targets"], top[..., None])
@@ -100,6 +113,12 @@ def train(
     # (kernels/fused_ce.py): same loss, no (B,L,V) logits in HBM.
     # auto = on when running on TPU (Mosaic-compiled only).
     use_fused_ce="auto",
+    # First-fit-decreasing sequence packing (data/batching.pack_examples):
+    # multiple short histories share one max_seq_len row with segment-aware
+    # attention and within-segment positions, so the MXU stops paying for
+    # padding. False restores the original one-example-per-row layout
+    # (left-padded, absolute positions) exactly.
+    pack_sequences=True,
     profile_steps=0,
     seed=0,
 ):
@@ -112,17 +131,38 @@ def train(
     if dataset == "synthetic":
         ds = SyntheticSeqDataset(max_seq_len=max_seq_len, seed=seed)
         n_items = num_items or ds.num_items
-        train_arrays = ds.train_arrays()
-        valid_arrays = ds.eval_arrays("valid")
-        test_arrays = ds.eval_arrays("test")
     else:
         from genrec_tpu.data.amazon import AmazonSASRecData
 
         ds = AmazonSASRecData(root=dataset_folder, split=split, max_seq_len=max_seq_len)
         n_items = ds.num_items
+    valid_arrays = ds.eval_arrays("valid")
+    test_arrays = ds.eval_arrays("test")
+
+    if pack_sequences:
+        # The packer owns layout: raw examples only — never materialize
+        # the padded (N, max_seq_len) train matrix just to discard it.
+        # Re-packed per epoch (epoch-seeded example shuffle) so example
+        # co-location in a row is re-mixed like the padded layout's
+        # per-epoch permutation, not frozen at startup.
+        train_examples = ds.train_examples()
+
+        def repack(epoch: int):
+            arrays, rep = pack_examples(
+                train_examples, row_len=max_seq_len, seed=(seed, epoch)
+            )
+            arrays.pop("segment_valid")  # unused by SASRec's token-level CE
+            return arrays, rep
+
+        train_arrays, pack_report = repack(0)
+        logger.info(str(pack_report))
+        # Eval rows must index positions the way packed training does
+        # (token t at position t), and predictions come from the last
+        # VALID slot (make_eval_step(last_from_length=True)).
+        valid_arrays = right_align(valid_arrays)
+        test_arrays = right_align(test_arrays)
+    else:
         train_arrays = ds.train_arrays()
-        valid_arrays = ds.eval_arrays("valid")
-        test_arrays = ds.eval_arrays("test")
 
     compute_dtype = (
         jnp.bfloat16 if (amp and mixed_precision_type == "bf16") else jnp.float32
@@ -161,13 +201,21 @@ def train(
             batch["input_ids"],
             batch["targets"],
             deterministic=False,
+            segment_ids=batch.get("segment_ids"),
+            positions=batch.get("positions"),
             rngs={"dropout": step_rng},
         )
-        return loss, {}
+        aux = {}
+        if "segment_ids" in batch:
+            # tokens-per-step / occupancy surface in the step metrics.
+            aux["real_tokens"] = jnp.sum(batch["segment_ids"] != 0).astype(jnp.float32)
+        return loss, aux
 
     step_fn = jax.jit(make_train_step(loss_fn, optimizer, clip_norm=None), donate_argnums=0)
     state = replicate(mesh, TrainState.create(params, optimizer, state_rng))
-    eval_step = make_eval_step(model)  # one jit cache for every eval call
+    # One jit cache for every eval call; packed training reads predictions
+    # from the last valid slot of right-padded eval rows.
+    eval_step = make_eval_step(model, last_from_length=pack_sequences)
 
     from genrec_tpu.core.checkpoint import BestTracker, CheckpointManager, maybe_resume, save_params
 
@@ -199,10 +247,18 @@ def train(
             tracker.finish()
             logger.info(f"preempted: exiting before epoch {epoch}")
             return {}, {}
+        if pack_sequences and epoch > 0:
+            train_arrays, _ = repack(epoch)  # re-mix example co-location
         # Device-scalar accumulation: float() only at logging boundaries so
         # the host never blocks on the jitted step (async dispatch).
-        epoch_loss, n_batches = None, 0
-        timer = StepTimer(batch_size, skip_first=1 if epoch == start_epoch else 0)
+        epoch_loss, epoch_tokens, n_batches = None, None, 0
+        # Packed rows hold several examples: feed the timer the MEAN
+        # examples per step so seq/s keeps meaning sequences, not rows.
+        examples_per_step = (
+            batch_size * pack_report.n_examples / pack_report.n_rows
+            if pack_sequences else batch_size
+        )
+        timer = StepTimer(examples_per_step, skip_first=1 if epoch == start_epoch else 0)
         for sharded, _ in prefetch_to_device(
             batch_iterator(train_arrays, batch_size, shuffle=True,
                            seed=seed, epoch=epoch, drop_last=True),
@@ -210,6 +266,11 @@ def train(
         ):
             state, metrics = step_fn(state, sharded)
             epoch_loss = metrics["loss"] if epoch_loss is None else epoch_loss + metrics["loss"]
+            if "real_tokens" in metrics:
+                epoch_tokens = (
+                    metrics["real_tokens"] if epoch_tokens is None
+                    else epoch_tokens + metrics["real_tokens"]
+                )
             timer.tick()
             n_batches += 1
             global_step += 1
@@ -218,7 +279,18 @@ def train(
                 tracker.log(
                     {"global_step": global_step, "train/loss": float(metrics["loss"])}
                 )
-        log_epoch_perf(logger, tracker, epoch, epoch_loss, n_batches, timer)
+        log_epoch_perf(
+            logger, tracker, epoch, epoch_loss, n_batches, timer,
+            tokens_per_step=(
+                float(epoch_tokens) / n_batches
+                if (epoch_tokens is not None and n_batches) else None
+            ),
+        )
+        if epoch_tokens is not None and n_batches:
+            log_occupancy(
+                logger, tracker, epoch, float(epoch_tokens),
+                n_batches * batch_size * max_seq_len,
+            )
 
         if ckpt_mgr is not None and (epoch + 1) % save_every_epoch == 0:
             ckpt_mgr.save(epoch, state)  # full TrainState: one resumable format everywhere

@@ -20,11 +20,12 @@ import optax
 
 from genrec_tpu import configlib
 from genrec_tpu.core.harness import make_train_step
-from genrec_tpu.core.logging import Tracker, setup_logger
+from genrec_tpu.core.logging import Tracker, log_occupancy, setup_logger
 from genrec_tpu.core.profiling import ProfileWindow, StepTimer, log_epoch_perf
 from genrec_tpu.core.state import TrainState
 from genrec_tpu.data.batching import (
     batch_iterator,
+    pack_examples,
     prefetch_eval_batches,
     prefetch_to_device,
 )
@@ -87,6 +88,18 @@ def train(
     sem_ids_path=None,
     add_disambiguation=False,
     tensor_parallel=1,
+    # First-fit-decreasing sequence packing of the ENCODER stream: several
+    # (user token + history) examples share one row with segment-restricted
+    # attention and within-segment T5 relative positions; decoders stay per
+    # example, cross-attending into their own segment of the packed memory.
+    # False restores the original one-example-per-row layout exactly.
+    pack_sequences=True,
+    # Decoder rows are sized rows x MAX-segments-per-row, so one dense row
+    # of tiny histories would make every row pay for its segment count;
+    # capping trades a little encoder occupancy for a bounded decoder batch
+    # (measured on the Amazon-like distribution: cap 4 keeps occupancy
+    # within a few percent and the packed step ~2x padded examples/sec).
+    pack_max_segments=4,
     generate_temperature=0.2,
     do_eval=True,
     eval_every_epoch=10,
@@ -142,10 +155,29 @@ def train(
                             user_hash_size=num_user_embeddings)
         sem_id_dim = data.D
 
-    train_arrays = data.train_arrays()
     valid_arrays = data.eval_arrays("valid")
     test_arrays = data.eval_arrays("test")
     trie = build_trie(data.valid_item_sem_ids(), codebook_size)
+
+    pack_row_len = 1 + max_items * sem_id_dim  # user token + item stream
+    if pack_sequences:
+        # Raw examples only — the padded (N, L) train matrix is never
+        # materialized when the packer owns layout. Re-packed per epoch
+        # (epoch-seeded shuffle) so example co-location is re-mixed like
+        # the padded layout's per-epoch permutation.
+        examples = data.train_examples()
+
+        def repack(epoch: int):
+            return pack_examples(
+                examples, row_len=pack_row_len,
+                segment_keys=("target_ids",), max_segments=pack_max_segments,
+                seed=(seed, epoch),
+            )
+
+        train_arrays, pack_report = repack(0)
+        logger.info(str(pack_report))
+    else:
+        train_arrays = data.train_arrays()
 
     compute_dtype = jnp.bfloat16 if (amp and mixed_precision_type == "bf16") else jnp.float32
     model = Tiger(
@@ -175,10 +207,11 @@ def train(
         jnp.ones((1, L), jnp.int32),
     )["params"]
 
-    # One optimizer step consumes batch_size * accum samples (state.step
-    # counts optimizer steps, not microbatches).
+    # One optimizer step consumes batch_size * accum rows (packed rows hold
+    # several examples each; state.step counts optimizer steps).
+    n_train_rows = next(iter(train_arrays.values())).shape[0]
     opt_steps_per_epoch = max(
-        1, len(train_arrays["user_ids"]) // (batch_size * gradient_accumulate_every)
+        1, n_train_rows // (batch_size * gradient_accumulate_every)
     )
     total_steps = epochs * opt_steps_per_epoch
     schedule = cosine_schedule_with_warmup(learning_rate, num_warmup_steps, total_steps)
@@ -186,17 +219,43 @@ def train(
 
     tgt_types = jnp.broadcast_to(jnp.arange(sem_id_dim), (1, sem_id_dim))
 
-    def loss_fn(params, batch, step_rng):
-        B = batch["user_ids"].shape[0]
-        out = model.apply(
-            {"params": params},
-            batch["user_ids"], batch["item_input_ids"], batch["token_type_ids"],
-            batch["target_ids"], jnp.broadcast_to(tgt_types, (B, sem_id_dim)),
-            batch["seq_mask"],
-            deterministic=False,
-            rngs={"dropout": step_rng},
-        )
-        return out.loss, {}
+    if pack_sequences:
+        # Expected examples per microbatch (static). make_train_step
+        # averages microbatch losses with EQUAL weight; packed microbatches
+        # carry varying example counts, so under accumulation each loss is
+        # rescaled by actual/expected count — every example then weighs the
+        # same in the averaged gradient (a fixed count makes this exact for
+        # unpacked batches; accum=1 keeps the exact mean-over-valid loss).
+        expected_per_micro = batch_size * pack_report.n_examples / pack_report.n_rows
+
+        def loss_fn(params, batch, step_rng):
+            out = model.apply(
+                {"params": params},
+                batch["item_input_ids"], batch["token_type_ids"],
+                batch["user_token_ids"], batch["user_mask"],
+                batch["segment_ids"], batch["positions"],
+                batch["target_ids"], batch["segment_valid"],
+                deterministic=False,
+                rngs={"dropout": step_rng},
+                method=Tiger.forward_packed,
+            )
+            loss = out.loss
+            if gradient_accumulate_every > 1:
+                count = jnp.sum(batch["segment_valid"]).astype(jnp.float32)
+                loss = loss * count / expected_per_micro
+            return loss, {"real_tokens": out.real_tokens.astype(jnp.float32)}
+    else:
+        def loss_fn(params, batch, step_rng):
+            B = batch["user_ids"].shape[0]
+            out = model.apply(
+                {"params": params},
+                batch["user_ids"], batch["item_input_ids"], batch["token_type_ids"],
+                batch["target_ids"], jnp.broadcast_to(tgt_types, (B, sem_id_dim)),
+                batch["seq_mask"],
+                deterministic=False,
+                rngs={"dropout": step_rng},
+            )
+            return out.loss, {}
 
     step_fn = jax.jit(
         make_train_step(
@@ -246,9 +305,14 @@ def train(
         # so host dispatch never blocks on the step (async dispatch).
         # StepTimer.tick() likewise does not block; the block_until_ready
         # on the chained epoch_loss below closes the timing window.
-        epoch_loss, n_batches = None, 0
+        if pack_sequences and epoch > 0:
+            train_arrays, _ = repack(epoch)  # re-mix example co-location
+        epoch_loss, epoch_tokens, n_batches = None, None, 0
+        # seq/s keeps meaning EXAMPLES under packing (rows hold several).
+        rows_per_step = batch_size * gradient_accumulate_every
         timer = StepTimer(
-            batch_size * gradient_accumulate_every,
+            rows_per_step * pack_report.n_examples / pack_report.n_rows
+            if pack_sequences else rows_per_step,
             skip_first=1 if epoch == start_epoch else 0,
         )
         for sharded, _ in prefetch_to_device(
@@ -258,13 +322,29 @@ def train(
         ):
             state, m = step_fn(state, sharded)
             epoch_loss = m["loss"] if epoch_loss is None else epoch_loss + m["loss"]
+            if "real_tokens" in m:
+                # make_train_step MEANS aux over microbatches; scale back
+                # to whole-step tokens.
+                tok = m["real_tokens"] * gradient_accumulate_every
+                epoch_tokens = tok if epoch_tokens is None else epoch_tokens + tok
             timer.tick()
             n_batches += 1
             global_step += 1
             prof.tick(global_step)
             if global_step % wandb_log_interval == 0:
                 tracker.log({"global_step": global_step, "train/loss": float(m["loss"])})
-        log_epoch_perf(logger, tracker, epoch, epoch_loss, n_batches, timer)
+        log_epoch_perf(
+            logger, tracker, epoch, epoch_loss, n_batches, timer,
+            tokens_per_step=(
+                float(epoch_tokens) / n_batches
+                if (epoch_tokens is not None and n_batches) else None
+            ),
+        )
+        if epoch_tokens is not None and n_batches:
+            log_occupancy(
+                logger, tracker, epoch, float(epoch_tokens),
+                n_batches * batch_size * gradient_accumulate_every * pack_row_len,
+            )
 
         if do_eval and (epoch + 1) % eval_every_epoch == 0:
             eval_rng, sub = jax.random.split(eval_rng)

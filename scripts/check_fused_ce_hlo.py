@@ -43,6 +43,8 @@ def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--write-note", action="store_true",
                     help="append the verdict to docs/PERF.md")
+    ap.add_argument("--small", action="store_true",
+                    help="tiny shapes for fast CI runs (scripts/ci_checks.sh --smoke)")
     ap.add_argument("--platform", default=None)
     args = ap.parse_args(argv)
 
@@ -66,7 +68,7 @@ def main(argv=None):
     n_dev = jax.device_count()
     mesh = Mesh(np.array(jax.devices()).reshape(n_dev), ("data",))
 
-    B, L, V, D = 64, 50, 12160, 64
+    B, L, V, D = (16, 16, 640, 16) if args.small else (64, 50, 12160, 64)
     model = SASRec(
         num_items=V, max_seq_len=L, embed_dim=D, num_heads=2, num_blocks=2,
         ffn_dim=256, dropout=0.0, fused_ce=True, dtype=jnp.bfloat16,
@@ -113,7 +115,10 @@ def main(argv=None):
         if n_dev > 1 and re.search(rf"\b{rows_global}\b", line)
     ]
 
-    conclusive = n_dev > 1
+    # Off-TPU the Pallas call runs in interpret mode, so no Mosaic custom
+    # call can appear — only a >=2-device TPU run answers the partitioning
+    # question; anything else merely certifies the sharded-jit compile.
+    conclusive = n_dev > 1 and backend == "tpu"
     # ok answers "is partitioning VERIFIED good" — inconclusive runs must
     # not read as a pass to automation keying on ok/rc.
     ok = (
@@ -136,11 +141,15 @@ def main(argv=None):
 
     if args.write_note:
         if not conclusive:
+            what = (
+                "compiled inside the sharded-jit program" if custom_calls
+                else ("interpret-mode (non-TPU) run: sharded-jit compile "
+                      "certified only" if backend != "tpu"
+                      else "NOT found in the compiled module")
+            )
             msg = (
-                "single-chip run: Mosaic kernel "
-                f"{'compiled inside the sharded-jit program' if custom_calls else 'NOT found in the compiled module'}; "
-                "collectives elided at 1 device, partitioning question "
-                "still open (needs >= 2 chips)"
+                f"inconclusive run: Mosaic kernel {what}; "
+                "partitioning question still open (needs >= 2 TPU chips)"
             )
         elif ok:
             msg = ("OK: kernel partitioned — no all-gather feeds it and "
@@ -157,11 +166,14 @@ def main(argv=None):
         os.makedirs(os.path.join(REPO, "out"), exist_ok=True)
         with open(os.path.join(REPO, "out", "fused_ce_hlo.txt"), "w") as f:
             f.write(hlo)
-    # rc: 0 = verified good; 2 = ran fine but inconclusive (1 device);
-    # 1 = a check failed.
+    # rc: 0 = verified good; 2 = ran fine but inconclusive (1 device or
+    # non-TPU backend, where Mosaic cannot appear at all); 1 = a check
+    # failed (including a TPU run whose kernel vanished from the module).
     if ok:
         return 0
-    return 2 if (not conclusive and custom_calls) else 1
+    if not conclusive:
+        return 2 if (custom_calls or backend != "tpu") else 1
+    return 1
 
 
 if __name__ == "__main__":

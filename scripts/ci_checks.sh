@@ -1,0 +1,52 @@
+#!/usr/bin/env bash
+# Single entrypoint for the repo's standalone static checks (VERDICT r4 /
+# ISSUE 2 consolidation):
+#
+#   check_decode_hlo.py    — KV-cached decode compiles w/o K-fold memory
+#   check_fused_ce_hlo.py  — fused-CE Mosaic call partitions under the mesh
+#   check_packed_hlo.py    — packed train step has no per-example re-pad
+#   tpu_kernel_check.py    — Pallas kernels at trainer shapes (TPU only)
+#
+# Usage:
+#   scripts/ci_checks.sh            # full shapes, current backend; runs the
+#                                   # hardware kernel check too when on TPU
+#   scripts/ci_checks.sh --smoke    # CI mode: small shapes, CPU-pinned,
+#                                   # skips the hardware-only kernel check
+#
+# Exit code: 0 when every check passes (rc 2 = "ran fine but inconclusive",
+# e.g. single-chip partitioning checks, is tolerated); 1 otherwise.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+MODE="${1:-full}"
+FAIL=0
+
+run() {
+    echo "== $*" >&2
+    "$@"
+    local rc=$?
+    if [ "$rc" -eq 2 ]; then
+        echo "   (rc=2: ran but inconclusive — tolerated)" >&2
+    elif [ "$rc" -ne 0 ]; then
+        echo "   FAILED (rc=$rc)" >&2
+        FAIL=1
+    fi
+}
+
+if [ "$MODE" = "--smoke" ]; then
+    run python scripts/check_decode_hlo.py --small --platform cpu
+    run python scripts/check_fused_ce_hlo.py --small --platform cpu
+    run python scripts/check_packed_hlo.py --small --platform cpu
+else
+    run python scripts/check_decode_hlo.py --write-note
+    run python scripts/check_fused_ce_hlo.py --write-note
+    run python scripts/check_packed_hlo.py --write-note
+    # Hardware kernel shapes compile only through Mosaic — TPU backend only.
+    if python -c "import jax; raise SystemExit(0 if jax.default_backend() == 'tpu' else 1)" 2>/dev/null; then
+        run python scripts/tpu_kernel_check.py
+    else
+        echo "== skipping tpu_kernel_check.py (no TPU backend)" >&2
+    fi
+fi
+
+exit $FAIL

@@ -33,8 +33,16 @@ def run_model(model: str, root: str, split: str, out_path: str, epochs: int | No
     dataset = "amazon"
     if model == "sasrec":
         from genrec_tpu.trainers.sasrec_trainer import train
+
+        # Strict layout parity with the torch reference: one example per
+        # left-padded row, absolute positions (packing is a beyond-parity
+        # throughput feature; its exactness is pinned separately by
+        # tests/test_packed_parity.py).
+        extra = dict(pack_sequences=False)
     elif model == "hstu":
         from genrec_tpu.trainers.hstu_trainer import train
+
+        extra = dict(pack_sequences=False)  # see sasrec note
     elif model == "tiger":
         from genrec_tpu.trainers.tiger_trainer import train
 
@@ -50,6 +58,7 @@ def run_model(model: str, root: str, split: str, out_path: str, epochs: int | No
             # Protocol match: the reference TIGER trainer evaluates test
             # with FINAL-epoch weights (no best tracking).
             test_on_best=False,
+            pack_sequences=False,  # strict layout parity (see sasrec note)
         )
     elif model == "cobra":
         from genrec_tpu.data.amazon import load_sequences

@@ -1,0 +1,60 @@
+"""scripts/ci_checks.sh — the single entrypoint for the standalone static
+checks — plus a fast in-process run of the new packed-step HLO check.
+
+The full smoke invocation (all three checks through the shell entrypoint)
+is exercised once; check_decode_hlo additionally has its own in-process
+CI wrapper (tests/test_check_decode_hlo.py)."""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+
+
+def _load(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "scripts", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_packed_hlo_check_small(capsys):
+    mod = _load("check_packed_hlo")
+    rc = mod.main(["--small"])
+    verdict = json.loads(capsys.readouterr().out)
+    assert verdict["regex_bites"], (
+        "self-test failed: the explicit unpack no longer shows the re-pad "
+        "scatter, so the check is vacuous"
+    )
+    assert verdict["repad_scatter_hits"] == 0, verdict
+    assert verdict["compiled_one_program"]
+    assert rc == 0
+
+
+def test_fused_ce_hlo_check_small_is_inconclusive_not_failed(capsys):
+    """On the CPU backend Mosaic can never appear (interpret mode): the
+    check must report conclusive=false with rc=2, not a failure."""
+    mod = _load("check_fused_ce_hlo")
+    rc = mod.main(["--small"])
+    verdict = json.loads(capsys.readouterr().out)
+    assert verdict["conclusive"] is False
+    assert rc == 2
+
+
+def test_ci_checks_smoke_entrypoint():
+    """The consolidated entrypoint runs every smoke check and exits 0
+    (rc=2 inconclusives tolerated, real failures propagated)."""
+    proc = subprocess.run(
+        ["bash", os.path.join(REPO, "scripts", "ci_checks.sh"), "--smoke"],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    # One verdict JSON per check on stdout.
+    verdicts = [json.loads(l) for l in proc.stdout.splitlines() if l.strip()]
+    assert len(verdicts) == 3
