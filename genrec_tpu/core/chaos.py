@@ -1,0 +1,134 @@
+"""Fault-injection harness: kill, corrupt, and poison a live training run.
+
+Chaos engineering for the fault-tolerance layer (core.fault_tolerance):
+tests install a `ChaosPlan` and the training loops fire it at the exact
+step/epoch it names —
+
+- ``kill_at_step`` / ``kill_at_epoch``: deliver a real signal (SIGTERM by
+  default, the TPU preemption signal) to this process mid-run, exactly
+  like a spot reclaim. The PreemptionGuard latches it and the loop takes
+  its normal checkpoint-and-exit path — the chaos test then resumes and
+  asserts exact parity with an uninterrupted run.
+- ``nan_at_steps``: overwrite every FLOAT array of the host batch with
+  NaN before it ships to device (integer token batches pass through
+  untouched), driving the jitted non-finite guard in core.harness.
+- `truncate_checkpoint` / `garble_checkpoint`: damage an on-disk orbax
+  step dir the way a crashed writer or a bad disk would, driving the
+  integrity ladder in core.checkpoint.
+
+The hooks are no-ops (one module attribute read) unless a plan is
+installed, so they stay in the production loops permanently — the same
+code path that serves traffic is the one chaos-tested.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal
+from typing import Iterable, Iterator
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class ChaosPlan:
+    kill_at_step: int | None = None  # global step (post-increment) to signal at
+    kill_at_epoch: int | None = None  # epoch index to signal at (end of epoch)
+    kill_signal: int = signal.SIGTERM
+    nan_at_steps: frozenset[int] = frozenset()  # global steps to poison
+
+
+_ACTIVE: ChaosPlan | None = None
+
+
+class inject:
+    """Context manager installing a plan for the duration of a test."""
+
+    def __init__(self, plan: ChaosPlan):
+        self._plan = plan
+
+    def __enter__(self) -> ChaosPlan:
+        global _ACTIVE
+        self._prev = _ACTIVE
+        _ACTIVE = self._plan
+        return self._plan
+
+    def __exit__(self, *exc):
+        global _ACTIVE
+        _ACTIVE = self._prev
+        return False
+
+
+def active() -> ChaosPlan | None:
+    return _ACTIVE
+
+
+def maybe_kill(step: int | None = None, epoch: int | None = None) -> None:
+    """Fire the plan's signal when the loop reaches the named point.
+
+    The signal goes through the real OS delivery path (os.kill to self),
+    so whatever handler the trainer installed — the PreemptionGuard —
+    latches it exactly as it would a fleet preemption."""
+    plan = _ACTIVE
+    if plan is None:
+        return
+    if step is not None and plan.kill_at_step == step:
+        os.kill(os.getpid(), plan.kill_signal)
+    if epoch is not None and plan.kill_at_epoch == epoch:
+        os.kill(os.getpid(), plan.kill_signal)
+
+
+def poison_batches(iterator: Iterable, start_step: int) -> Iterator:
+    """Wrap a (batch, valid) iterator, NaN-ing float arrays at the plan's
+    steps. ``start_step`` is the global step BEFORE the first yielded
+    batch (batch i lands as global step start_step + 1 + i)."""
+    for i, (batch, valid) in enumerate(iterator):
+        plan = _ACTIVE
+        if plan is not None and (start_step + 1 + i) in plan.nan_at_steps:
+            batch = {
+                k: (np.full_like(v, np.nan)
+                    if np.issubdtype(np.asarray(v).dtype, np.floating) else v)
+                for k, v in batch.items()
+            }
+        yield batch, valid
+
+
+# -- on-disk checkpoint damage (test fixtures) ------------------------------
+
+
+def _step_files(ckpt_dir: str, step: int) -> list[str]:
+    root = os.path.join(ckpt_dir, str(step))
+    out = []
+    for base, _, files in os.walk(root):
+        out.extend(os.path.join(base, f) for f in files)
+    if not out:
+        raise FileNotFoundError(f"no files under checkpoint step dir {root}")
+    return sorted(out)
+
+
+def truncate_checkpoint(ckpt_dir: str, step: int, keep_bytes: int = 8) -> None:
+    """Truncate every array file of a step — a writer killed mid-flush."""
+    for f in _step_files(ckpt_dir, step):
+        if os.path.basename(f).startswith("_"):
+            continue  # keep metadata: truncation of DATA must be caught too
+        with open(f, "r+b") as fh:
+            fh.truncate(min(keep_bytes, os.path.getsize(f)))
+
+
+def garble_checkpoint(ckpt_dir: str, step: int, seed: int = 0) -> None:
+    """Overwrite array bytes with noise — silent media corruption."""
+    rng = np.random.default_rng(seed)
+    for f in _step_files(ckpt_dir, step):
+        if os.path.basename(f).startswith("_"):
+            continue
+        size = os.path.getsize(f)
+        with open(f, "r+b") as fh:
+            fh.write(rng.integers(0, 256, size=max(size, 1), dtype=np.uint8).tobytes())
+
+
+def drop_commit_marker(ckpt_dir: str, step: int) -> None:
+    """Delete the orbax commit marker — a save interrupted mid-commit."""
+    from genrec_tpu.core.checkpoint import _COMMIT_MARKER
+
+    os.remove(os.path.join(ckpt_dir, str(step), _COMMIT_MARKER))

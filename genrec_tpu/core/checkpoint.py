@@ -9,13 +9,30 @@ genrec_tpu.data.sem_ids.
 
 from __future__ import annotations
 
+import json
+import logging
 import os
+import shutil
 from typing import Any
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 import orbax.checkpoint as ocp
+
+logger = logging.getLogger("genrec_tpu")
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint step failed integrity validation (missing commit
+    marker, unreadable/garbled arrays, or non-finite leaves)."""
+
+
+class CheckpointMismatchError(RuntimeError):
+    """A checkpoint step is READABLE but its tree structure does not
+    match the live state — e.g. a record written by an older code
+    version. The ladder skips these (they are not damaged; a rollback
+    could still use them) instead of quarantining."""
 
 
 def _abs(path: str) -> str:
@@ -101,20 +118,58 @@ def load_params(path: str, like: Any | None = None) -> Any:
     return ckptr.restore(_abs(path))
 
 
+def _refuse_resume_below_stale_steps(
+    ckpt: "CheckpointManager", resumed_step: int | None
+) -> None:
+    """Readable foreign records retained ABOVE the restore point (or with
+    nothing restorable at all) are a trap: orbax silently refuses saves
+    at steps <= the stale latest (`should_save`), so the run would
+    checkpoint NOTHING while logging success — every relaunch restores
+    the same old step and the work loops forever. Fail loudly instead.
+
+    Foreign records BELOW the restore point are harmless (future saves
+    key above them) and stay on disk for rollbacks."""
+    stale = [
+        s for s in ckpt.all_steps()
+        if resumed_step is None or s > resumed_step
+    ]
+    if stale:
+        at = (
+            "start fresh on top of them"
+            if resumed_step is None
+            else f"resume below them (at step {resumed_step})"
+        )
+        raise RuntimeError(
+            f"checkpoint directory {ckpt.directory} holds records this run "
+            f"cannot resume (steps {stale}: written by a different code "
+            f"version or trainer). Refusing to {at} — orbax would silently "
+            "drop every save keyed below the stale latest step. Move or "
+            "delete those step dirs (the records are intact) and relaunch."
+        )
+
+
 def maybe_resume(ckpt: "CheckpointManager | None", state, replicate_fn=None):
-    """Shared resume logic for every trainer.
+    """Shared resume logic for the epoch-granularity trainers.
 
     Checkpoints are keyed by EPOCH. Returns
     ``(state, start_epoch, global_step)`` — fresh-start values when there
-    is nothing to restore. ``replicate_fn`` re-places the restored host
-    arrays on the mesh.
+    is nothing (valid) to restore. ``replicate_fn`` re-places the
+    restored host arrays on the mesh.
+
+    Restores go through the integrity ladder
+    (`CheckpointManager.restore_latest_valid`): a truncated/garbled
+    latest step is quarantined with a warning and the previous retained
+    step is used instead of crashing the resume.
     """
     if ckpt is None or ckpt.latest_step() is None:
         return state, 0, 0
-    restored = ckpt.restore(state)
+    restored, step = ckpt.restore_latest_valid(state)
+    _refuse_resume_below_stale_steps(ckpt, step)
+    if restored is None:
+        return state, 0, 0
     if replicate_fn is not None:
         restored = replicate_fn(restored)
-    start_epoch = ckpt.latest_step() + 1
+    start_epoch = step + 1
     return restored, start_epoch, int(restored.step)
 
 
@@ -133,18 +188,25 @@ class BestTracker:
         self.metric = metric
         self.value = -1.0
         if self.meta and os.path.exists(self.meta):
-            import json
-
-            with open(self.meta) as f:
-                self.value = float(json.load(f)["value"])
+            try:
+                with open(self.meta) as f:
+                    self.value = float(json.load(f)["value"])
+            except (ValueError, KeyError, TypeError, OSError) as e:
+                # A sidecar truncated by a crash mid-write (pre-atomic
+                # format) must not break resume: forget the best-so-far
+                # value — the next improvement re-saves model + sidecar —
+                # instead of crashing every future run.
+                logger.warning(
+                    f"corrupt best-model sidecar {self.meta} ({e}): "
+                    "resetting best-metric tracking"
+                )
+                self.value = -1.0
 
     def update(self, value: float, params) -> bool:
         if value <= self.value:
             return False
         self.value = value
         if self.dir:
-            import json
-
             # Synchronous on purpose: the sidecar must only ever describe
             # a DURABLE best_model dir. An async write here would let a
             # crash leave value=X on disk with no params — a resumed run
@@ -152,8 +214,14 @@ class BestTracker:
             # is lost for good. Best-improvements are rare; the epoch-level
             # CheckpointManager saves are the async path.
             save_params(self.dir, params)
-            with open(self.meta, "w") as f:
+            # Atomic replace: a crash mid-write must never leave a
+            # truncated json that breaks the next resume's float(...).
+            tmp = self.meta + ".tmp"
+            with open(tmp, "w") as f:
                 json.dump({"metric": self.metric, "value": value}, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.meta)
         return True
 
     def best_params(self, like):
@@ -164,24 +232,56 @@ class BestTracker:
         return None
 
 
+# Orbax finalizes a step by renaming its tmp dir and then writing this
+# marker (orbax 0.5+). A step dir without it was interrupted mid-commit.
+_COMMIT_MARKER = "_CHECKPOINT_METADATA"
+
+
 class CheckpointManager:
     """Step-numbered training checkpoints with auto-resume.
 
     Covers (and exceeds — the reference has no auto-resume discovery) the
-    `resume_from_checkpoint` flow of tiger_trainer.py:248-256.
+    `resume_from_checkpoint` flow of tiger_trainer.py:248-256. Restores
+    can run through an INTEGRITY LADDER (`restore_latest_valid`): newest
+    retained step first, validated as (1) orbax commit marker present,
+    (2) arrays readable + tree structure matches the live state, (3) every
+    float leaf finite — a step failing any rung is quarantined to
+    ``<dir>/quarantine/`` (kept for post-mortem, excluded from discovery)
+    and the ladder falls through to the previous retained step.
     """
 
     def __init__(self, directory: str, max_to_keep: int = 3):
+        self.directory = _abs(directory)
         self._mgr = ocp.CheckpointManager(
-            _abs(directory),
+            self.directory,
             options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep),
         )
 
     def save(self, step: int, state: Any) -> None:
-        self._mgr.save(step, args=ocp.args.StandardSave(to_savable(state)))
+        saved = self._mgr.save(step, args=ocp.args.StandardSave(to_savable(state)))
+        # orbax's should_save REFUSES saves keyed <= the retained latest
+        # step, returning False with no error. Re-saving the exact latest
+        # key is benign (identical record, e.g. a preemption landing on a
+        # just-written epoch boundary); anything else silently dropping a
+        # checkpoint is the worst failure mode this layer exists to
+        # prevent — surface it.
+        if not saved and step != self._mgr.latest_step():
+            raise RuntimeError(
+                f"orbax refused to save checkpoint step {step} (latest "
+                f"retained step is {self._mgr.latest_step()}): stale "
+                "higher-numbered records in the directory? The save did "
+                "NOT happen."
+            )
 
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()
+
+    def all_steps(self) -> list[int]:
+        return sorted(self._mgr.all_steps())
+
+    def wait(self) -> None:
+        """Join any in-flight async save (durability barrier)."""
+        self._mgr.wait_until_finished()
 
     def restore(self, state_like: Any, step: int | None = None) -> Any:
         step = step if step is not None else self._mgr.latest_step()
@@ -191,6 +291,106 @@ class CheckpointManager:
             step, args=ocp.args.StandardRestore(to_savable(state_like))
         )
         return from_savable(restored, state_like)
+
+    # -- integrity ladder ---------------------------------------------------
+
+    def validate_and_restore(self, state_like: Any, step: int) -> Any:
+        """One ladder rung: restore ``step`` or raise
+        CheckpointCorruptError (damaged) / CheckpointMismatchError
+        (readable but structurally foreign, e.g. written pre-upgrade).
+
+        The finite-leaves rung scans every float leaf once on host —
+        O(checkpoint size) reads, which the restore already paid for.
+        """
+        marker = os.path.join(self.directory, str(step), _COMMIT_MARKER)
+        if not os.path.exists(marker):
+            raise CheckpointCorruptError(
+                f"step {step}: missing orbax commit marker {_COMMIT_MARKER} "
+                "(interrupted mid-commit?)"
+            )
+        try:
+            # Raises on unreadable/truncated arrays and on any mismatch
+            # between the stored tree and the live state's structure.
+            restored = self.restore(state_like, step)
+        except Exception as e:
+            # Disambiguate "damaged bytes" from "different layout": a
+            # METADATA read (tree structure only, no array bytes — cheap
+            # even for multi-GB records) succeeding means the record is
+            # intact, just not ours to restore (old format / other
+            # trainer). Quarantining it would destroy a checkpoint a
+            # rollback could still use.
+            ckptr = ocp.StandardCheckpointer()
+            try:
+                ckptr.metadata(os.path.join(self.directory, str(step), "default"))
+            except Exception:
+                raise CheckpointCorruptError(
+                    f"step {step}: unreadable ({e})"
+                ) from e
+            finally:
+                ckptr.close()
+            raise CheckpointMismatchError(
+                f"step {step}: readable but tree structure does not match "
+                f"the live state ({e})"
+            ) from e
+        for path, leaf in jax.tree_util.tree_leaves_with_path(
+            to_savable(restored)
+        ):
+            arr = np.asarray(leaf)
+            # jnp.issubdtype also covers the ml_dtypes floats (bf16 params)
+            # that numpy's own hierarchy does not classify as floating.
+            if jnp.issubdtype(arr.dtype, jnp.floating) and not np.all(
+                np.isfinite(arr)
+            ):
+                raise CheckpointCorruptError(
+                    f"step {step}: non-finite leaf "
+                    f"{jax.tree_util.keystr(path)}"
+                )
+        return restored
+
+    def quarantine(self, step: int) -> None:
+        """Move a corrupt step dir out of discovery, keeping it on disk."""
+        src = os.path.join(self.directory, str(step))
+        qdir = os.path.join(self.directory, "quarantine")
+        os.makedirs(qdir, exist_ok=True)
+        dst = os.path.join(qdir, str(step))
+        n = 0
+        while os.path.exists(dst):
+            n += 1
+            dst = os.path.join(qdir, f"{step}.{n}")
+        if os.path.exists(src):
+            shutil.move(src, dst)
+        self._mgr.reload()  # drop the manager's cached step listing
+
+    def restore_latest_valid(
+        self, state_like: Any, extra_validate=None
+    ) -> tuple[Any, int] | tuple[None, None]:
+        """Walk retained steps newest-first; quarantine every CORRUPT one
+        (structure mismatches are skipped in place — see
+        CheckpointMismatchError); return ``(restored, step)`` for the
+        first valid, or (None, None) when nothing survives.
+
+        ``extra_validate(restored, step)`` lets the caller add a rung
+        (e.g. the resume-point format tag) — raise
+        CheckpointMismatchError from it to skip that step in place and
+        keep walking."""
+        for step in sorted(self._mgr.all_steps(), reverse=True):
+            try:
+                restored = self.validate_and_restore(state_like, step)
+                if extra_validate is not None:
+                    extra_validate(restored, step)
+                return restored, step
+            except CheckpointCorruptError as e:
+                logger.warning(
+                    f"checkpoint integrity: {e} — quarantining and falling "
+                    "back to the previous retained step"
+                )
+                self.quarantine(step)
+            except CheckpointMismatchError as e:
+                logger.warning(
+                    f"checkpoint integrity: {e} — leaving it on disk and "
+                    "falling back to the previous retained step"
+                )
+        return None, None
 
     def close(self) -> None:
         self._mgr.close()

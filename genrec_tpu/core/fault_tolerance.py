@@ -1,0 +1,263 @@
+"""Step-granular fault tolerance: interrupt anywhere, resume exactly.
+
+Three pieces, composed by `trainers.packed_loop.PackedTrainLoop`:
+
+1. **Resume points** — one checkpoint record keyed by GLOBAL STEP holding
+   the full TrainState *plus the serialized data-iterator state* (epoch,
+   next batch index, data seed, prefetch depth). Because the whole input
+   pipeline is deterministic in ``(seed, epoch)`` — the per-epoch packer
+   permutation (`data.batching.pack_examples(seed=(seed, epoch))`), the
+   shuffle (`batch_iterator(seed=..., epoch=...)`), and the prefetcher
+   (a pure read-ahead whose unconsumed batches are regenerated on
+   resume) — the cursor (epoch, next_batch) pins the exact next batch,
+   and a resumed run replays nothing and skips nothing: per-step losses
+   and final params match an uninterrupted run bit-for-bit on a fixed
+   backend (<=1e-5 fp32 across backends).
+
+2. **Integrity-ladder restore** — `resume_exact` walks retained resume
+   points newest-first through `CheckpointManager.restore_latest_valid`,
+   quarantining truncated/garbled/structure-mismatched steps instead of
+   crashing.
+
+3. **`NonFiniteMonitor`** — host-side policy for the jitted non-finite
+   guard in `core.harness.make_train_step`: dump the offending batch to
+   disk with step metadata, abort after N CONSECUTIVE skipped steps (the
+   streak itself lives on device in ``TrainState.nonfinite_count``, so
+   it is checkpointed and survives resume). The check is deferred by one
+   step so reading the flag never stalls async dispatch: step N's flag is
+   read only after step N+1 has been dispatched.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Callable
+
+import numpy as np
+
+from genrec_tpu.core.checkpoint import (
+    CheckpointManager,
+    CheckpointMismatchError,
+    _refuse_resume_below_stale_steps,
+)
+
+# Version tag for the resume-point record; bump on layout change. The
+# check runs as a rung of the integrity ladder: a record with a foreign
+# tag is skipped IN PLACE (left on disk for the code version that wrote
+# it). Foreign records BELOW the chosen restore point are harmless; if
+# any remain ABOVE it, resume refuses loudly — orbax silently drops
+# saves keyed below its retained latest, so continuing would checkpoint
+# nothing (move the newer step dirs aside to roll back).
+_FORMAT = 1
+
+
+@dataclasses.dataclass
+class ResumePoint:
+    """Deserialized cursor: continue ``epoch`` at batch ``next_batch``."""
+
+    state: Any
+    epoch: int
+    next_batch: int
+    global_step: int
+
+
+def _cursor_arrays(
+    epoch: int, next_batch: int, global_step: int, data_seed: int,
+    prefetch_depth: int,
+) -> dict[str, np.ndarray]:
+    return {
+        "format": np.asarray(_FORMAT, np.int32),
+        "epoch": np.asarray(epoch, np.int32),
+        # Batches CONSUMED this epoch == index of the next batch to run.
+        "next_batch": np.asarray(next_batch, np.int32),
+        # Loop-iteration counter (can exceed state.step when the
+        # non-finite guard skipped updates).
+        "global_step": np.asarray(global_step, np.int64),
+        # The base data seed; (data_seed, epoch) derives the packer
+        # permutation and the shuffle. Stored to detect a resume launched
+        # with a different seed (which would silently break exactness).
+        "data_seed": np.asarray(data_seed, np.int64),
+        # Unconsumed read-ahead at save time. Always 0 in the record: the
+        # prefetcher is stateless read-ahead, so those batches are simply
+        # regenerated — recorded for format completeness/forward-compat.
+        "prefetch_depth": np.asarray(prefetch_depth, np.int32),
+    }
+
+
+def _composite_like(state_like: Any) -> dict[str, Any]:
+    return {"state": state_like, "cursor": _cursor_arrays(0, 0, 0, 0, 0)}
+
+
+def save_resume_point(
+    ckpt: CheckpointManager,
+    state: Any,
+    *,
+    epoch: int,
+    next_batch: int,
+    global_step: int,
+    data_seed: int,
+    wait: bool = False,
+) -> None:
+    """Write a step-keyed resume point (TrainState + iterator cursor).
+
+    Periodic saves stay async (orbax snapshots to host and commits on a
+    background thread); a preemption save passes ``wait=True`` so the
+    record is durable before the process exits the grace window."""
+    ckpt.save(
+        global_step,
+        {
+            "state": state,
+            "cursor": _cursor_arrays(epoch, next_batch, global_step, data_seed, 0),
+        },
+    )
+    if wait:
+        ckpt.wait()
+
+
+def resume_exact(
+    ckpt: CheckpointManager | None,
+    state_like: Any,
+    place_fn: Callable[[Any], Any] | None = None,
+    *,
+    data_seed: int,
+    logger=None,
+) -> ResumePoint | None:
+    """Restore the newest VALID resume point, or None for a fresh start.
+
+    Corrupt steps are quarantined by the integrity ladder. A stored
+    data seed differing from the configured one is an error: the shuffle
+    and packer permutations would diverge and the 'exact' resume would
+    silently replay different data."""
+    if ckpt is None or ckpt.latest_step() is None:
+        return None
+
+    def check_format(restored, step):
+        got = int(restored["cursor"]["format"])
+        if got != _FORMAT:
+            raise CheckpointMismatchError(
+                f"step {step}: resume-point format {got} != {_FORMAT} "
+                "(written by a different code version)"
+            )
+
+    restored, step = ckpt.restore_latest_valid(
+        _composite_like(state_like), extra_validate=check_format
+    )
+    # Foreign records retained ABOVE the restore point would silently
+    # swallow every future save (orbax refuses keys below its latest):
+    # refuse loudly before burning compute on an unsaveable run.
+    _refuse_resume_below_stale_steps(ckpt, step)
+    if restored is None:
+        if logger is not None:
+            logger.warning("no valid resume point survived the integrity ladder")
+        return None
+    cursor = restored["cursor"]
+    if int(cursor["data_seed"]) != int(data_seed):
+        raise ValueError(
+            f"resume point was written with data seed {int(cursor['data_seed'])} "
+            f"but this run uses {int(data_seed)}: refusing an inexact resume"
+        )
+    state = restored["state"]
+    if place_fn is not None:
+        state = place_fn(state)
+    point = ResumePoint(
+        state=state,
+        epoch=int(cursor["epoch"]),
+        next_batch=int(cursor["next_batch"]),
+        global_step=int(cursor["global_step"]),
+    )
+    if logger is not None:
+        logger.info(
+            f"resumed at epoch {point.epoch} batch {point.next_batch} "
+            f"(global step {point.global_step}, checkpoint step {step})"
+        )
+    return point
+
+
+class NonFiniteLossError(RuntimeError):
+    """Raised after max_consecutive non-finite steps: the run is diverging
+    structurally, not hitting a one-off bad batch."""
+
+
+class NonFiniteMonitor:
+    """Host policy for the jitted non-finite guard (core.harness).
+
+    `observe` is called once per step with the step's metrics and the
+    on-device batch; it CHECKS the PREVIOUS step's flag (deferred by one
+    step, so the device scalar it reads is already computed and the read
+    never stalls dispatch of the current step). On a flagged step the
+    batch is dumped to ``<dump_dir>/nonfinite_step<g>.npz`` with step
+    metadata, and once the device-side consecutive streak
+    (``metrics["nonfinite_count"]``) reaches ``max_consecutive`` the run
+    aborts with `NonFiniteLossError`. Call `flush()` at epoch end /
+    before a preemption save to check the last in-flight step."""
+
+    def __init__(self, dump_dir: str | None, max_consecutive: int = 3,
+                 logger=None):
+        self.dump_dir = dump_dir
+        self.max_consecutive = max_consecutive
+        self.logger = logger
+        self.dumped: list[str] = []
+        self._pending: tuple[int, int, dict, Any] | None = None
+
+    @classmethod
+    def for_run(cls, save_dir_root: str | None, logger=None,
+                max_consecutive: int = 3) -> "NonFiniteMonitor":
+        """Monitor with the standard dump location for a trainer run
+        (``<save_dir_root>/nonfinite/``; no dumps without a save dir)."""
+        return cls(
+            os.path.join(save_dir_root, "nonfinite") if save_dir_root else None,
+            max_consecutive, logger,
+        )
+
+    def observe(self, global_step: int, epoch: int, metrics: dict, batch) -> None:
+        prev, self._pending = self._pending, (global_step, epoch, metrics, batch)
+        if prev is not None:
+            self._check(*prev)
+
+    def flush(self) -> None:
+        prev, self._pending = self._pending, None
+        if prev is not None:
+            self._check(*prev)
+
+    def _check(self, global_step: int, epoch: int, metrics: dict, batch) -> None:
+        if "nonfinite" not in metrics or not float(metrics["nonfinite"]):
+            return
+        streak = int(float(metrics.get("nonfinite_count", 1.0)))
+        path = self._dump(global_step, epoch, metrics, batch)
+        if self.logger is not None:
+            self.logger.warning(
+                f"non-finite loss/grad at step {global_step} (epoch {epoch}): "
+                f"optimizer update skipped (streak {streak}/"
+                f"{self.max_consecutive})"
+                + (f", batch dumped to {path}" if path else "")
+            )
+        if streak >= self.max_consecutive:
+            raise NonFiniteLossError(
+                f"{streak} consecutive non-finite steps (last: step "
+                f"{global_step}, epoch {epoch})"
+                + (f"; offending batches dumped under {self.dump_dir}" if path else "")
+            )
+
+    def _dump(self, global_step: int, epoch: int, metrics: dict, batch) -> str | None:
+        if self.dump_dir is None:
+            return None
+        os.makedirs(self.dump_dir, exist_ok=True)
+        payload: dict[str, np.ndarray] = {
+            "global_step": np.asarray(global_step, np.int64),
+            "epoch": np.asarray(epoch, np.int64),
+            "loss": np.asarray(float(metrics["loss"]), np.float64),
+            "grad_norm": np.asarray(float(metrics["grad_norm"]), np.float64),
+        }
+        for key, leaf in batch.items():
+            try:
+                payload[f"batch/{key}"] = np.asarray(leaf)
+            except Exception:
+                # Multi-host: a non-fully-addressable shard can't be
+                # materialized here; the metadata alone still localizes
+                # the bad step for offline repro.
+                continue
+        path = os.path.join(self.dump_dir, f"nonfinite_step{global_step}.npz")
+        np.savez(path, **payload)
+        self.dumped.append(path)
+        return path

@@ -32,6 +32,7 @@ def make_train_step(
     optimizer: optax.GradientTransformation,
     accum_steps: int = 1,
     clip_norm: float | None = 1.0,
+    skip_nonfinite: bool = True,
 ):
     """Build `step(state, batch) -> (state, metrics)`, ready to jit.
 
@@ -39,6 +40,18 @@ def make_train_step(
     ``accum_steps`` microbatches scanned sequentially — same semantics as
     `Accelerator(gradient_accumulation_steps=...)` but inside one compiled
     step, so the optimizer/clip always sees the averaged full-batch grad.
+
+    ``skip_nonfinite`` (default on) is the jitted non-finite step guard:
+    when the batch loss or the (pre-clip) gradient norm is NaN/Inf, the
+    optimizer update is dropped — params, opt_state and ``state.step``
+    pass through UNCHANGED (the per-step RNG still advances, so a skipped
+    step perturbs nothing downstream), ``state.nonfinite_count`` counts
+    the consecutive-skip streak (reset to 0 by any finite step), and the
+    metrics gain ``nonfinite`` (0/1 flag) + ``nonfinite_count``. Skipping
+    happens entirely on device via `jnp.where` — no host sync, no branch,
+    identical numerics on the finite path. Host-side policy (dumping the
+    offending batch, aborting after N consecutive skips) lives in
+    `core.fault_tolerance.NonFiniteMonitor`.
     """
 
     def grads_of(params, batch, rng):
@@ -91,10 +104,32 @@ def make_train_step(
 
         updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
-        new_state = state.replace(
-            step=state.step + 1, params=params, opt_state=opt_state, rng=rng
-        )
         metrics = {"loss": loss, "grad_norm": gnorm, **aux}
+        if skip_nonfinite:
+            # NaN/Inf batch: keep the old params/opt_state/step (the NaN
+            # update would poison Adam's moments even at lr=0), bump the
+            # consecutive-skip streak. `where` with a scalar predicate
+            # selects whole buffers — on the finite path this is the
+            # identity, bit-for-bit.
+            ok = jnp.isfinite(loss) & jnp.isfinite(gnorm)
+            keep = lambda new, old: jax.tree_util.tree_map(
+                lambda n, o: jnp.where(ok, n, o), new, old
+            )
+            params = keep(params, state.params)
+            opt_state = keep(opt_state, state.opt_state)
+            step = state.step + jnp.where(ok, 1, 0).astype(state.step.dtype)
+            nonfinite_count = jnp.where(ok, 0, state.nonfinite_count + 1).astype(
+                state.nonfinite_count.dtype
+            )
+            metrics["nonfinite"] = (~ok).astype(jnp.float32)
+            metrics["nonfinite_count"] = nonfinite_count.astype(jnp.float32)
+        else:
+            step = state.step + 1
+            nonfinite_count = state.nonfinite_count
+        new_state = state.replace(
+            step=step, params=params, opt_state=opt_state, rng=rng,
+            nonfinite_count=nonfinite_count,
+        )
         return new_state, metrics
 
     return step

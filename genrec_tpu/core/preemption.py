@@ -8,19 +8,27 @@ window. This guard turns that signal into a final checkpoint + clean
 exit, so `resume_from_checkpoint` continues from the preempted step
 instead of the last periodic save.
 
-Usage (every trainer):
+Usage (packed trainers — STEP granularity via trainers.packed_loop):
+
+    guard = PreemptionGuard(logger)
+    loop = PackedTrainLoop(..., guard=guard, ckpt=ckpt)
+    # run_epoch polls guard.fired after every optimizer step; on fire it
+    # writes a step-granular resume point (TrainState + data-iterator
+    # cursor, core.fault_tolerance.save_resume_point) and returns
+    # preempted=True — resume continues at the exact next batch.
+
+Usage (epoch-granularity trainers — cobra/lcrec/notellm/rqvae):
 
     guard = PreemptionGuard(logger)
     for epoch ...:
+        if guard.fired:
+            ckpt.save(epoch - 1, state)  # durable: manager save + wait
+            return ...                   # clean exit -> scheduler restarts
         for batch ...:
             ...
-        if guard.fired:
-            ckpt.save(epoch, state)   # durable: manager save + wait
-            return ...                # clean exit -> scheduler restarts
 
-The flag is checked at epoch granularity by default because steps are
-milliseconds and the grace window is tens of seconds; `check_every`
-tighter loops can poll `guard.fired` per step.
+Polling `fired` is a lock-free Event read — cheap enough for per-step
+checks even at millisecond step times.
 """
 
 from __future__ import annotations
@@ -32,12 +40,23 @@ import threading
 class PreemptionGuard:
     """Latches the first SIGTERM/SIGINT; restores prior handlers on close.
 
+    Both signals latch by default: TPU fleets deliver SIGTERM for
+    maintenance/spot reclaims, and an operator ^C (SIGINT) deserves the
+    same checkpoint-then-exit instead of a stack trace mid-write.
+
+    The latch is ONE-SHOT: the first signal restores the previous
+    handlers immediately, so a second ^C / SIGTERM falls through to them
+    (default: KeyboardInterrupt / terminate) — a run hung between poll
+    points, or a guard left installed by an aborted run, can always be
+    escalated without SIGKILL. Orbax commits are atomic (tmp + rename),
+    so an escalated kill mid-save never leaves a committed corrupt step.
+
     Installs only in the main thread (signal.signal raises elsewhere —
     e.g. when a trainer runs inside a test worker thread); off the main
     thread the guard is inert and `fired` stays False.
     """
 
-    def __init__(self, logger=None, signals=(signal.SIGTERM,)):
+    def __init__(self, logger=None, signals=(signal.SIGTERM, signal.SIGINT)):
         self._fired = threading.Event()
         self._logger = logger
         self._prev = {}
@@ -48,10 +67,12 @@ class PreemptionGuard:
     def _handle(self, signum, frame):
         if self._logger is not None:
             self._logger.warning(
-                f"signal {signal.Signals(signum).name}: finishing the "
-                "current epoch, checkpointing, then exiting cleanly"
+                f"signal {signal.Signals(signum).name}: checkpointing at "
+                "the next poll point, then exiting cleanly (send again to "
+                "force the previous handler)"
             )
         self._fired.set()
+        self.close()  # one-shot: next signal falls through
 
     @property
     def fired(self) -> bool:

@@ -37,10 +37,19 @@ def fast_step_rng(rng: jax.Array) -> jax.Array:
 
 
 class TrainState(struct.PyTreeNode):
+    """``step`` counts APPLIED optimizer updates (the non-finite guard in
+    core.harness skips the update — and the step increment — on NaN/Inf
+    batches, so LR schedules keyed on ``step`` never advance past skipped
+    work). ``nonfinite_count`` is the running streak of CONSECUTIVE
+    skipped steps; it lives in the state pytree so it is checkpointed and
+    a resumed run keeps counting toward the abort threshold instead of
+    resetting it."""
+
     step: jax.Array
     params: Any
     opt_state: Any
     rng: jax.Array
+    nonfinite_count: jax.Array
 
     @classmethod
     def create(cls, params, optimizer: optax.GradientTransformation, rng: jax.Array):
@@ -51,4 +60,5 @@ class TrainState(struct.PyTreeNode):
             params=params,
             opt_state=optimizer.init(params),
             rng=rng,
+            nonfinite_count=jnp.zeros((), jnp.int32),
         )

@@ -18,6 +18,7 @@ import gzip
 import json
 import logging
 import os
+import urllib.error
 import urllib.request
 
 import numpy as np
@@ -63,18 +64,54 @@ def parse_gzip_json(path: str):
                     continue
 
 
-def _maybe_download(url: str, dest: str) -> None:
+def _maybe_download(
+    url: str, dest: str, *, attempts: int = 3, backoff: float = 2.0,
+    sleep=None,
+) -> None:
+    """Download with bounded retry + exponential backoff.
+
+    Writes to ``<dest>.part`` and renames into place only on success, so
+    a transient failure can never leave a truncated ``dest`` that poisons
+    the next attempt's exists-check; the partial file itself is removed
+    after the final failure. ``sleep`` is injectable for tests."""
     if os.path.exists(dest):
         return
     os.makedirs(os.path.dirname(dest), exist_ok=True)
-    logger.info("downloading %s -> %s", url, dest)
-    try:
-        urllib.request.urlretrieve(url, dest)
-    except Exception as e:
-        raise FileNotFoundError(
-            f"Could not download {url} ({e}). This environment may have no "
-            f"network egress — place the file manually at {dest}."
-        ) from e
+    if sleep is None:
+        import time
+
+        sleep = time.sleep
+    part = dest + ".part"
+    last_err: Exception | None = None
+    for attempt in range(attempts):
+        if attempt:
+            delay = backoff * (2 ** (attempt - 1))
+            logger.warning(
+                "download attempt %d/%d for %s failed (%s); retrying in %.1fs",
+                attempt, attempts, url, last_err, delay,
+            )
+            sleep(delay)
+        logger.info("downloading %s -> %s", url, dest)
+        try:
+            urllib.request.urlretrieve(url, part)
+            os.replace(part, dest)
+            return
+        except urllib.error.HTTPError as e:
+            last_err = e
+            if os.path.exists(part):
+                os.remove(part)
+            if 400 <= e.code < 500:
+                # Deterministic client error (bad split name, retired
+                # URL): retrying cannot help — fail immediately.
+                break
+        except Exception as e:
+            last_err = e
+            if os.path.exists(part):
+                os.remove(part)
+    raise FileNotFoundError(
+        f"Could not download {url} ({last_err}). This environment may have "
+        f"no network egress — place the file manually at {dest}."
+    ) from last_err
 
 
 def load_sequences(

@@ -342,17 +342,23 @@ def batch_iterator(
     seed: int = 0,
     drop_last: bool = False,
     epoch: int = 0,
+    start_batch: int = 0,
 ) -> Iterator[tuple[dict, np.ndarray]]:
     """Yield (batch_dict, valid_mask) of fixed shape (batch_size, ...).
 
     Shuffling is deterministic in (seed, epoch) so every data-parallel
     process draws the same permutation and shards it consistently.
+
+    ``start_batch`` skips the first N batches WITHOUT gathering them —
+    the mid-epoch resume cursor (core.fault_tolerance): the permutation
+    is drawn in full, so batch i of a resumed epoch is bit-identical to
+    batch i of the uninterrupted one.
     """
     n = next(iter(arrays.values())).shape[0]
     idx = np.arange(n)
     if shuffle:
         idx = np.random.default_rng((seed, epoch)).permutation(n)
-    for start in range(0, n, batch_size):
+    for start in range(start_batch * batch_size, n, batch_size):
         sel = idx[start : start + batch_size]
         if len(sel) < batch_size and drop_last:
             return

@@ -23,6 +23,7 @@ import numpy as np
 import optax
 
 from genrec_tpu import configlib
+from genrec_tpu.core import chaos
 from genrec_tpu.core.harness import make_train_step
 from genrec_tpu.core.logging import Tracker, setup_logger
 from genrec_tpu.core.profiling import ProfileWindow, StepTimer, log_epoch_perf
@@ -186,6 +187,12 @@ def train(
     from genrec_tpu.core.preemption import PreemptionGuard
 
     guard = PreemptionGuard(logger)
+    from genrec_tpu.core.fault_tolerance import NonFiniteMonitor
+
+    # Host policy for the jitted non-finite guard (core.harness): dump
+    # the offending batch, abort after N consecutive skips — without
+    # this, a structurally diverging run would silently freeze.
+    nonfinite = NonFiniteMonitor.for_run(save_dir_root, logger)
     for epoch in range(start_epoch, epochs):
         if guard.fired:
             # Preempted (SIGTERM grace window): persist the last
@@ -207,6 +214,7 @@ def train(
             mesh,
         ):
             state, m = step_fn(state, sharded)
+            nonfinite.observe(global_step + 1, epoch, m, sharded)
             epoch_loss = m["loss"] if epoch_loss is None else epoch_loss + m["loss"]
             timer.tick()
             n_batches += 1
@@ -214,7 +222,11 @@ def train(
             prof.tick(global_step)
             if global_step % wandb_log_interval == 0:
                 tracker.log({"global_step": global_step, "train/loss": float(m["loss"])})
+        nonfinite.flush()
         log_epoch_perf(logger, tracker, epoch, epoch_loss, n_batches, timer)
+        # Fault-injection hook (core.chaos): lets tests deliver a real
+        # SIGTERM at a chosen epoch; no-op outside a chaos plan.
+        chaos.maybe_kill(epoch=epoch)
 
         if ckpt is not None and (epoch + 1) % save_every_epoch == 0:
             ckpt.save(epoch, state)

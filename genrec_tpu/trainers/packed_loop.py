@@ -1,0 +1,268 @@
+"""Shared packed-trainer loop: one implementation of the plumbing that was
+triplicated (with drift) across the sasrec/hstu/tiger trainers —
+
+- the per-epoch repack closure (epoch-seeded `pack_examples` so example
+  co-location re-mixes like the padded layout's per-epoch permutation);
+- device-scalar epoch loss / real-token accumulation (float() only at
+  logging boundaries, so the host never blocks async dispatch);
+- the examples-per-step timer math (seq/s keeps meaning EXAMPLES when a
+  packed row holds several) and the occupancy epilogue;
+- wandb-interval step logging and ProfileWindow ticks —
+
+plus the STEP-GRANULAR fault tolerance this PR adds, which lands here
+once instead of three times:
+
+- the PreemptionGuard is polled after every optimizer step; on fire, a
+  resume point (full TrainState + data-iterator cursor,
+  `core.fault_tolerance.save_resume_point`) is written durably and the
+  epoch returns ``preempted=True`` — a resumed run continues at the
+  exact next batch with identical losses/grads;
+- `core.chaos` hooks (signal injection, NaN batch poisoning) run inside
+  the same loop that serves production, so chaos tests exercise the real
+  code path;
+- the `NonFiniteMonitor` consumes the jitted non-finite guard's metrics
+  (one step deferred — no dispatch stall), dumps offending batches, and
+  aborts after N consecutive skipped steps.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax.numpy as jnp
+
+from genrec_tpu.core import chaos
+from genrec_tpu.core.fault_tolerance import (
+    NonFiniteMonitor,
+    resume_exact,
+    save_resume_point,
+)
+from genrec_tpu.core.logging import log_occupancy
+from genrec_tpu.core.profiling import StepTimer, log_epoch_perf
+from genrec_tpu.data.batching import batch_iterator, prefetch_to_device
+
+
+@dataclasses.dataclass
+class EpochResult:
+    state: Any
+    global_step: int
+    preempted: bool
+    n_batches: int
+
+
+class PackedTrainLoop:
+    """Owns epoch execution for one trainer; the trainer keeps ownership
+    of eval, best-model tracking, and periodic checkpoint CADENCE.
+
+    ``repack(epoch) -> (arrays, PackingReport)`` is called lazily per
+    epoch when ``pack_sequences``; otherwise ``train_arrays`` is the
+    fixed padded layout. ``rows_per_step`` is the batch rows consumed per
+    optimizer step (batch_size, times grad-accum for TIGER);
+    ``tokens_scale`` rescales the step's mean ``real_tokens`` metric back
+    to whole-step tokens under accumulation.
+    """
+
+    def __init__(
+        self,
+        *,
+        logger,
+        tracker,
+        prof,
+        mesh,
+        guard=None,
+        ckpt=None,
+        rows_per_step: int,
+        row_len: int,
+        seed: int,
+        pack_sequences: bool,
+        repack: Callable[[int], tuple[dict, Any]] | None = None,
+        train_arrays: dict | None = None,
+        tokens_scale: float = 1.0,
+        wandb_log_interval: int = 100,
+        nonfinite_dump_dir: str | None = None,
+        max_consecutive_nonfinite: int = 3,
+    ):
+        if pack_sequences and repack is None:
+            raise ValueError("pack_sequences=True needs a repack closure")
+        if not pack_sequences and train_arrays is None:
+            raise ValueError("pack_sequences=False needs train_arrays")
+        self.logger = logger
+        self.tracker = tracker
+        self.prof = prof
+        self.mesh = mesh
+        self.guard = guard
+        self.ckpt = ckpt
+        self.rows_per_step = rows_per_step
+        self.row_len = row_len
+        self.seed = seed
+        self.pack_sequences = pack_sequences
+        self._repack = repack
+        self.tokens_scale = tokens_scale
+        self.wandb_log_interval = wandb_log_interval
+        self.monitor = NonFiniteMonitor(
+            nonfinite_dump_dir, max_consecutive_nonfinite, logger
+        )
+        self._ran_epoch = False
+        self._arrays = train_arrays
+        self._arrays_epoch: int | None = None
+        self._report = None
+
+    # -- layout ------------------------------------------------------------
+
+    def _arrays_for(self, epoch: int) -> dict:
+        # Lazy: a run resumed at epoch E packs ONCE (for E), not
+        # epoch-0-then-E — restart latency sits inside the preemption
+        # grace window on large datasets.
+        if self.pack_sequences and self._arrays_epoch != epoch:
+            self._arrays, rep = self._repack(epoch)
+            self._arrays_epoch = epoch
+            if self._report is None:
+                # Rates only (n_examples/n_rows for timers): the example
+                # multiset is epoch-invariant, so any epoch's report works.
+                self._report = rep
+                self.logger.info(str(rep))
+        return self._arrays
+
+    @property
+    def pack_report(self):
+        if self.pack_sequences and self._report is None:
+            self._arrays_for(0)
+        return self._report
+
+    @property
+    def examples_per_step(self) -> float:
+        """MEAN examples per optimizer step: packed rows hold several
+        examples, so seq/s keeps meaning sequences, not rows."""
+        if self.pack_sequences:
+            rep = self.pack_report
+            return self.rows_per_step * rep.n_examples / rep.n_rows
+        return float(self.rows_per_step)
+
+    # -- resume + checkpoint -----------------------------------------------
+
+    def resume(self, state_like, place_fn=None) -> tuple[Any, int, int, int]:
+        """(state, start_epoch, start_batch, global_step) — exact cursor
+        via the integrity ladder, or fresh-start values."""
+        point = resume_exact(
+            self.ckpt, state_like, place_fn,
+            data_seed=self.seed, logger=self.logger,
+        )
+        if point is None:
+            return state_like, 0, 0, 0
+        return point.state, point.epoch, point.next_batch, point.global_step
+
+    def save(self, state, *, epoch: int, next_batch: int, global_step: int,
+             wait: bool = False) -> None:
+        """Write a resume point (no-op without a checkpoint manager)."""
+        if self.ckpt is not None:
+            save_resume_point(
+                self.ckpt, state, epoch=epoch, next_batch=next_batch,
+                global_step=global_step, data_seed=self.seed, wait=wait,
+            )
+
+    def shutdown(self, preempted_epoch: int | None = None) -> None:
+        """Close everything the loop owns (ckpt manager joins in-flight
+        async saves, guard restores signal handlers, profiler and tracker
+        flush) — the single exit sequence for both the preempted and the
+        normal return paths of every packed trainer."""
+        if self.ckpt is not None:
+            self.ckpt.close()
+        if self.guard is not None:
+            self.guard.close()
+        self.prof.close()
+        self.tracker.finish()
+        if preempted_epoch is not None:
+            self.logger.info(
+                f"preempted: exiting during epoch {preempted_epoch}"
+            )
+
+    def _preempt(self, state, epoch: int, next_batch: int, global_step: int):
+        # Durable save FIRST: the monitor's deferred check may abort the
+        # run (NonFiniteLossError), and a preemption arriving on top of a
+        # non-finite streak must still leave a resume point — the streak
+        # itself is inside the saved state (nonfinite_count), so the
+        # resumed run keeps counting toward the threshold.
+        self.save(state, epoch=epoch, next_batch=next_batch,
+                  global_step=global_step, wait=True)
+        self.logger.info(
+            f"preempted: resume point at epoch {epoch} batch {next_batch} "
+            f"(global step {global_step})"
+        )
+        self.monitor.flush()
+
+    # -- the epoch ---------------------------------------------------------
+
+    def run_epoch(self, state, step_fn, epoch: int, global_step: int,
+                  start_batch: int = 0) -> EpochResult:
+        """One epoch (or its remainder from ``start_batch``), polling the
+        guard per step. Returns with ``preempted=True`` after writing a
+        durable mid-epoch resume point."""
+        if self.guard is not None and self.guard.fired:
+            # Fired between epochs (eval/checkpoint window): the cursor
+            # is simply "this epoch, batch start_batch".
+            self._preempt(state, epoch, start_batch, global_step)
+            return EpochResult(state, global_step, True, 0)
+        arrays = self._arrays_for(epoch)
+        timer = StepTimer(
+            self.examples_per_step,
+            skip_first=0 if self._ran_epoch else 1,
+        )
+        self._ran_epoch = True
+        epoch_loss, epoch_tokens, n_batches = None, None, 0
+        consumed = start_batch
+        for sharded, _ in prefetch_to_device(
+            chaos.poison_batches(
+                batch_iterator(
+                    arrays, self.rows_per_step, shuffle=True, seed=self.seed,
+                    epoch=epoch, drop_last=True, start_batch=start_batch,
+                ),
+                start_step=global_step,
+            ),
+            self.mesh,
+        ):
+            state, m = step_fn(state, sharded)
+            # Guard-skipped steps contribute 0 to the epoch mean — one
+            # NaN batch must not turn the whole epoch summary NaN (NaN*0
+            # is still NaN, so select, don't scale; the per-step wandb
+            # log still reports the raw loss).
+            loss = m["loss"]
+            if "nonfinite" in m:
+                loss = jnp.where(m["nonfinite"] > 0, 0.0, loss)
+            epoch_loss = loss if epoch_loss is None else epoch_loss + loss
+            if "real_tokens" in m:
+                tok = m["real_tokens"] * self.tokens_scale
+                epoch_tokens = tok if epoch_tokens is None else epoch_tokens + tok
+            timer.tick()
+            n_batches += 1
+            consumed += 1
+            global_step += 1
+            self.prof.tick(global_step)
+            if global_step % self.wandb_log_interval == 0:
+                self.tracker.log(
+                    {"global_step": global_step, "train/loss": float(m["loss"])}
+                )
+            # Deferred non-finite policy: checks the PREVIOUS step's flag.
+            self.monitor.observe(global_step, epoch, m, sharded)
+            chaos.maybe_kill(step=global_step)
+            if self.guard is not None and self.guard.fired:
+                self._preempt(state, epoch, consumed, global_step)
+                return EpochResult(state, global_step, True, n_batches)
+        self.monitor.flush()
+        if n_batches:
+            # Zero batches = an epoch resumed exactly at its end (the
+            # preemption latched after the final batch): nothing ran, so
+            # logging a fabricated 0.0 epoch loss would be a lie.
+            log_epoch_perf(
+                self.logger, self.tracker, epoch, epoch_loss, n_batches, timer,
+                tokens_per_step=(
+                    float(epoch_tokens) / n_batches
+                    if epoch_tokens is not None else None
+                ),
+            )
+            if epoch_tokens is not None:
+                log_occupancy(
+                    self.logger, self.tracker, epoch, float(epoch_tokens),
+                    n_batches * self.rows_per_step * self.row_len,
+                )
+        return EpochResult(state, global_step, False, n_batches)

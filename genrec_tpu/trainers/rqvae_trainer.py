@@ -25,6 +25,7 @@ import numpy as np
 import optax
 
 from genrec_tpu import configlib
+from genrec_tpu.core import chaos
 from genrec_tpu.core.harness import make_train_step
 from genrec_tpu.core.logging import Tracker, setup_logger
 from genrec_tpu.core.profiling import ProfileWindow, StepTimer, log_epoch_perf
@@ -233,6 +234,12 @@ def train(
     from genrec_tpu.core.preemption import PreemptionGuard
 
     guard = PreemptionGuard(logger)
+    from genrec_tpu.core.fault_tolerance import NonFiniteMonitor
+
+    # Host policy for the jitted non-finite guard (core.harness): dump
+    # the offending batch, abort after N consecutive skips — without
+    # this, a structurally diverging run would silently freeze.
+    nonfinite = NonFiniteMonitor.for_run(save_dir_root, logger)
     for epoch in range(start_epoch, epochs):
         if guard.fired:
             # Preempted (SIGTERM grace window): persist the last
@@ -255,6 +262,7 @@ def train(
             if global_step >= total_steps:
                 break
             state, m = step_fn(state, sharded)
+            nonfinite.observe(global_step + 1, epoch, m, sharded)
             epoch_loss = m["loss"] if epoch_loss is None else epoch_loss + m["loss"]
             timer.tick()
             n_batches += 1
@@ -284,7 +292,11 @@ def train(
                     }
                 )
 
+        nonfinite.flush()
         log_epoch_perf(logger, tracker, epoch, epoch_loss, n_batches, timer)
+        # Fault-injection hook (core.chaos): lets tests deliver a real
+        # SIGTERM at a chosen epoch; no-op outside a chaos plan.
+        chaos.maybe_kill(epoch=epoch)
 
         if use_epochs and do_eval and ((epoch + 1) % eval_every == 0 or epoch + 1 == epochs):
             le = eval_losses(state.params, jnp.asarray(eval_x))

@@ -19,8 +19,8 @@ import optax
 
 from genrec_tpu import configlib
 from genrec_tpu.core.harness import make_train_step
-from genrec_tpu.core.logging import Tracker, log_occupancy, setup_logger
-from genrec_tpu.core.profiling import ProfileWindow, StepTimer, log_epoch_perf
+from genrec_tpu.core.logging import Tracker, setup_logger
+from genrec_tpu.core.profiling import ProfileWindow
 from genrec_tpu.core.state import TrainState
 from genrec_tpu.data.batching import (
     batch_iterator,
@@ -139,12 +139,14 @@ def train(
     valid_arrays = ds.eval_arrays("valid")
     test_arrays = ds.eval_arrays("test")
 
+    repack, train_arrays = None, None
     if pack_sequences:
         # The packer owns layout: raw examples only — never materialize
         # the padded (N, max_seq_len) train matrix just to discard it.
         # Re-packed per epoch (epoch-seeded example shuffle) so example
         # co-location in a row is re-mixed like the padded layout's
-        # per-epoch permutation, not frozen at startup.
+        # per-epoch permutation, not frozen at startup. PackedTrainLoop
+        # calls this lazily per epoch.
         train_examples = ds.train_examples()
 
         def repack(epoch: int):
@@ -154,8 +156,6 @@ def train(
             arrays.pop("segment_valid")  # unused by SASRec's token-level CE
             return arrays, rep
 
-        train_arrays, pack_report = repack(0)
-        logger.info(str(pack_report))
         # Eval rows must index positions the way packed training does
         # (token t at position t), and predictions come from the last
         # VALID slot (make_eval_step(last_from_length=True)).
@@ -217,83 +217,51 @@ def train(
     # from the last valid slot of right-padded eval rows.
     eval_step = make_eval_step(model, last_from_length=pack_sequences)
 
-    from genrec_tpu.core.checkpoint import BestTracker, CheckpointManager, maybe_resume, save_params
+    from genrec_tpu.core.checkpoint import BestTracker, CheckpointManager, save_params
+    from genrec_tpu.core.preemption import PreemptionGuard
+    from genrec_tpu.trainers.packed_loop import PackedTrainLoop
 
     ckpt_mgr = CheckpointManager(os.path.join(save_dir_root, "checkpoints")) if save_dir_root else None
-    start_epoch, global_step = 0, 0
-    if resume_from_checkpoint:
-        state, start_epoch, global_step = maybe_resume(
-            ckpt_mgr, state, lambda s: replicate(mesh, s)
-        )
-        if start_epoch:
-            logger.info(f"resumed after epoch {start_epoch - 1} (step {global_step})")
     best = BestTracker(save_dir_root)
     prof = ProfileWindow(
         os.path.join(save_dir_root, "profile") if save_dir_root else "",
         profile_steps,
     )
-    from genrec_tpu.core.preemption import PreemptionGuard
-
     guard = PreemptionGuard(logger)
+    loop = PackedTrainLoop(
+        logger=logger, tracker=tracker, prof=prof, mesh=mesh,
+        guard=guard, ckpt=ckpt_mgr,
+        rows_per_step=batch_size, row_len=max_seq_len, seed=seed,
+        pack_sequences=pack_sequences, repack=repack, train_arrays=train_arrays,
+        wandb_log_interval=wandb_log_interval,
+        nonfinite_dump_dir=(
+            os.path.join(save_dir_root, "nonfinite") if save_dir_root else None
+        ),
+    )
+    start_epoch, start_batch, global_step = 0, 0, 0
+    if resume_from_checkpoint:
+        # Step-granular exact resume: restores TrainState + the data
+        # cursor through the integrity ladder, continuing at the exact
+        # next batch of a possibly mid-epoch resume point.
+        state, start_epoch, start_batch, global_step = loop.resume(
+            state, lambda s: replicate(mesh, s)
+        )
     for epoch in range(start_epoch, epochs):
-        if guard.fired:
-            # Preempted (SIGTERM grace window): persist the last
-            # COMPLETED epoch and exit; resume_from_checkpoint
-            # continues from here instead of the last periodic save.
-            if ckpt_mgr is not None and epoch > start_epoch:
-                ckpt_mgr.save(epoch - 1, state)
-                ckpt_mgr.close()
-            guard.close()
-            tracker.finish()
-            logger.info(f"preempted: exiting before epoch {epoch}")
+        res = loop.run_epoch(
+            state, step_fn, epoch, global_step,
+            start_batch=start_batch if epoch == start_epoch else 0,
+        )
+        state, global_step = res.state, res.global_step
+        if res.preempted:
+            # SIGTERM/SIGINT grace window: the loop already wrote a
+            # durable mid-epoch resume point; exit cleanly so the
+            # scheduler restarts us with resume_from_checkpoint.
+            loop.shutdown(preempted_epoch=epoch)
             return {}, {}
-        if pack_sequences and epoch > 0:
-            train_arrays, _ = repack(epoch)  # re-mix example co-location
-        # Device-scalar accumulation: float() only at logging boundaries so
-        # the host never blocks on the jitted step (async dispatch).
-        epoch_loss, epoch_tokens, n_batches = None, None, 0
-        # Packed rows hold several examples: feed the timer the MEAN
-        # examples per step so seq/s keeps meaning sequences, not rows.
-        examples_per_step = (
-            batch_size * pack_report.n_examples / pack_report.n_rows
-            if pack_sequences else batch_size
-        )
-        timer = StepTimer(examples_per_step, skip_first=1 if epoch == start_epoch else 0)
-        for sharded, _ in prefetch_to_device(
-            batch_iterator(train_arrays, batch_size, shuffle=True,
-                           seed=seed, epoch=epoch, drop_last=True),
-            mesh,
-        ):
-            state, metrics = step_fn(state, sharded)
-            epoch_loss = metrics["loss"] if epoch_loss is None else epoch_loss + metrics["loss"]
-            if "real_tokens" in metrics:
-                epoch_tokens = (
-                    metrics["real_tokens"] if epoch_tokens is None
-                    else epoch_tokens + metrics["real_tokens"]
-                )
-            timer.tick()
-            n_batches += 1
-            global_step += 1
-            prof.tick(global_step)
-            if global_step % wandb_log_interval == 0:
-                tracker.log(
-                    {"global_step": global_step, "train/loss": float(metrics["loss"])}
-                )
-        log_epoch_perf(
-            logger, tracker, epoch, epoch_loss, n_batches, timer,
-            tokens_per_step=(
-                float(epoch_tokens) / n_batches
-                if (epoch_tokens is not None and n_batches) else None
-            ),
-        )
-        if epoch_tokens is not None and n_batches:
-            log_occupancy(
-                logger, tracker, epoch, float(epoch_tokens),
-                n_batches * batch_size * max_seq_len,
-            )
 
         if ckpt_mgr is not None and (epoch + 1) % save_every_epoch == 0:
-            ckpt_mgr.save(epoch, state)  # full TrainState: one resumable format everywhere
+            # Epoch-boundary resume point: cursor = (next epoch, batch 0).
+            loop.save(state, epoch=epoch + 1, next_batch=0, global_step=global_step)
 
         if do_eval and (epoch + 1) % eval_every_epoch == 0:
             m = evaluate(eval_step, state.params, valid_arrays, eval_batch_size, mesh)
@@ -313,10 +281,7 @@ def train(
 
     if save_dir_root and best.value < 0:  # no eval ran: snapshot final params
         save_params(os.path.join(save_dir_root, "best_model"), final_params)
-    if ckpt_mgr is not None:
-        ckpt_mgr.close()
-    prof.close()
-    tracker.finish()
+    loop.shutdown()
     return valid_metrics, test_metrics
 
 

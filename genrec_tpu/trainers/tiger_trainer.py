@@ -20,14 +20,13 @@ import optax
 
 from genrec_tpu import configlib
 from genrec_tpu.core.harness import make_train_step
-from genrec_tpu.core.logging import Tracker, log_occupancy, setup_logger
-from genrec_tpu.core.profiling import ProfileWindow, StepTimer, log_epoch_perf
+from genrec_tpu.core.logging import Tracker, setup_logger
+from genrec_tpu.core.profiling import ProfileWindow
 from genrec_tpu.core.state import TrainState
 from genrec_tpu.data.batching import (
     batch_iterator,
     pack_examples,
     prefetch_eval_batches,
-    prefetch_to_device,
 )
 from genrec_tpu.data.tiger_seq import TigerSeqData, synthetic_tiger_data
 from genrec_tpu.models.tiger import Tiger, tiger_generate
@@ -85,6 +84,8 @@ def train(
     dataset="synthetic",
     dataset_folder="dataset/amazon",
     split="beauty",
+    # Synthetic-dataset scale knob (tests/chaos harness shrink it).
+    num_users=500,
     sem_ids_path=None,
     add_disambiguation=False,
     tensor_parallel=1,
@@ -134,7 +135,7 @@ def train(
     if dataset == "synthetic":
         data = synthetic_tiger_data(
             codebook_size=codebook_size, sem_id_dim=sem_id_dim,
-            max_items=max_items, seed=seed,
+            max_items=max_items, seed=seed, num_users=num_users,
         )
     else:
         from genrec_tpu.data.amazon import load_sequences
@@ -160,11 +161,13 @@ def train(
     trie = build_trie(data.valid_item_sem_ids(), codebook_size)
 
     pack_row_len = 1 + max_items * sem_id_dim  # user token + item stream
+    repack, train_arrays = None, None
     if pack_sequences:
         # Raw examples only — the padded (N, L) train matrix is never
         # materialized when the packer owns layout. Re-packed per epoch
         # (epoch-seeded shuffle) so example co-location is re-mixed like
-        # the padded layout's per-epoch permutation.
+        # the padded layout's per-epoch permutation; PackedTrainLoop
+        # calls this lazily per epoch.
         examples = data.train_examples()
 
         def repack(epoch: int):
@@ -174,10 +177,40 @@ def train(
                 seed=(seed, epoch),
             )
 
-        train_arrays, pack_report = repack(0)
-        logger.info(str(pack_report))
     else:
         train_arrays = data.train_arrays()
+
+    from genrec_tpu.core.checkpoint import BestTracker, CheckpointManager, save_params
+    from genrec_tpu.core.preemption import PreemptionGuard
+    from genrec_tpu.trainers.packed_loop import PackedTrainLoop
+
+    ckpt = CheckpointManager(os.path.join(save_dir_root, "checkpoints")) if save_dir_root else None
+    prof = ProfileWindow(
+        os.path.join(save_dir_root, "profile") if save_dir_root else "",
+        profile_steps,
+    )
+    guard = PreemptionGuard(logger)
+    # One optimizer step consumes batch_size * accum rows (packed rows
+    # hold several examples each; state.step counts optimizer steps).
+    rows_per_step = batch_size * gradient_accumulate_every
+    loop = PackedTrainLoop(
+        logger=logger, tracker=tracker, prof=prof, mesh=mesh,
+        guard=guard, ckpt=ckpt,
+        rows_per_step=rows_per_step, row_len=pack_row_len, seed=seed,
+        pack_sequences=pack_sequences, repack=repack, train_arrays=train_arrays,
+        # make_train_step MEANS aux over microbatches; scale real_tokens
+        # back to whole-step counts.
+        tokens_scale=float(gradient_accumulate_every),
+        wandb_log_interval=wandb_log_interval,
+        nonfinite_dump_dir=(
+            os.path.join(save_dir_root, "nonfinite") if save_dir_root else None
+        ),
+    )
+    # Accessing the report here materializes the epoch-0 pack (the jitted
+    # loss closure below needs its rates before any resume decision), so a
+    # resume at epoch E packs twice for TIGER — seconds, vs the ~30s+ step
+    # recompile every restart pays anyway.
+    pack_report = loop.pack_report
 
     compute_dtype = jnp.bfloat16 if (amp and mixed_precision_type == "bf16") else jnp.float32
     model = Tiger(
@@ -207,12 +240,11 @@ def train(
         jnp.ones((1, L), jnp.int32),
     )["params"]
 
-    # One optimizer step consumes batch_size * accum rows (packed rows hold
-    # several examples each; state.step counts optimizer steps).
-    n_train_rows = next(iter(train_arrays.values())).shape[0]
-    opt_steps_per_epoch = max(
-        1, n_train_rows // (batch_size * gradient_accumulate_every)
+    n_train_rows = (
+        pack_report.n_rows if pack_sequences
+        else next(iter(train_arrays.values())).shape[0]
     )
+    opt_steps_per_epoch = max(1, n_train_rows // rows_per_step)
     total_steps = epochs * opt_steps_per_epoch
     schedule = cosine_schedule_with_warmup(learning_rate, num_warmup_steps, total_steps)
     optimizer = optax.adamw(schedule, weight_decay=weight_decay)
@@ -272,79 +304,24 @@ def train(
     state = place_state(TrainState.create(params, optimizer, state_rng))
     gen_fn = make_generate_fn(model, trie, generate_temperature, 10)
 
-    from genrec_tpu.core.checkpoint import BestTracker, CheckpointManager, maybe_resume, save_params
-
-    ckpt = CheckpointManager(os.path.join(save_dir_root, "checkpoints")) if save_dir_root else None
-    start_epoch, global_step = 0, 0
+    start_epoch, start_batch, global_step = 0, 0, 0
     if resume_from_checkpoint:
+        # Step-granular exact resume through the integrity ladder;
         # place_state preserves the tensor-parallel layout on restore.
-        state, start_epoch, global_step = maybe_resume(ckpt, state, place_state)
-        if start_epoch:
-            logger.info(f"resumed after epoch {start_epoch - 1} (step {global_step})")
+        state, start_epoch, start_batch, global_step = loop.resume(state, place_state)
     best = BestTracker(save_dir_root)
-    prof = ProfileWindow(
-        os.path.join(save_dir_root, "profile") if save_dir_root else "",
-        profile_steps,
-    )
-    from genrec_tpu.core.preemption import PreemptionGuard
-
-    guard = PreemptionGuard(logger)
     for epoch in range(start_epoch, epochs):
-        if guard.fired:
-            # Preempted (SIGTERM grace window): persist the last
-            # COMPLETED epoch and exit; resume_from_checkpoint
-            # continues from here instead of the last periodic save.
-            if ckpt is not None and epoch > start_epoch:
-                ckpt.save(epoch - 1, state)
-                ckpt.close()
-            guard.close()
-            tracker.finish()
-            logger.info(f"preempted: exiting before epoch {epoch}")
+        res = loop.run_epoch(
+            state, step_fn, epoch, global_step,
+            start_batch=start_batch if epoch == start_epoch else 0,
+        )
+        state, global_step = res.state, res.global_step
+        if res.preempted:
+            # SIGTERM/SIGINT grace window: the loop already wrote a
+            # durable mid-epoch resume point; exit cleanly so the
+            # scheduler restarts us with resume_from_checkpoint.
+            loop.shutdown(preempted_epoch=epoch)
             return {}, {}
-        # Accumulate the device scalar; float() only at logging boundaries
-        # so host dispatch never blocks on the step (async dispatch).
-        # StepTimer.tick() likewise does not block; the block_until_ready
-        # on the chained epoch_loss below closes the timing window.
-        if pack_sequences and epoch > 0:
-            train_arrays, _ = repack(epoch)  # re-mix example co-location
-        epoch_loss, epoch_tokens, n_batches = None, None, 0
-        # seq/s keeps meaning EXAMPLES under packing (rows hold several).
-        rows_per_step = batch_size * gradient_accumulate_every
-        timer = StepTimer(
-            rows_per_step * pack_report.n_examples / pack_report.n_rows
-            if pack_sequences else rows_per_step,
-            skip_first=1 if epoch == start_epoch else 0,
-        )
-        for sharded, _ in prefetch_to_device(
-            batch_iterator(train_arrays, batch_size * gradient_accumulate_every,
-                           shuffle=True, seed=seed, epoch=epoch, drop_last=True),
-            mesh,
-        ):
-            state, m = step_fn(state, sharded)
-            epoch_loss = m["loss"] if epoch_loss is None else epoch_loss + m["loss"]
-            if "real_tokens" in m:
-                # make_train_step MEANS aux over microbatches; scale back
-                # to whole-step tokens.
-                tok = m["real_tokens"] * gradient_accumulate_every
-                epoch_tokens = tok if epoch_tokens is None else epoch_tokens + tok
-            timer.tick()
-            n_batches += 1
-            global_step += 1
-            prof.tick(global_step)
-            if global_step % wandb_log_interval == 0:
-                tracker.log({"global_step": global_step, "train/loss": float(m["loss"])})
-        log_epoch_perf(
-            logger, tracker, epoch, epoch_loss, n_batches, timer,
-            tokens_per_step=(
-                float(epoch_tokens) / n_batches
-                if (epoch_tokens is not None and n_batches) else None
-            ),
-        )
-        if epoch_tokens is not None and n_batches:
-            log_occupancy(
-                logger, tracker, epoch, float(epoch_tokens),
-                n_batches * batch_size * gradient_accumulate_every * pack_row_len,
-            )
 
         if do_eval and (epoch + 1) % eval_every_epoch == 0:
             eval_rng, sub = jax.random.split(eval_rng)
@@ -356,7 +333,8 @@ def train(
             best.update(metrics["Recall@10"], state.params)
 
         if ckpt is not None and (epoch + 1) % save_every_epoch == 0:
-            ckpt.save(epoch, state)  # epoch-keyed: uniform across trainers
+            # Epoch-boundary resume point: cursor = (next epoch, batch 0).
+            loop.save(state, epoch=epoch + 1, next_batch=0, global_step=global_step)
 
     final_params = best.best_params(like=state.params) if test_on_best else None
     if final_params is None:
@@ -368,10 +346,7 @@ def train(
     tracker.log({f"test/{k}": v for k, v in test_metrics.items()})
     if save_dir_root and best.value < 0:  # no eval ran: snapshot final params
         save_params(os.path.join(save_dir_root, "best_model"), final_params)
-    if ckpt is not None:
-        ckpt.close()
-    prof.close()
-    tracker.finish()
+    loop.shutdown()
     return valid_metrics, test_metrics
 
 

@@ -189,3 +189,17 @@ def test_right_align_moves_left_padded_rows():
         out["timestamps"], [[70, 80, 0, 0], [10, 20, 30, 40], [90, 0, 0, 0]]
     )
     np.testing.assert_array_equal(out["targets"], arrays["targets"])
+
+
+def test_batch_iterator_start_batch_resumes_exact_order():
+    """The mid-epoch resume cursor: start_batch=k yields exactly the
+    batches an uninterrupted iteration would have yielded from index k,
+    under the same (seed, epoch) shuffle."""
+    arrays = {"x": np.arange(37, dtype=np.int32)[:, None]}
+    kw = dict(shuffle=True, seed=3, epoch=2, drop_last=True)
+    full = [b["x"] for b, _ in batch_iterator(arrays, 5, **kw)]
+    for k in (0, 1, 3, len(full)):
+        tail = [b["x"] for b, _ in batch_iterator(arrays, 5, start_batch=k, **kw)]
+        assert len(tail) == len(full) - k
+        for a, b in zip(full[k:], tail):
+            np.testing.assert_array_equal(a, b)

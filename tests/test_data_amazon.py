@@ -202,3 +202,102 @@ def test_native_parser_adversarial_lines(tmp_path):
     u_idx, i_idx, ts, users, items = out
     assert list(ts) == [1234, 777]  # real timestamp, not the in-text 999
     assert users == ["u1", "u2"] and items == ["a1", "a2"]
+
+
+def test_maybe_download_retries_with_backoff_then_succeeds(tmp_path):
+    """Transient network errors are retried with exponential backoff; the
+    partial file is staged at <dest>.part and only renamed on success."""
+    from genrec_tpu.data import amazon
+
+    dest = str(tmp_path / "raw" / "f.json.gz")
+    calls, delays = [], []
+
+    def flaky(url, path):
+        calls.append(url)
+        if len(calls) < 3:
+            with open(path, "wb") as f:
+                f.write(b"trunc")  # partial write before the failure
+            raise OSError("connection reset")
+        with open(path, "wb") as f:
+            f.write(b"payload")
+
+    orig = amazon.urllib.request.urlretrieve
+    amazon.urllib.request.urlretrieve = flaky
+    try:
+        amazon._maybe_download("http://x/f.json.gz", dest,
+                               attempts=3, backoff=0.5, sleep=delays.append)
+    finally:
+        amazon.urllib.request.urlretrieve = orig
+    assert len(calls) == 3
+    assert delays == [0.5, 1.0]  # exponential backoff
+    assert open(dest, "rb").read() == b"payload"
+    assert not os.path.exists(dest + ".part")
+
+
+def test_maybe_download_cleans_partial_after_final_failure(tmp_path):
+    """A permanently failing download must not leave a truncated file
+    that poisons the next attempt's exists-check."""
+    from genrec_tpu.data import amazon
+
+    dest = str(tmp_path / "raw" / "f.json.gz")
+
+    def always_fail(url, path):
+        with open(path, "wb") as f:
+            f.write(b"trunc")
+        raise OSError("no route to host")
+
+    orig = amazon.urllib.request.urlretrieve
+    amazon.urllib.request.urlretrieve = always_fail
+    try:
+        with pytest.raises(FileNotFoundError, match="no route to host"):
+            amazon._maybe_download("http://x/f.json.gz", dest,
+                                   attempts=2, backoff=0.1, sleep=lambda s: None)
+    finally:
+        amazon.urllib.request.urlretrieve = orig
+    assert not os.path.exists(dest)
+    assert not os.path.exists(dest + ".part")
+
+
+def test_maybe_download_existing_dest_is_untouched(tmp_path):
+    from genrec_tpu.data import amazon
+
+    dest = str(tmp_path / "f.json.gz")
+    with open(dest, "wb") as f:
+        f.write(b"cached")
+
+    def boom(url, path):  # must never be called
+        raise AssertionError("download attempted despite cached file")
+
+    orig = amazon.urllib.request.urlretrieve
+    amazon.urllib.request.urlretrieve = boom
+    try:
+        amazon._maybe_download("http://x/f.json.gz", dest)
+    finally:
+        amazon.urllib.request.urlretrieve = orig
+    assert open(dest, "rb").read() == b"cached"
+
+
+def test_maybe_download_fails_fast_on_4xx(tmp_path):
+    """A deterministic client error (404: bad split/retired URL) is not
+    retried — no backoff sleeps, one attempt, immediate failure."""
+    import urllib.error
+
+    from genrec_tpu.data import amazon
+
+    dest = str(tmp_path / "raw" / "f.json.gz")
+    calls, delays = [], []
+
+    def not_found(url, path):
+        calls.append(url)
+        raise urllib.error.HTTPError(url, 404, "Not Found", None, None)
+
+    orig = amazon.urllib.request.urlretrieve
+    amazon.urllib.request.urlretrieve = not_found
+    try:
+        with pytest.raises(FileNotFoundError, match="404"):
+            amazon._maybe_download("http://x/f.json.gz", dest,
+                                   attempts=3, backoff=0.5, sleep=delays.append)
+    finally:
+        amazon.urllib.request.urlretrieve = orig
+    assert len(calls) == 1 and delays == []
+    assert not os.path.exists(dest) and not os.path.exists(dest + ".part")
