@@ -37,6 +37,23 @@ class ChaosPlan:
     kill_at_epoch: int | None = None  # epoch index to signal at (end of epoch)
     kill_signal: int = signal.SIGTERM
     nan_at_steps: frozenset[int] = frozenset()  # global steps to poison
+    # Step at which CheckpointManager.save dies HARD (SIGKILL) after the
+    # array snapshot but before the commit completes — a host vanishing
+    # mid-save. Drives the coordinated-commit guarantee: the step must
+    # never end up with a commit marker.
+    die_in_save_at_step: int | None = None
+    # Multi-host chaos: restrict every injection above to ONE simulated
+    # host (jax.process_index()). None = fire on every process (the
+    # single-process default, where process_index() is 0).
+    only_process: int | None = None
+
+
+def _this_process_targeted(plan: ChaosPlan) -> bool:
+    if plan.only_process is None:
+        return True
+    import jax
+
+    return jax.process_index() == plan.only_process
 
 
 _ACTIVE: ChaosPlan | None = None
@@ -71,12 +88,24 @@ def maybe_kill(step: int | None = None, epoch: int | None = None) -> None:
     so whatever handler the trainer installed — the PreemptionGuard —
     latches it exactly as it would a fleet preemption."""
     plan = _ACTIVE
-    if plan is None:
+    if plan is None or not _this_process_targeted(plan):
         return
     if step is not None and plan.kill_at_step == step:
         os.kill(os.getpid(), plan.kill_signal)
     if epoch is not None and plan.kill_at_epoch == epoch:
         os.kill(os.getpid(), plan.kill_signal)
+
+
+def maybe_die_in_save(step: int) -> None:
+    """Die HARD (SIGKILL — no handlers, no atexit, no orbax cleanup) when
+    the plan names this checkpoint step, simulating a host lost mid-save.
+    Called by `CheckpointManager.save` after the in-memory snapshot, while
+    the directory write/commit is still in flight."""
+    plan = _ACTIVE
+    if plan is None or not _this_process_targeted(plan):
+        return
+    if plan.die_in_save_at_step == step:
+        os.kill(os.getpid(), signal.SIGKILL)
 
 
 def poison_batches(iterator: Iterable, start_step: int) -> Iterator:
@@ -85,7 +114,11 @@ def poison_batches(iterator: Iterable, start_step: int) -> Iterator:
     batch (batch i lands as global step start_step + 1 + i)."""
     for i, (batch, valid) in enumerate(iterator):
         plan = _ACTIVE
-        if plan is not None and (start_step + 1 + i) in plan.nan_at_steps:
+        if (
+            plan is not None
+            and _this_process_targeted(plan)
+            and (start_step + 1 + i) in plan.nan_at_steps
+        ):
             batch = {
                 k: (np.full_like(v, np.nan)
                     if np.issubdtype(np.asarray(v).dtype, np.floating) else v)

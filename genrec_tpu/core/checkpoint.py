@@ -9,6 +9,7 @@ genrec_tpu.data.sem_ids.
 
 from __future__ import annotations
 
+import asyncio
 import json
 import logging
 import os
@@ -20,7 +21,65 @@ import jax.numpy as jnp
 import numpy as np
 import orbax.checkpoint as ocp
 
+from genrec_tpu.core import chaos
+
 logger = logging.getLogger("genrec_tpu")
+
+
+def _per_host_type_handler_registry():
+    """Type-handler registry for `CheckpointManager(per_host=True)`:
+    the stock numpy/scalar handlers minus their hard-coded
+    ``process_index() == 0`` write gate (orbax assumes one shared
+    directory; with per-host record trees EVERY process is the sole
+    writer of its own tree, in a singleton orbax process group).
+
+    Built lazily because the ungated subclasses override PRIVATE orbax
+    internals (`_background_serialize`) verified against orbax 0.7 —
+    only this optional per-host mode depends on them, so an orbax that
+    reorganized those internals fails HERE with an actionable error,
+    not at import time for every shared-directory user."""
+    from orbax.checkpoint import type_handlers as _oth
+
+    try:
+
+        class _AllHostsNumpyHandler(_oth.NumpyHandler):
+            async def _background_serialize(self, values, infos, args=None):
+                write_coros = []
+                for value, info, arg in zip(values, infos, args):
+                    tspec = self._get_json_tspec_write(
+                        info,
+                        value,
+                        use_ocdbt=info.is_ocdbt_checkpoint,
+                        process_index=_oth.get_process_index_for_subdir(
+                            use_ocdbt=info.is_ocdbt_checkpoint,
+                            override_ocdbt_process_id=(
+                                self._override_ocdbt_process_id
+                            ),
+                        ),
+                        arg=arg,
+                    )
+                    write_coros.append(
+                        self._open_and_write(value, tspec, info.ts_context)
+                    )
+                await asyncio.gather(*write_coros)
+
+        class _AllHostsScalarHandler(_oth.ScalarHandler, _AllHostsNumpyHandler):
+            pass
+
+        return _oth.create_type_handler_registry(
+            (int, _AllHostsScalarHandler()),
+            (float, _AllHostsScalarHandler()),
+            (np.number, _AllHostsScalarHandler()),
+            (np.ndarray, _AllHostsNumpyHandler()),
+        )
+    except AttributeError as e:
+        raise RuntimeError(
+            "CheckpointManager(per_host=True) needs the orbax-checkpoint "
+            "0.7 type_handlers internals its ungated write handlers "
+            f"subclass, but this orbax does not provide them ({e}). "
+            "Install orbax-checkpoint==0.7.* or use the default "
+            "shared-directory mode."
+        ) from e
 
 
 class CheckpointCorruptError(RuntimeError):
@@ -139,17 +198,36 @@ def _refuse_resume_below_stale_steps(
             if resumed_step is None
             else f"resume below them (at step {resumed_step})"
         )
-        raise RuntimeError(
-            f"checkpoint directory {ckpt.directory} holds records this run "
-            f"cannot resume (steps {stale}: written by a different code "
-            f"version or trainer). Refusing to {at} — orbax would silently "
-            "drop every save keyed below the stale latest step. Move or "
-            "delete those step dirs (the records are intact) and relaunch."
-        )
+        raise RuntimeError(stale_refusal_message(
+            ckpt.directory,
+            f"steps {stale}: written by a different code version or trainer",
+            at,
+        ))
+
+
+def stale_refusal_message(directory: str, what: str, at: str) -> str:
+    """The one stale-record refusal narrative, shared by the single-host
+    refusal above and the collective multi-host refusal in
+    `core.fault_tolerance.resume_exact` — remediation guidance edited in
+    only one copy would drift."""
+    return (
+        f"checkpoint directory {directory} holds records this run "
+        f"cannot resume ({what}). Refusing to {at} — orbax would silently "
+        "drop every save keyed below the stale latest step. Move or "
+        "delete those step dirs (the records are intact; pre-PR4 "
+        "epoch-keyed records can still be restored from a script via "
+        "genrec_tpu.core.checkpoint.maybe_resume) and relaunch."
+    )
 
 
 def maybe_resume(ckpt: "CheckpointManager | None", state, replicate_fn=None):
-    """Shared resume logic for the epoch-granularity trainers.
+    """LEGACY epoch-keyed resume. No trainer uses this anymore — every
+    trainer resumes step-exactly through `core.fault_tolerance.resume_exact`
+    (scripts/ci_checks.sh enforces the no-import rule). Kept as a
+    library-level migration helper for pre-PR4 epoch-keyed checkpoints
+    (bare TrainState records): call it from a script to pull the state
+    out of an old directory — the trainers themselves refuse such
+    directories loudly (see `_refuse_resume_below_stale_steps`).
 
     Checkpoints are keyed by EPOCH. Returns
     ``(state, start_epoch, global_step)`` — fresh-start values when there
@@ -214,14 +292,20 @@ class BestTracker:
             # is lost for good. Best-improvements are rare; the epoch-level
             # CheckpointManager saves are the async path.
             save_params(self.dir, params)
-            # Atomic replace: a crash mid-write must never leave a
-            # truncated json that breaks the next resume's float(...).
-            tmp = self.meta + ".tmp"
-            with open(tmp, "w") as f:
-                json.dump({"metric": self.metric, "value": value}, f)
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(tmp, self.meta)
+            if jax.process_index() == 0:
+                # Process-0-only: on a shared filesystem every host sees
+                # the same best_model dir; concurrent sidecar writers
+                # would race each other's tmp/replace. The orbax save
+                # above is still collective (all hosts contribute
+                # shards); only the tiny json is single-writer.
+                # Atomic replace: a crash mid-write must never leave a
+                # truncated json that breaks the next resume's float(...).
+                tmp = self.meta + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump({"metric": self.metric, "value": value}, f)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, self.meta)
         return True
 
     def best_params(self, like):
@@ -246,19 +330,94 @@ class CheckpointManager:
     retained step first, validated as (1) orbax commit marker present,
     (2) arrays readable + tree structure matches the live state, (3) every
     float leaf finite — a step failing any rung is quarantined to
-    ``<dir>/quarantine/`` (kept for post-mortem, excluded from discovery)
-    and the ladder falls through to the previous retained step.
+    ``<dir>/quarantine/p<process>/`` (kept for post-mortem, excluded from
+    discovery) and the ladder falls through to the previous retained step.
+
+    Multi-host semantics:
+
+    - **Coordinated commit** (shared directory, the default): orbax
+      writes every host's shards into the step's tmp dir and process 0
+      finalizes (rename + commit marker) only after an ALL-HOST barrier
+      through the distributed coordination service — a host dying
+      mid-save can never yield a step that is commit-markered for some
+      hosts and absent for others. The barrier is bounded by
+      ``commit_timeout_secs`` so a lost host surfaces as an error on the
+      survivors instead of a silent hang.
+    - **Per-host directories** (``per_host=True``): each process keeps an
+      independent record tree under ``<dir>/p<process>/`` with no
+      cross-host coordination — the layout for host-local disks. The
+      orbax manager runs in a SINGLETON process group (``primary_host``
+      = this process, ``active_processes`` = {this process}) so every
+      host writes, finalizes, and commit-markers its own tree; trees
+      must be host-local (numpy leaves — cross-process jax.Arrays need
+      the shared-directory mode). Restores then MUST go through
+      `restore_latest_valid_consensus`, which makes every host restore
+      the SAME step (or aborts loudly with a per-host validity report).
     """
 
-    def __init__(self, directory: str, max_to_keep: int = 3):
-        self.directory = _abs(directory)
+    def __init__(self, directory: str, max_to_keep: int = 3, *,
+                 per_host: bool = False, commit_timeout_secs: int = 300):
+        self.per_host = bool(per_host and jax.process_count() > 1)
+        root = _abs(directory)
+        async_options = ocp.options.AsyncOptions(
+            timeout_secs=commit_timeout_secs
+        )
+        if self.per_host:
+            pid = jax.process_index()
+            root = os.path.join(root, f"p{pid}")
+            # Singleton process group: orbax's own barriers and primary-
+            # host gating collapse to this process alone. The write gate
+            # baked into the stock numpy type handler still points at
+            # global process 0, so per-host trees use the ungated
+            # handlers above (and plain zarr, not OCDBT — the per-process
+            # OCDBT merge machinery serves the shared-directory layout).
+            mp_options = ocp.options.MultiprocessingOptions(
+                primary_host=pid,
+                active_processes={pid},
+                barrier_sync_key_prefix=f"perhost{pid}",
+            )
+            registry = _per_host_type_handler_registry()
+            os.makedirs(root, exist_ok=True)  # orbax create=False needs it
+            self.directory = root
+            self._mgr = ocp.CheckpointManager(
+                root,
+                options=ocp.CheckpointManagerOptions(
+                    max_to_keep=max_to_keep,
+                    create=False,
+                    async_options=async_options,
+                    multiprocessing_options=mp_options,
+                ),
+                item_handlers=ocp.PyTreeCheckpointHandler(
+                    use_ocdbt=False,
+                    multiprocessing_options=mp_options,
+                    type_handler_registry=registry,
+                ),
+            )
+            return
+        self.directory = root
         self._mgr = ocp.CheckpointManager(
             self.directory,
-            options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep),
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                async_options=async_options,
+            ),
         )
 
+    def _save_args(self, tree: Any):
+        # Per-host managers carry an explicit handler (PyTree args);
+        # shared-directory managers use the standard route.
+        if self.per_host:
+            return ocp.args.PyTreeSave(tree)
+        return ocp.args.StandardSave(tree)
+
     def save(self, step: int, state: Any) -> None:
-        saved = self._mgr.save(step, args=ocp.args.StandardSave(to_savable(state)))
+        saved = self._mgr.save(step, args=self._save_args(to_savable(state)))
+        # Chaos hook: a host lost MID-SAVE (SIGKILL with the directory
+        # write still in flight on the background thread). The
+        # coordinated-commit guarantee under test: the marker is written
+        # by process 0 only after the all-host barrier, so this step must
+        # never become restorable anywhere.
+        chaos.maybe_die_in_save(step)
         # orbax's should_save REFUSES saves keyed <= the retained latest
         # step, returning False with no error. Re-saving the exact latest
         # key is benign (identical record, e.g. a preemption landing on a
@@ -283,12 +442,24 @@ class CheckpointManager:
         """Join any in-flight async save (durability barrier)."""
         self._mgr.wait_until_finished()
 
+    def reload(self) -> None:
+        """Re-read the step listing from disk. Needed when another host
+        sharing the directory may have quarantined steps since this
+        manager last scanned (the consensus pass does)."""
+        self._mgr.reload()
+
     def restore(self, state_like: Any, step: int | None = None) -> Any:
         step = step if step is not None else self._mgr.latest_step()
         if step is None:
             return None
+        like = to_savable(state_like)
         restored = self._mgr.restore(
-            step, args=ocp.args.StandardRestore(to_savable(state_like))
+            step,
+            args=(
+                ocp.args.PyTreeRestore(like)
+                if self.per_host
+                else ocp.args.StandardRestore(like)
+            ),
         )
         return from_savable(restored, state_like)
 
@@ -348,17 +519,32 @@ class CheckpointManager:
         return restored
 
     def quarantine(self, step: int) -> None:
-        """Move a corrupt step dir out of discovery, keeping it on disk."""
+        """Move a corrupt step dir out of discovery, keeping it on disk.
+
+        The destination embeds ``jax.process_index()``: on a shared
+        filesystem every host runs the ladder over the same files, so
+        concurrent quarantines would otherwise clobber each other's
+        post-mortem artifacts. The losing host of a move race finds the
+        source already gone — which is fine, the step is out of
+        discovery either way."""
         src = os.path.join(self.directory, str(step))
-        qdir = os.path.join(self.directory, "quarantine")
+        qdir = os.path.join(
+            self.directory, "quarantine", f"p{jax.process_index()}"
+        )
         os.makedirs(qdir, exist_ok=True)
         dst = os.path.join(qdir, str(step))
         n = 0
         while os.path.exists(dst):
             n += 1
             dst = os.path.join(qdir, f"{step}.{n}")
-        if os.path.exists(src):
-            shutil.move(src, dst)
+        try:
+            if os.path.exists(src):
+                shutil.move(src, dst)
+        except (FileNotFoundError, shutil.Error) as e:
+            logger.warning(
+                f"quarantine of step {step} lost a move race ({e}): "
+                "another host already moved it"
+            )
         self._mgr.reload()  # drop the manager's cached step listing
 
     def restore_latest_valid(
@@ -391,6 +577,95 @@ class CheckpointManager:
                     "falling back to the previous retained step"
                 )
         return None, None
+
+    def restore_latest_valid_consensus(
+        self, state_like: Any, extra_validate=None
+    ) -> tuple[Any, int] | tuple[None, None]:
+        """Multi-host-safe `restore_latest_valid`: every host restores
+        the SAME step, or the job aborts loudly.
+
+        Each host first runs the integrity ladder locally (quarantining
+        its corrupt steps), then the fleet agrees through the
+        distributed runtime:
+
+        1. allgather each host's newest-valid step (-1 = nothing valid);
+        2. all equal -> done (the common case; covers all--1 = every
+           host starts fresh, which is consistent);
+        3. some hosts valid, some with nothing -> abort with a per-host
+           validity report (silently forking restored-vs-fresh training
+           state is exactly the failure this exists to prevent);
+        4. disagreeing steps -> every host re-validates the fleet MIN
+           (hosts whose local newest is newer fall back; a checkpoint
+           truncated on one host can only pull the fleet DOWN to a step
+           everyone holds), a second allgather confirms all hosts hold
+           it, and any failure aborts with the report.
+
+        A final `barrier` pins the agreement before training resumes.
+        Single-process: identical to `restore_latest_valid`.
+        """
+        restored, step = self.restore_latest_valid(state_like, extra_validate)
+        if jax.process_count() == 1:
+            return restored, step
+        from genrec_tpu.parallel.mesh import allgather_host_ints, barrier
+
+        steps = allgather_host_ints([-1 if step is None else step])[:, 0]
+        report = ", ".join(
+            f"p{i}={'none' if s < 0 else int(s)}" for i, s in enumerate(steps)
+        )
+        if (steps < 0).all():
+            barrier("ckpt-consensus-fresh")
+            return None, None
+        if (steps < 0).any():
+            raise RuntimeError(
+                "checkpoint consensus: some hosts have NO valid checkpoint "
+                f"while others do (newest-valid per host: {report}). "
+                "Restoring would fork the replicated training state; "
+                "restore or clear the affected hosts' checkpoint "
+                "directories and relaunch."
+            )
+        target = int(steps.min())
+        ok = 1
+        if step != target:
+            logger.warning(
+                f"checkpoint consensus: local newest-valid step {step} != "
+                f"fleet minimum {target} (per host: {report}) — falling "
+                f"back to step {target}"
+            )
+            try:
+                restored = self.validate_and_restore(state_like, target)
+                if extra_validate is not None:
+                    extra_validate(restored, target)
+                step = target
+                # Steps above the fleet-agreed restore are VALID locally
+                # but abandoned by the consensus decision: retained, orbax
+                # would silently drop every future save keyed below them,
+                # and the stale-step refusal would abort only THIS host
+                # while its peers enter training. Quarantine them like
+                # corrupt steps — on disk for rollback, out of discovery.
+                for s in [s for s in self.all_steps() if s > target]:
+                    logger.warning(
+                        f"checkpoint consensus: quarantining locally-valid "
+                        f"step {s} abandoned by the fleet-agreed restore at "
+                        f"step {target}"
+                    )
+                    self.quarantine(s)
+            except (CheckpointCorruptError, CheckpointMismatchError) as e:
+                logger.error(
+                    f"checkpoint consensus: cannot restore fleet-agreed "
+                    f"step {target} locally: {e}"
+                )
+                ok = 0
+        all_ok = allgather_host_ints([ok])[:, 0]
+        if not (all_ok > 0).all():
+            failed = [f"p{i}" for i, o in enumerate(all_ok) if not o]
+            raise RuntimeError(
+                f"checkpoint consensus: hosts {failed} cannot restore the "
+                f"fleet-agreed step {target} (newest-valid per host: "
+                f"{report}). No step is valid on every host — refusing a "
+                "forked restore; inspect the per-host quarantine dirs."
+            )
+        barrier("ckpt-consensus")
+        return restored, step
 
     def close(self) -> None:
         self._mgr.close()

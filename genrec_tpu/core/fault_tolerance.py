@@ -34,12 +34,14 @@ import dataclasses
 import os
 from typing import Any, Callable
 
+import jax
 import numpy as np
 
 from genrec_tpu.core.checkpoint import (
     CheckpointManager,
     CheckpointMismatchError,
     _refuse_resume_below_stale_steps,
+    stale_refusal_message,
 )
 
 # Version tag for the resume-point record; bump on layout change. The
@@ -128,25 +130,52 @@ def resume_exact(
     Corrupt steps are quarantined by the integrity ladder. A stored
     data seed differing from the configured one is an error: the shuffle
     and packer permutations would diverge and the 'exact' resume would
-    silently replay different data."""
-    if ckpt is None or ckpt.latest_step() is None:
+    silently replay different data.
+
+    Multi-host: the restore runs through
+    `CheckpointManager.restore_latest_valid_consensus` — after each host
+    runs the ladder locally, the fleet allgathers newest-valid steps and
+    every host restores the SAME step (or the job aborts with a per-host
+    validity report), so a checkpoint truncated on one host can never
+    silently fork the replicated training state."""
+    if ckpt is None:
+        return None
+    if jax.process_count() == 1 and ckpt.latest_step() is None:
+        # Multi-process runs must NOT take this shortcut: one host with
+        # an empty directory returning early while another enters the
+        # consensus collectives would deadlock — the mixed
+        # empty/non-empty case is the consensus pass's job to report.
         return None
 
-    def check_format(restored, step):
-        got = int(restored["cursor"]["format"])
-        if got != _FORMAT:
-            raise CheckpointMismatchError(
-                f"step {step}: resume-point format {got} != {_FORMAT} "
-                "(written by a different code version)"
-            )
-
-    restored, step = ckpt.restore_latest_valid(
-        _composite_like(state_like), extra_validate=check_format
-    )
+    restored, step = _restore_resume_point_consensus(ckpt, state_like)
     # Foreign records retained ABOVE the restore point would silently
     # swallow every future save (orbax refuses keys below its latest):
-    # refuse loudly before burning compute on an unsaveable run.
-    _refuse_resume_below_stale_steps(ckpt, step)
+    # refuse loudly before burning compute on an unsaveable run. On a
+    # fleet the decision must be COLLECTIVE — one host raising while its
+    # peers enter training would strand the fleet at its next collective
+    # — so any host's stale steps abort every host.
+    if jax.process_count() > 1:
+        from genrec_tpu.parallel.mesh import allgather_host_ints
+
+        # Another host's consensus pass may have quarantined steps in a
+        # shared directory since this manager last scanned.
+        ckpt.reload()
+        stale = [
+            s for s in ckpt.all_steps() if step is None or s > step
+        ]
+        counts = allgather_host_ints([len(stale)])[:, 0]
+        if counts.max() > 0:
+            report = ", ".join(
+                f"p{i}={int(c)}" for i, c in enumerate(counts)
+            )
+            raise RuntimeError(stale_refusal_message(
+                ckpt.directory,
+                f"stale-step counts per host: {report}; "
+                f"local stale steps {stale}",
+                "resume on any host",
+            ))
+    else:
+        _refuse_resume_below_stale_steps(ckpt, step)
     if restored is None:
         if logger is not None:
             logger.warning("no valid resume point survived the integrity ladder")
@@ -172,6 +201,68 @@ def resume_exact(
             f"(global step {point.global_step}, checkpoint step {step})"
         )
     return point
+
+
+def _restore_resume_point_consensus(ckpt: CheckpointManager, state_like: Any):
+    """Walk the integrity ladder over COMPOSITE resume-point records
+    (consensus on multi-host), rejecting any whose cursor format this
+    code version cannot interpret. The one restore preamble shared by
+    `resume_exact` and `restore_for_eval` — a `_FORMAT` bump edited in
+    only one of them would let eval and resume disagree on which records
+    are restorable."""
+
+    def check_format(restored, step):
+        got = int(restored["cursor"]["format"])
+        if got != _FORMAT:
+            raise CheckpointMismatchError(
+                f"step {step}: resume-point format {got} != {_FORMAT} "
+                "(written by a different code version)"
+            )
+
+    return ckpt.restore_latest_valid_consensus(
+        _composite_like(state_like), extra_validate=check_format
+    )
+
+
+def restore_for_eval(
+    ckpt: CheckpointManager | None,
+    state_like: Any,
+    place_fn: Callable[[Any], Any] | None = None,
+    *,
+    logger=None,
+) -> tuple[Any, int | None]:
+    """Restore the newest valid model state for a PURE EVALUATION run.
+
+    eval_only consumes no training data, so none of `resume_exact`'s
+    exactness preconditions apply: the stored data seed is ignored and
+    stale foreign records above the restore point do not refuse (no save
+    will ever be keyed below them). Walks the step-granular resume
+    points through the integrity ladder first (consensus on multi-host,
+    so every host evaluates the same params); single-process runs fall
+    back to bare pre-PR4 TrainState records. Returns ``(state, step)``,
+    or ``(state_like, None)`` when nothing restores.
+    """
+    if ckpt is None:
+        return state_like, None
+    if jax.process_count() == 1 and ckpt.latest_step() is None:
+        return state_like, None
+
+    restored, step = _restore_resume_point_consensus(ckpt, state_like)
+    if restored is not None:
+        state = restored["state"]
+    elif jax.process_count() == 1:
+        # Pre-PR4 bare TrainState records (epoch-keyed, single-host).
+        restored, step = ckpt.restore_latest_valid(state_like)
+        if restored is None:
+            return state_like, None
+        state = restored
+    else:
+        return state_like, None
+    if place_fn is not None:
+        state = place_fn(state)
+    if logger is not None:
+        logger.info(f"eval_only: restored checkpoint step {step}")
+    return state, step
 
 
 class NonFiniteLossError(RuntimeError):
@@ -242,6 +333,10 @@ class NonFiniteMonitor:
     def _dump(self, global_step: int, epoch: int, metrics: dict, batch) -> str | None:
         if self.dump_dir is None:
             return None
+        # Process-suffixed filename: hosts sharing a filesystem dump the
+        # same flagged step concurrently and must not clobber each
+        # other's post-mortem artifacts.
+        suffix = f"_p{jax.process_index()}" if jax.process_count() > 1 else ""
         os.makedirs(self.dump_dir, exist_ok=True)
         payload: dict[str, np.ndarray] = {
             "global_step": np.asarray(global_step, np.int64),
@@ -257,7 +352,9 @@ class NonFiniteMonitor:
                 # materialized here; the metadata alone still localizes
                 # the bad step for offline repro.
                 continue
-        path = os.path.join(self.dump_dir, f"nonfinite_step{global_step}.npz")
+        path = os.path.join(
+            self.dump_dir, f"nonfinite_step{global_step}{suffix}.npz"
+        )
         np.savez(path, **payload)
         self.dumped.append(path)
         return path

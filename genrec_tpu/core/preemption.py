@@ -8,24 +8,18 @@ window. This guard turns that signal into a final checkpoint + clean
 exit, so `resume_from_checkpoint` continues from the preempted step
 instead of the last periodic save.
 
-Usage (packed trainers — STEP granularity via trainers.packed_loop):
+Usage (every trainer — STEP granularity via trainers.packed_loop):
 
     guard = PreemptionGuard(logger)
     loop = PackedTrainLoop(..., guard=guard, ckpt=ckpt)
-    # run_epoch polls guard.fired after every optimizer step; on fire it
-    # writes a step-granular resume point (TrainState + data-iterator
-    # cursor, core.fault_tolerance.save_resume_point) and returns
-    # preempted=True — resume continues at the exact next batch.
-
-Usage (epoch-granularity trainers — cobra/lcrec/notellm/rqvae):
-
-    guard = PreemptionGuard(logger)
-    for epoch ...:
-        if guard.fired:
-            ckpt.save(epoch - 1, state)  # durable: manager save + wait
-            return ...                   # clean exit -> scheduler restarts
-        for batch ...:
-            ...
+    # run_epoch polls the guard after every optimizer step (fleet-wide
+    # OR on multi-host, loop.fleet_preempted); on fire it writes a
+    # step-granular resume point (TrainState + data-iterator cursor,
+    # core.fault_tolerance.save_resume_point) and returns
+    # preempted=True — resume continues at the exact next batch. Do NOT
+    # hand-roll an epoch-granular `if guard.fired: save(epoch - 1)`
+    # loop: a signal during the final epoch would save nothing (the
+    # hole PR 4 closed for cobra/lcrec).
 
 Polling `fired` is a lock-free Event read — cheap enough for per-step
 checks even at millisecond step times.

@@ -15,6 +15,8 @@ from genrec_tpu.parallel.mesh import (
     metric_allreduce,
     to_host,
     barrier,
+    allgather_host_ints,
+    any_across_processes,
 )
 
 __all__ = [
@@ -26,4 +28,6 @@ __all__ = [
     "metric_allreduce",
     "to_host",
     "barrier",
+    "allgather_host_ints",
+    "any_across_processes",
 ]

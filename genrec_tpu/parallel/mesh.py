@@ -30,7 +30,7 @@ def pin_platform(platform: str) -> None:
     jax.config.update("jax_platforms", platform)
 
 
-def distributed_init() -> None:
+def distributed_init(initialization_timeout: int | None = None) -> None:
     """Initialize multi-host JAX if launched in a multi-process environment.
 
     Replaces `Accelerator(...)` process-group setup (reference
@@ -45,12 +45,124 @@ def distributed_init() -> None:
     env value here — trainers call this before first device use — restores
     the standard semantics. An explicit ``pin_platform()`` call (the
     ``--platform`` flag) takes precedence over the env var.
+
+    The `jax.distributed.initialize` call runs with an explicit
+    ``initialization_timeout`` (``GENREC_DIST_INIT_TIMEOUT`` seconds,
+    default 300) and a missing/late host surfaces as an actionable error
+    naming the coordinator address, this process's id, and the expected
+    process count — not JAX's bare hang-then-stack-trace.
     """
     env_platforms = os.environ.get("JAX_PLATFORMS")
     if env_platforms and not _explicit_platform_pin:
         jax.config.update("jax_platforms", env_platforms)
     if int(os.environ.get("JAX_PROCESS_COUNT", "1")) > 1 or "JAX_COORDINATOR_ADDRESS" in os.environ:
-        jax.distributed.initialize()
+        timeout = (
+            initialization_timeout
+            if initialization_timeout is not None
+            else int(os.environ.get("GENREC_DIST_INIT_TIMEOUT", "300"))
+        )
+        coordinator = os.environ.get("JAX_COORDINATOR_ADDRESS", "<env-detected>")
+        process_id = os.environ.get("JAX_PROCESS_ID", "<env-detected>")
+        process_count = os.environ.get("JAX_PROCESS_COUNT", "<env-detected>")
+        if (jax.config.jax_platforms or "").split(",")[0] in ("", "cpu"):
+            # Multi-process CPU (dev fleets, CI workers): the default CPU
+            # client cannot compile cross-process computations at all.
+            # Unset platform counts too — CPU is the default backend, so
+            # defaulted-CPU fleets hit the same error; the option only
+            # configures the CPU client, so if the fleet turns out to run
+            # an accelerator it is inert. An explicit non-cpu pin skips it.
+            try:
+                jax.config.update("jax_cpu_collectives_implementation", "gloo")
+            except Exception:  # older jaxlib without the option
+                pass
+        # jax reads JAX_COORDINATOR_ADDRESS itself but (as of 0.4.x)
+        # fills process count/id only from cluster auto-detection
+        # (SLURM, GKE) — env-var-driven fleets must pass them explicitly
+        # or initialize fails instantly with "Number of processes must
+        # be defined".
+        kwargs: dict = {"initialization_timeout": timeout}
+        if "JAX_PROCESS_COUNT" in os.environ:
+            kwargs["num_processes"] = int(os.environ["JAX_PROCESS_COUNT"])
+        if "JAX_PROCESS_ID" in os.environ:
+            kwargs["process_id"] = int(os.environ["JAX_PROCESS_ID"])
+        # An UNREACHABLE coordinator must be caught HERE: past this
+        # point the XLA distributed client LOG(FATAL)s the whole process
+        # on its registration deadline (no Python exception to wrap), so
+        # non-coordinator processes retry a plain TCP connect against
+        # the same deadline first and fail with an actionable error.
+        if (
+            coordinator != "<env-detected>"
+            and kwargs.get("process_id", 0) != 0
+        ):
+            # One budget overall: the connect wait and initialize share
+            # the deadline, so a slow coordinator cannot stretch the
+            # operator's wait to 2x the configured timeout.
+            kwargs["initialization_timeout"] = _await_coordinator(
+                coordinator, timeout, process_id, process_count
+            )
+        try:
+            jax.distributed.initialize(**kwargs)
+        except Exception as e:
+            # Only timeout-shaped failures get the missing-host
+            # narrative; anything else (double initialize, bad flag) is
+            # instant and must not send the operator chasing networking.
+            msg = str(e).lower()
+            if not any(t in msg for t in ("deadline", "timed out", "timeout")):
+                raise
+            raise RuntimeError(
+                _init_failure_message(timeout, coordinator, process_id,
+                                      process_count)
+            ) from e
+
+
+def _init_failure_message(timeout, coordinator, process_id, process_count):
+    return (
+        f"jax.distributed.initialize() failed after {timeout}s "
+        f"(coordinator {coordinator}, process id {process_id} of "
+        f"{process_count} expected). Most likely one host never "
+        "started or cannot reach the coordinator: check that every "
+        "worker launched, that JAX_COORDINATOR_ADDRESS is routable "
+        "from all hosts, and that JAX_PROCESS_COUNT matches the "
+        "actual fleet size. Raise GENREC_DIST_INIT_TIMEOUT for "
+        "slow-provisioning fleets."
+    )
+
+
+def _await_coordinator(coordinator: str, timeout: int,
+                       process_id, process_count) -> int:
+    """Retry a bare TCP connect to the coordinator until it accepts or
+    the initialization deadline passes (workers legitimately start
+    before the coordinator — refused connects keep retrying). Returns
+    the whole seconds REMAINING of ``timeout`` (at least 1) for the
+    caller to hand to `jax.distributed.initialize`."""
+    import socket
+    import time
+
+    host, _, port = coordinator.rpartition(":")
+    if not host or not port.isdigit():
+        # A malformed address is a config error, not a timeout: fail
+        # instantly with the same actionable narrative instead of a raw
+        # int() traceback from the connect loop.
+        raise RuntimeError(
+            f"JAX_COORDINATOR_ADDRESS {coordinator!r} is not host:port. "
+            + _init_failure_message(timeout, coordinator, process_id,
+                                    process_count)
+        )
+    deadline = time.monotonic() + timeout
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise RuntimeError(
+                _init_failure_message(timeout, coordinator, process_id,
+                                      process_count)
+            )
+        try:
+            with socket.create_connection(
+                (host, int(port)), timeout=min(5.0, remaining)
+            ):
+                return max(1, int(deadline - time.monotonic()))
+        except OSError:
+            time.sleep(min(0.5, max(0.0, deadline - time.monotonic())))
 
 
 def make_mesh(shape: Mapping[str, int] | None = None, devices=None) -> Mesh:
@@ -142,3 +254,36 @@ def barrier(name: str = "barrier") -> None:
         from jax.experimental import multihost_utils
 
         multihost_utils.sync_global_devices(name)
+
+
+def allgather_host_ints(values) -> np.ndarray:
+    """Gather a small per-process int vector from every process.
+
+    Returns a ``(process_count, len(values))`` int64 array whose row p is
+    process p's vector — the communication primitive under checkpoint
+    consensus (each host contributes its locally-valid checkpoint steps)
+    and preemption agreement. Every process must call this in lockstep
+    with an equal-length vector. Single-process: a trivial (1, N) reshape,
+    no collective.
+    """
+    row = np.asarray(list(values), np.int64).reshape(-1)
+    if jax.process_count() == 1:
+        return row[None, :]
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(row))
+
+
+def any_across_processes(flag: bool) -> bool:
+    """True iff ``flag`` is True on AT LEAST one process.
+
+    The multi-host preemption agreement primitive: every host polls its
+    local PreemptionGuard but acts only on the fleet-wide OR, so all hosts
+    write their preemption resume point at the SAME global step instead of
+    forking (one host checkpointing step N while another runs on to N+1
+    would deadlock the next collective and fork the saved state).
+    Single-process: returns ``flag`` with no collective.
+    """
+    if jax.process_count() == 1:
+        return bool(flag)
+    return bool(allgather_host_ints([1 if flag else 0]).max())

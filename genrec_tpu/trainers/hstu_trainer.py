@@ -229,9 +229,7 @@ def train(
         rows_per_step=batch_size, row_len=max_seq_len, seed=seed,
         pack_sequences=pack_sequences, repack=repack, train_arrays=train_arrays,
         wandb_log_interval=wandb_log_interval,
-        nonfinite_dump_dir=(
-            os.path.join(save_dir_root, "nonfinite") if save_dir_root else None
-        ),
+        save_dir_root=save_dir_root,
     )
     start_epoch, start_batch, global_step = 0, 0, 0
     if resume_from_checkpoint:

@@ -21,10 +21,9 @@ import numpy as np
 import optax
 
 from genrec_tpu import configlib
-from genrec_tpu.core import chaos
 from genrec_tpu.core.harness import make_train_step
 from genrec_tpu.core.logging import Tracker, setup_logger
-from genrec_tpu.core.profiling import ProfileWindow, StepTimer, log_epoch_perf
+from genrec_tpu.core.profiling import ProfileWindow
 from genrec_tpu.core.lora import lora_init, lora_merge, lora_param_count
 from genrec_tpu.core.state import TrainState
 from genrec_tpu.data.batching import (
@@ -586,79 +585,68 @@ def train(
             )
         )
 
-    from genrec_tpu.core.checkpoint import BestTracker, CheckpointManager, maybe_resume, save_params
+    from genrec_tpu.core.checkpoint import BestTracker, CheckpointManager, save_params
+    from genrec_tpu.core.fault_tolerance import restore_for_eval
+    from genrec_tpu.core.preemption import PreemptionGuard
+    from genrec_tpu.trainers.packed_loop import PackedTrainLoop
 
     ckpt = CheckpointManager(os.path.join(save_dir_root, "checkpoints")) if save_dir_root else None
-
-    # eval_only restores the latest checkpoint (the reference loads a
-    # trained model for eval_only, lcrec_trainer.py:358-364); resume picks
-    # up mid-training.
-    start_epoch, global_step = 0, 0
-    if eval_only or resume_from_checkpoint:
-        state, start_epoch, global_step = maybe_resume(
-            ckpt, state, place_state  # restored runs keep the TP layout
-        )
-        if start_epoch:
-            logger.info(f"resumed after epoch {start_epoch - 1} (step {global_step})")
-        elif eval_only:
-            logger.warning("eval_only without a checkpoint: evaluating the INITIAL model")
-
-    if eval_only:
-        m = evaluate(gen_fn, params_of(state.params), valid_arrays, eval_batch_size, mesh, num_codebooks)
-        logger.info("eval_only " + ", ".join(f"{k}={v:.4f}" for k, v in m.items()))
-        tracker.finish()
-        return m, m
-
-    best = BestTracker(save_dir_root)
     prof = ProfileWindow(
         os.path.join(save_dir_root, "profile") if save_dir_root else "",
         profile_steps,
     )
-    from genrec_tpu.core.preemption import PreemptionGuard
-
     guard = PreemptionGuard(logger)
-    from genrec_tpu.core.fault_tolerance import NonFiniteMonitor
+    loop = PackedTrainLoop(
+        logger=logger, tracker=tracker, prof=prof, mesh=mesh,
+        guard=guard, ckpt=ckpt,
+        rows_per_step=batch_size, row_len=max_text_len, seed=seed,
+        pack_sequences=False, train_arrays=train_arrays,
+        wandb_log_interval=wandb_log_interval,
+        save_dir_root=save_dir_root,
+    )
 
-    # Host policy for the jitted non-finite guard (core.harness): dump
-    # the offending batch, abort after N consecutive skips — without
-    # this, a structurally diverging run would silently freeze.
-    nonfinite = NonFiniteMonitor.for_run(save_dir_root, logger)
+    # eval_only restores the latest checkpoint (the reference loads a
+    # trained model for eval_only, lcrec_trainer.py:358-364) WITHOUT the
+    # exact-resume preconditions — a pure evaluation consumes no training
+    # data, so a different data seed or a pre-PR4 record must not refuse;
+    # resume picks up mid-training through the step-granular resume point.
+    start_epoch, start_batch, global_step = 0, 0, 0
+    if eval_only:
+        state, ckpt_step = restore_for_eval(
+            ckpt, state, place_state, logger=logger  # keep the TP layout
+        )
+        if ckpt_step is None:
+            logger.warning("eval_only without a checkpoint: evaluating the INITIAL model")
+    elif resume_from_checkpoint:
+        state, start_epoch, start_batch, global_step = loop.resume(
+            state, place_state  # restored runs keep the TP layout
+        )
+
+    if eval_only:
+        m = evaluate(gen_fn, params_of(state.params), valid_arrays, eval_batch_size, mesh, num_codebooks)
+        logger.info("eval_only " + ", ".join(f"{k}={v:.4f}" for k, v in m.items()))
+        loop.shutdown()
+        return m, m
+
+    best = BestTracker(save_dir_root)
     for epoch in range(start_epoch, epochs):
-        if guard.fired:
-            # Preempted (SIGTERM grace window): persist the last
-            # COMPLETED epoch and exit; resume_from_checkpoint
-            # continues from here instead of the last periodic save.
-            if ckpt is not None and epoch > start_epoch:
-                ckpt.save(epoch - 1, state)
-                ckpt.close()
-            guard.close()
-            tracker.finish()
-            logger.info(f"preempted: exiting before epoch {epoch}")
+        res = loop.run_epoch(
+            state, step_fn, epoch, global_step,
+            start_batch=start_batch if epoch == start_epoch else 0,
+        )
+        state, global_step = res.state, res.global_step
+        if res.preempted:
+            # SIGTERM/SIGINT grace window: the loop already wrote a
+            # durable mid-epoch resume point (even mid-FINAL-epoch — the
+            # hole the old epoch-granular guard left open); exit cleanly
+            # so the scheduler restarts us with resume_from_checkpoint.
+            loop.shutdown(preempted_epoch=epoch)
             return {}, {}
-        epoch_loss, n_batches = None, 0
-        timer = StepTimer(batch_size, skip_first=1 if epoch == start_epoch else 0)
-        for sharded, _ in prefetch_to_device(
-            batch_iterator(train_arrays, batch_size, shuffle=True,
-                           seed=seed, epoch=epoch, drop_last=True),
-            mesh,
-        ):
-            state, m = step_fn(state, sharded)
-            nonfinite.observe(global_step + 1, epoch, m, sharded)
-            epoch_loss = m["loss"] if epoch_loss is None else epoch_loss + m["loss"]
-            timer.tick()
-            n_batches += 1
-            global_step += 1
-            prof.tick(global_step)
-            if global_step % wandb_log_interval == 0:
-                tracker.log({"global_step": global_step, "train/loss": float(m["loss"])})
-        nonfinite.flush()
-        log_epoch_perf(logger, tracker, epoch, epoch_loss, n_batches, timer)
-        # Fault-injection hook (core.chaos): lets tests deliver a real
-        # SIGTERM at a chosen epoch; no-op outside a chaos plan.
-        chaos.maybe_kill(epoch=epoch)
 
         if ckpt is not None and (epoch + 1) % save_every_epoch == 0:
-            ckpt.save(epoch, state)
+            # Epoch-boundary resume point: cursor = (next epoch, batch 0).
+            loop.save(state, epoch=epoch + 1, next_batch=0,
+                      global_step=global_step)
 
         if do_eval and (epoch + 1) % eval_every_epoch == 0:
             m = evaluate(gen_fn, params_of(state.params), valid_arrays, eval_batch_size, mesh, num_codebooks)
@@ -668,6 +656,10 @@ def train(
             tracker.log({"epoch": epoch, **{f"eval/{k}": v for k, v in m.items()}})
             best.update(m["Recall@10"], state.params)
 
+    # Unconditional final resume point: closes the old hole where a
+    # save_every_epoch cadence never firing left a completed run with
+    # NOTHING on disk to resume from.
+    loop.save(state, epoch=epochs, next_batch=0, global_step=global_step)
     final_trainable = (
         best.best_params(like=state.params) if test_on_best else None
     )
@@ -695,10 +687,7 @@ def train(
         # Best tracker stores the TRAINABLE tree (lora or full); persist the
         # merged model too for direct consumption.
         save_params(os.path.join(save_dir_root, "final_model"), final_params)
-    if ckpt is not None:
-        ckpt.close()
-    prof.close()
-    tracker.finish()
+    loop.shutdown()
     return valid_metrics, test_metrics
 
 

@@ -23,10 +23,9 @@ import numpy as np
 import optax
 
 from genrec_tpu import configlib
-from genrec_tpu.core import chaos
 from genrec_tpu.core.harness import make_train_step
 from genrec_tpu.core.logging import Tracker, setup_logger
-from genrec_tpu.core.profiling import ProfileWindow, StepTimer, log_epoch_perf
+from genrec_tpu.core.profiling import ProfileWindow
 from genrec_tpu.core.state import TrainState
 from genrec_tpu.data.batching import batch_iterator, prefetch_to_device
 from genrec_tpu.data.notellm_pairs import NoteLLMPairData
@@ -164,72 +163,56 @@ def train(
     state = replicate(mesh, TrainState.create(params, optimizer, state_rng))
     embed_fn = make_embed_fn(model)
 
-    from genrec_tpu.core.checkpoint import BestTracker, CheckpointManager, maybe_resume
+    from genrec_tpu.core.checkpoint import BestTracker, CheckpointManager
+    from genrec_tpu.core.preemption import PreemptionGuard
+    from genrec_tpu.trainers.packed_loop import PackedTrainLoop
 
     ckpt = (
         CheckpointManager(os.path.join(save_dir_root, "checkpoints"))
         if save_dir_root
         else None
     )
-    start_epoch, global_step = 0, 0
-    if resume_from_checkpoint:
-        state, start_epoch, global_step = maybe_resume(
-            ckpt, state, lambda s: replicate(mesh, s)
-        )
-        if start_epoch:
-            logger.info(f"resumed after epoch {start_epoch - 1}")
-
     best = BestTracker(save_dir_root, metric=f"top{eval_topk}_acc")
     prof = ProfileWindow(
         os.path.join(save_dir_root, "profile") if save_dir_root else "",
         profile_steps,
     )
-    from genrec_tpu.core.preemption import PreemptionGuard
-
     guard = PreemptionGuard(logger)
-    from genrec_tpu.core.fault_tolerance import NonFiniteMonitor
-
-    # Host policy for the jitted non-finite guard (core.harness): dump
-    # the offending batch, abort after N consecutive skips — without
-    # this, a structurally diverging run would silently freeze.
-    nonfinite = NonFiniteMonitor.for_run(save_dir_root, logger)
+    loop = PackedTrainLoop(
+        logger=logger, tracker=tracker, prof=prof, mesh=mesh,
+        guard=guard, ckpt=ckpt,
+        rows_per_step=batch_pairs, row_len=max_text_len, seed=seed,
+        pack_sequences=False, train_arrays=train_arrays,
+        # 2 rows per pair-unit row: seq/s counts sequences, like every
+        # other trainer.
+        examples_per_row=2.0,
+        wandb_log_interval=wandb_log_interval,
+        save_dir_root=save_dir_root,
+    )
+    start_epoch, start_batch, global_step = 0, 0, 0
+    if resume_from_checkpoint:
+        # Step-granular exact resume: continues at the exact next batch
+        # of a possibly mid-epoch resume point.
+        state, start_epoch, start_batch, global_step = loop.resume(
+            state, lambda s: replicate(mesh, s)
+        )
     for epoch in range(start_epoch, epochs):
-        if guard.fired:
-            # Preempted (SIGTERM grace window): persist the last
-            # COMPLETED epoch and exit; resume_from_checkpoint
-            # continues from here instead of the last periodic save.
-            if ckpt is not None and epoch > start_epoch:
-                ckpt.save(epoch - 1, state)
-                ckpt.close()
-            guard.close()
-            tracker.finish()
-            logger.info(f"preempted: exiting before epoch {epoch}")
+        res = loop.run_epoch(
+            state, step_fn, epoch, global_step,
+            start_batch=start_batch if epoch == start_epoch else 0,
+        )
+        state, global_step = res.state, res.global_step
+        if res.preempted:
+            # SIGTERM/SIGINT grace window: the loop already wrote a
+            # durable mid-epoch resume point; exit cleanly so the
+            # scheduler restarts us with resume_from_checkpoint.
+            loop.shutdown(preempted_epoch=epoch)
             return {}
-        epoch_loss, n_batches = None, 0
-        # 2 rows per pair: count sequences, like every other trainer.
-        timer = StepTimer(batch_pairs * 2, skip_first=1 if epoch == start_epoch else 0)
-        for sharded, _ in prefetch_to_device(
-            batch_iterator(train_arrays, batch_pairs, shuffle=True,
-                           seed=seed, epoch=epoch, drop_last=True),
-            mesh,
-        ):
-            state, m = step_fn(state, sharded)
-            nonfinite.observe(global_step + 1, epoch, m, sharded)
-            epoch_loss = m["loss"] if epoch_loss is None else epoch_loss + m["loss"]
-            timer.tick()
-            n_batches += 1
-            global_step += 1
-            prof.tick(global_step)
-            if global_step % wandb_log_interval == 0:
-                tracker.log({"global_step": global_step, "train/loss": float(m["loss"])})
-        nonfinite.flush()
-        log_epoch_perf(logger, tracker, epoch, epoch_loss, n_batches, timer)
-        # Fault-injection hook (core.chaos): lets tests deliver a real
-        # SIGTERM at a chosen epoch; no-op outside a chaos plan.
-        chaos.maybe_kill(epoch=epoch)
 
         if ckpt is not None and (epoch + 1) % save_every_epoch == 0:
-            ckpt.save(epoch, state)
+            # Epoch-boundary resume point: cursor = (next epoch, batch 0).
+            loop.save(state, epoch=epoch + 1, next_batch=0,
+                      global_step=global_step)
 
         if do_eval and (epoch + 1) % eval_every_epoch == 0:
             m = evaluate_retrieval(
@@ -249,11 +232,10 @@ def train(
     )
     logger.info("test " + ", ".join(f"{k}={v:.4f}" for k, v in test_m.items()))
     tracker.log({f"test/{k}": v for k, v in test_m.items()})
-    if ckpt is not None:
-        ckpt.save(epochs - 1, state)
-        ckpt.close()
-    prof.close()
-    tracker.finish()
+    # Unconditional final resume point: the trained state is durable even
+    # off the save_every_epoch cadence.
+    loop.save(state, epoch=epochs, next_batch=0, global_step=global_step)
+    loop.shutdown()
     return test_m
 
 

@@ -1,5 +1,7 @@
-"""Shared packed-trainer loop: one implementation of the plumbing that was
-triplicated (with drift) across the sasrec/hstu/tiger trainers —
+"""Shared step-granular train loop: one implementation of the plumbing
+that was triplicated (with drift) across the sasrec/hstu/tiger trainers
+and hand-rolled (epoch-granular, with preemption holes) in the
+cobra/lcrec/notellm/rqvae trainers —
 
 - the per-epoch repack closure (epoch-seeded `pack_examples` so example
   co-location re-mixes like the padded layout's per-epoch permutation);
@@ -22,7 +24,22 @@ once instead of three times:
   code path;
 - the `NonFiniteMonitor` consumes the jitted non-finite guard's metrics
   (one step deferred — no dispatch stall), dumps offending batches, and
-  aborts after N consecutive skipped steps.
+  aborts after N consecutive skipped steps;
+- multi-host preemption agreement: each host polls its local
+  `PreemptionGuard`, but the loop acts on the fleet-wide OR
+  (`parallel.any_across_processes`) so every host writes its resume
+  point at the SAME global step — one host checkpointing step N while
+  another runs on to N+1 would deadlock the next collective and fork
+  the saved state. Single-process runs short-circuit to the local flag
+  (no collective); multi-host runs poll the collective OR every
+  ``preempt_poll_interval`` steps (lockstep on every host) so the hot
+  loop never blocks on an every-step allgather.
+
+The epoch-granularity trainers plug in through three knobs:
+``pack_sequences=False`` + ``train_arrays`` (fixed padded layout),
+``step_log`` (trainer-specific wandb metric dicts), and ``step_hook`` +
+``run_epoch(max_steps=...)`` (rqvae's iteration-gated eval/save cadence
+and iteration-count stop).
 """
 
 from __future__ import annotations
@@ -30,6 +47,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Callable
 
+import jax
 import jax.numpy as jnp
 
 from genrec_tpu.core import chaos
@@ -60,7 +78,12 @@ class PackedTrainLoop:
     fixed padded layout. ``rows_per_step`` is the batch rows consumed per
     optimizer step (batch_size, times grad-accum for TIGER);
     ``tokens_scale`` rescales the step's mean ``real_tokens`` metric back
-    to whole-step tokens under accumulation.
+    to whole-step tokens under accumulation. ``examples_per_row``
+    rescales seq/s for layouts whose rows hold a fixed number of
+    examples (NoteLLM: 2 per pair-unit row). ``step_log(metrics,
+    global_step) -> dict`` replaces the default wandb-interval payload;
+    ``step_hook(state, epoch, next_batch, global_step)`` runs after
+    every step (rqvae's iteration-gated eval/save).
     """
 
     def __init__(
@@ -79,9 +102,13 @@ class PackedTrainLoop:
         repack: Callable[[int], tuple[dict, Any]] | None = None,
         train_arrays: dict | None = None,
         tokens_scale: float = 1.0,
+        examples_per_row: float = 1.0,
         wandb_log_interval: int = 100,
-        nonfinite_dump_dir: str | None = None,
+        save_dir_root: str | None = None,
         max_consecutive_nonfinite: int = 3,
+        step_log: Callable[[dict, int], dict] | None = None,
+        step_hook: Callable[[Any, int, int, int], None] | None = None,
+        preempt_poll_interval: int = 8,
     ):
         if pack_sequences and repack is None:
             raise ValueError("pack_sequences=True needs a repack closure")
@@ -99,9 +126,13 @@ class PackedTrainLoop:
         self.pack_sequences = pack_sequences
         self._repack = repack
         self.tokens_scale = tokens_scale
+        self.examples_per_row = examples_per_row
         self.wandb_log_interval = wandb_log_interval
-        self.monitor = NonFiniteMonitor(
-            nonfinite_dump_dir, max_consecutive_nonfinite, logger
+        self.step_log = step_log
+        self.step_hook = step_hook
+        self.preempt_poll_interval = max(1, int(preempt_poll_interval))
+        self.monitor = NonFiniteMonitor.for_run(
+            save_dir_root, logger, max_consecutive_nonfinite
         )
         self._ran_epoch = False
         self._arrays = train_arrays
@@ -137,7 +168,32 @@ class PackedTrainLoop:
         if self.pack_sequences:
             rep = self.pack_report
             return self.rows_per_step * rep.n_examples / rep.n_rows
-        return float(self.rows_per_step)
+        return float(self.rows_per_step) * self.examples_per_row
+
+    def fleet_preempted(self, global_step: int | None = None) -> bool:
+        """Fleet-wide preemption agreement: True iff ANY host's guard
+        latched. Acting on the OR keeps all hosts preempting at the same
+        global step instead of forking. Single-process: the local flag,
+        no collective. Multi-host: a host-blocking allgather every step
+        would serialize the hot loop against the fleet, so with a
+        ``global_step`` the collective only runs every
+        ``preempt_poll_interval`` steps — global_step advances in
+        lockstep on every host, so all hosts poll (and so agree) at the
+        same steps, and a latched signal is acted on within the interval
+        (well inside any preemption grace window). Callers without a
+        step (epoch boundaries) always poll."""
+        if self.guard is None:
+            return False
+        if jax.process_count() == 1:
+            return bool(self.guard.fired)
+        if (
+            global_step is not None
+            and global_step % self.preempt_poll_interval != 0
+        ):
+            return False
+        from genrec_tpu.parallel import any_across_processes
+
+        return any_across_processes(self.guard.fired)
 
     # -- resume + checkpoint -----------------------------------------------
 
@@ -194,11 +250,14 @@ class PackedTrainLoop:
     # -- the epoch ---------------------------------------------------------
 
     def run_epoch(self, state, step_fn, epoch: int, global_step: int,
-                  start_batch: int = 0) -> EpochResult:
+                  start_batch: int = 0,
+                  max_steps: int | None = None) -> EpochResult:
         """One epoch (or its remainder from ``start_batch``), polling the
-        guard per step. Returns with ``preempted=True`` after writing a
-        durable mid-epoch resume point."""
-        if self.guard is not None and self.guard.fired:
+        guard per step (fleet-wide OR on multi-host). Returns with
+        ``preempted=True`` after writing a durable mid-epoch resume
+        point. ``max_steps`` stops before the batch that would push
+        ``global_step`` past it (rqvae's iteration mode)."""
+        if self.fleet_preempted():
             # Fired between epochs (eval/checkpoint window): the cursor
             # is simply "this epoch, batch start_batch".
             self._preempt(state, epoch, start_batch, global_step)
@@ -221,6 +280,8 @@ class PackedTrainLoop:
             ),
             self.mesh,
         ):
+            if max_steps is not None and global_step >= max_steps:
+                break
             state, m = step_fn(state, sharded)
             # Guard-skipped steps contribute 0 to the epoch mean — one
             # NaN batch must not turn the whole epoch summary NaN (NaN*0
@@ -240,15 +301,25 @@ class PackedTrainLoop:
             self.prof.tick(global_step)
             if global_step % self.wandb_log_interval == 0:
                 self.tracker.log(
-                    {"global_step": global_step, "train/loss": float(m["loss"])}
+                    self.step_log(m, global_step)
+                    if self.step_log is not None
+                    else {"global_step": global_step,
+                          "train/loss": float(m["loss"])}
                 )
             # Deferred non-finite policy: checks the PREVIOUS step's flag.
             self.monitor.observe(global_step, epoch, m, sharded)
+            if self.step_hook is not None:
+                self.step_hook(state, epoch, consumed, global_step)
             chaos.maybe_kill(step=global_step)
-            if self.guard is not None and self.guard.fired:
+            if self.fleet_preempted(global_step):
                 self._preempt(state, epoch, consumed, global_step)
                 return EpochResult(state, global_step, True, n_batches)
         self.monitor.flush()
+        # Fault-injection hook (core.chaos): deliver a real signal in the
+        # between-epoch eval/checkpoint window — the top-of-epoch
+        # preemption branch above is what catches it on the NEXT call.
+        # One hook here covers all seven trainers; no-op outside a plan.
+        chaos.maybe_kill(epoch=epoch)
         if n_batches:
             # Zero batches = an epoch resumed exactly at its end (the
             # preemption latched after the final batch): nothing ran, so

@@ -25,12 +25,11 @@ import numpy as np
 import optax
 
 from genrec_tpu import configlib
-from genrec_tpu.core import chaos
 from genrec_tpu.core.harness import make_train_step
 from genrec_tpu.core.logging import Tracker, setup_logger
-from genrec_tpu.core.profiling import ProfileWindow, StepTimer, log_epoch_perf
+from genrec_tpu.core.profiling import ProfileWindow
 from genrec_tpu.core.state import TrainState
-from genrec_tpu.data.batching import batch_iterator, pad_to_batch, prefetch_to_device
+from genrec_tpu.data.batching import pad_to_batch
 from genrec_tpu.data.items import ItemEmbeddingData, SyntheticItemEmbeddings
 from genrec_tpu.data.sem_ids import save_sem_ids
 from genrec_tpu.models.rqvae import (
@@ -217,86 +216,73 @@ def train(
         out = model.apply({"params": p}, x, gumbel_temperature, training=False)
         return out.loss, out.reconstruction_loss, out.rqvae_loss
 
-    from genrec_tpu.core.checkpoint import CheckpointManager, maybe_resume
+    from genrec_tpu.core.checkpoint import CheckpointManager
+    from genrec_tpu.core.preemption import PreemptionGuard
+    from genrec_tpu.trainers.packed_loop import PackedTrainLoop
 
     ckpt = CheckpointManager(os.path.join(save_dir_root, "checkpoints")) if save_dir_root else None
-    start_epoch, global_step = 0, 0
-    if resume_from_checkpoint:
-        state, start_epoch, global_step = maybe_resume(
-            ckpt, state, lambda s: replicate(mesh, s)
-        )
-        if start_epoch:
-            logger.info(f"resumed after epoch {start_epoch - 1} (step {global_step})")
     prof = ProfileWindow(
         os.path.join(save_dir_root, "profile") if save_dir_root else "",
         profile_steps,
     )
-    from genrec_tpu.core.preemption import PreemptionGuard
-
     guard = PreemptionGuard(logger)
-    from genrec_tpu.core.fault_tolerance import NonFiniteMonitor
 
-    # Host policy for the jitted non-finite guard (core.harness): dump
-    # the offending batch, abort after N consecutive skips — without
-    # this, a structurally diverging run would silently freeze.
-    nonfinite = NonFiniteMonitor.for_run(save_dir_root, logger)
+    def step_log(m, g):
+        return {
+            "global_step": g,
+            "total_loss": float(m["loss"]),
+            "reconstruction_loss": float(m["reconstruction_loss"]),
+            "rqvae_loss": float(m["rqvae_loss"]),
+            "p_unique_ids": float(m["p_unique_ids"]),
+            "learning_rate": float(schedule(g)),
+        }
+
+    def step_hook(hook_state, epoch, next_batch, g):
+        if use_epochs:
+            return
+        # Iteration mode gates eval/save on ITERATIONS (reference
+        # rqvae_trainer.py:393,419), not derived epochs.
+        if do_eval and g % eval_every == 0:
+            le = eval_losses(hook_state.params, jnp.asarray(eval_x))
+            cr, n, uniq = compute_collision_rate(model, hook_state.params, all_x)
+            logger.info(
+                f"iter {g} eval loss {float(le[0]):.4f} "
+                f"collision {cr:.4f} ({uniq}/{n})"
+            )
+        if g % save_model_every == 0:
+            loop.save(hook_state, epoch=epoch, next_batch=next_batch,
+                      global_step=g)
+
+    loop = PackedTrainLoop(
+        logger=logger, tracker=tracker, prof=prof, mesh=mesh,
+        guard=guard, ckpt=ckpt,
+        rows_per_step=batch_size, row_len=1, seed=seed,
+        pack_sequences=False, train_arrays={"x": train_x},
+        wandb_log_interval=wandb_log_interval,
+        save_dir_root=save_dir_root,
+        step_log=step_log, step_hook=step_hook,
+    )
+    start_epoch, start_batch, global_step = 0, 0, 0
+    if resume_from_checkpoint:
+        # Step-granular exact resume (TrainState + data cursor through
+        # the integrity ladder): continues at the exact next batch of a
+        # possibly mid-epoch resume point.
+        state, start_epoch, start_batch, global_step = loop.resume(
+            state, lambda s: replicate(mesh, s)
+        )
     for epoch in range(start_epoch, epochs):
-        if guard.fired:
-            # Preempted (SIGTERM grace window): persist the last
-            # COMPLETED epoch and exit; resume_from_checkpoint
-            # continues from here instead of the last periodic save.
-            if ckpt is not None and epoch > start_epoch:
-                ckpt.save(epoch - 1, state)
-                ckpt.close()
-            guard.close()
-            tracker.finish()
-            logger.info(f"preempted: exiting before epoch {epoch}")
+        res = loop.run_epoch(
+            state, step_fn, epoch, global_step,
+            start_batch=start_batch if epoch == start_epoch else 0,
+            max_steps=None if use_epochs else total_steps,
+        )
+        state, global_step = res.state, res.global_step
+        if res.preempted:
+            # SIGTERM/SIGINT grace window: the loop already wrote a
+            # durable mid-epoch resume point; exit cleanly so the
+            # scheduler restarts us with resume_from_checkpoint.
+            loop.shutdown(preempted_epoch=epoch)
             return state.params, None
-        epoch_loss, n_batches = None, 0
-        timer = StepTimer(batch_size, skip_first=1 if epoch == start_epoch else 0)
-        for sharded, _ in prefetch_to_device(
-            batch_iterator({"x": train_x}, batch_size, shuffle=True,
-                           seed=seed, epoch=epoch, drop_last=True),
-            mesh,
-        ):
-            if global_step >= total_steps:
-                break
-            state, m = step_fn(state, sharded)
-            nonfinite.observe(global_step + 1, epoch, m, sharded)
-            epoch_loss = m["loss"] if epoch_loss is None else epoch_loss + m["loss"]
-            timer.tick()
-            n_batches += 1
-            global_step += 1
-            prof.tick(global_step)
-            if not use_epochs:
-                # Iteration mode gates eval/save on ITERATIONS (reference
-                # rqvae_trainer.py:393,419), not derived epochs.
-                if do_eval and global_step % eval_every == 0:
-                    le = eval_losses(state.params, jnp.asarray(eval_x))
-                    cr, n, uniq = compute_collision_rate(model, state.params, all_x)
-                    logger.info(
-                        f"iter {global_step} eval loss {float(le[0]):.4f} "
-                        f"collision {cr:.4f} ({uniq}/{n})"
-                    )
-                if ckpt is not None and global_step % save_model_every == 0:
-                    ckpt.save(epoch, state)
-            if global_step % wandb_log_interval == 0:
-                tracker.log(
-                    {
-                        "global_step": global_step,
-                        "total_loss": float(m["loss"]),
-                        "reconstruction_loss": float(m["reconstruction_loss"]),
-                        "rqvae_loss": float(m["rqvae_loss"]),
-                        "p_unique_ids": float(m["p_unique_ids"]),
-                        "learning_rate": float(schedule(global_step)),
-                    }
-                )
-
-        nonfinite.flush()
-        log_epoch_perf(logger, tracker, epoch, epoch_loss, n_batches, timer)
-        # Fault-injection hook (core.chaos): lets tests deliver a real
-        # SIGTERM at a chosen epoch; no-op outside a chaos plan.
-        chaos.maybe_kill(epoch=epoch)
 
         if use_epochs and do_eval and ((epoch + 1) % eval_every == 0 or epoch + 1 == epochs):
             le = eval_losses(state.params, jnp.asarray(eval_x))
@@ -319,17 +305,19 @@ def train(
             (use_epochs and ((epoch + 1) % save_model_every == 0 or epoch + 1 == epochs))
             or (not use_epochs and epoch + 1 == epochs)
         ):
-            ckpt.save(epoch, state)  # full TrainState: one resumable format everywhere
+            # Epoch-boundary resume point (cursor = next epoch, batch 0):
+            # one resumable step-keyed format everywhere, and the
+            # unconditional final-epoch save means even a signal during
+            # the LAST epoch's eval window leaves a resumable record.
+            loop.save(state, epoch=epoch + 1, next_batch=0,
+                      global_step=global_step)
 
     # Export the portable sem-id artifact for downstream stages.
     sem_ids = compute_sem_ids(model, state.params, all_x)
     out_path = sem_ids_path or os.path.join(save_dir_root, "sem_ids.npz")
     save_sem_ids(out_path, sem_ids, vae_codebook_size)
     logger.info(f"exported semantic ids -> {out_path}")
-    if ckpt is not None:
-        ckpt.close()
-    prof.close()
-    tracker.finish()
+    loop.shutdown()
     return state.params, sem_ids
 
 

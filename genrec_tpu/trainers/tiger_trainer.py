@@ -202,9 +202,7 @@ def train(
         # back to whole-step counts.
         tokens_scale=float(gradient_accumulate_every),
         wandb_log_interval=wandb_log_interval,
-        nonfinite_dump_dir=(
-            os.path.join(save_dir_root, "nonfinite") if save_dir_root else None
-        ),
+        save_dir_root=save_dir_root,
     )
     # Accessing the report here materializes the epoch-0 pack (the jitted
     # loss closure below needs its rates before any resume decision), so a

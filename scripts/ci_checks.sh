@@ -8,6 +8,12 @@
 #   tpu_kernel_check.py    — Pallas kernels at trainer shapes (TPU only)
 #   test_fault_tolerance   — chaos suite: SIGTERM mid-epoch + exact resume,
 #                            checkpoint integrity ladder, non-finite guard
+#   test_multihost         — 2-process jax.distributed chaos: consensus
+#                            restore, coordinated commit (smoke: the
+#                            consensus case only)
+#   no-legacy-resume       — no trainer may import the epoch-keyed
+#                            maybe_resume (every trainer resumes
+#                            step-exactly through fault_tolerance)
 #
 # Usage:
 #   scripts/ci_checks.sh            # full shapes, current backend; runs the
@@ -47,6 +53,18 @@ run_strict() {
     fi
 }
 
+# The legacy epoch-keyed resume path is restore-only (pre-PR4 records):
+# a trainer importing it would silently regress to epoch-granularity
+# resume. grep exits 1 on no match, so invert.
+check_no_legacy_resume() {
+    echo "== no trainer imports the legacy maybe_resume path" >&2
+    if grep -rn --include='*.py' "maybe_resume" genrec_tpu/trainers/ >&2; then
+        echo "   FAILED: trainers must resume via core.fault_tolerance.resume_exact" >&2
+        FAIL=1
+    fi
+}
+check_no_legacy_resume
+
 if [ "$MODE" = "--smoke" ]; then
     run python scripts/check_decode_hlo.py --small --platform cpu
     run python scripts/check_fused_ce_hlo.py --small --platform cpu
@@ -59,15 +77,23 @@ if [ "$MODE" = "--smoke" ]; then
     if [ -z "${GENREC_CI_SKIP_CHAOS:-}" ]; then
         run_strict env JAX_PLATFORMS=cpu python -m pytest tests/test_fault_tolerance.py \
             -q -m chaos_unit -p no:cacheprovider 1>&2
+        # Multi-host chaos smoke: 2 real jax.distributed CPU workers prove
+        # divergence-free consensus restore (one host's newest checkpoint
+        # corrupted -> both restore the same older step).
+        run_strict env JAX_PLATFORMS=cpu python -m pytest \
+            "tests/test_multihost.py::test_two_process_distributed[consensus]" \
+            -q -p no:cacheprovider 1>&2
     fi
 else
     run python scripts/check_decode_hlo.py --write-note
     run python scripts/check_fused_ce_hlo.py --write-note
     run python scripts/check_packed_hlo.py --write-note
-    # Full chaos suite: SIGTERM mid-epoch + exact-resume parity for the
-    # packed trainers, ladder fallback, NaN injection.
+    # Full chaos suite: SIGTERM mid-epoch + exact-resume parity for all
+    # seven trainers, ladder fallback, NaN injection — plus the 2-process
+    # multi-host chaos (consensus restore, mid-save host kill, init
+    # timeout).
     run_strict env JAX_PLATFORMS=cpu python -m pytest tests/test_fault_tolerance.py \
-        -q -p no:cacheprovider 1>&2
+        tests/test_multihost.py -q -p no:cacheprovider 1>&2
     # Hardware kernel shapes compile only through Mosaic — TPU backend only.
     if python -c "import jax; raise SystemExit(0 if jax.default_backend() == 'tpu' else 1)" 2>/dev/null; then
         run python scripts/tpu_kernel_check.py

@@ -1,14 +1,29 @@
 """Worker process for tests/test_multihost.py (not a pytest module).
 
 Runs as 1 of 2 jax.distributed processes, each with 4 virtual CPU
-devices -> an 8-device global mesh, and exercises every multi-host-only
-branch the single-process suite cannot reach:
+devices -> an 8-device global mesh, and exercises the multi-host-only
+branches the single-process suite cannot reach. Scenarios (argv[4],
+default "base"):
 
-- parallel.mesh.shard_batch -> jax.make_array_from_process_local_data
-- parallel.mesh.metric_allreduce / to_host / barrier
-- ops.metrics.TopKAccumulator.reduce(cross_process=True)
-- core.checkpoint.CheckpointManager save/restore of a NON-ADDRESSABLE
-  (cross-process data-sharded) array
+- ``base``: parallel.mesh.shard_batch's
+  make_array_from_process_local_data upload, metric_allreduce /
+  to_host / barrier / allgather_host_ints / any_across_processes,
+  TopKAccumulator.reduce(cross_process=True), and orbax save/restore of
+  a NON-ADDRESSABLE (cross-process data-sharded) array.
+- ``consensus``: per-host checkpoint directories
+  (`CheckpointManager(per_host=True)` -> ``<dir>/p<process>/``), the
+  newest step garbled on process 1 ONLY (chaos fault injection scoped
+  to one host), then `restore_latest_valid_consensus`: process 1's
+  ladder quarantines its step locally, the fleet allgathers
+  newest-valid steps, and BOTH processes restore the same older step —
+  the divergence-free-restore guarantee.
+- ``commit``: coordinated commit under a host lost MID-SAVE. Both
+  processes contribute shards of a cross-process-sharded array to a
+  shared-directory save; a chaos plan SIGKILLs process 1 after its
+  snapshot, while the commit is in flight. Process 0's bounded commit
+  barrier errors instead of hanging, and the step must NEVER gain a
+  commit marker — no host can ever restore a half-written checkpoint.
+  (Process 1 never prints; the parent asserts it died by SIGKILL.)
 
 Prints MULTIHOST_OK on success; any assertion kills the process and the
 parent test fails on the exit code.
@@ -18,30 +33,14 @@ import os
 import sys
 
 
-def main(coordinator: str, process_id: int, ckpt_dir: str) -> None:
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    flags = [
-        f for f in os.environ.get("XLA_FLAGS", "").split()
-        if not f.startswith("--xla_force_host_platform_device_count")
-    ]
-    flags.append("--xla_force_host_platform_device_count=4")
-    os.environ["XLA_FLAGS"] = " ".join(flags)
-
+def _scenario_base(process_id: int, ckpt_dir: str) -> None:
     import jax
-
-    jax.config.update("jax_platforms", "cpu")
-    jax.distributed.initialize(
-        coordinator_address=coordinator, num_processes=2, process_id=process_id
-    )
-    assert jax.process_count() == 2, jax.process_count()
-    assert jax.device_count() == 8, jax.device_count()
-    assert jax.local_device_count() == 4
-
     import jax.numpy as jnp
     import numpy as np
 
     from genrec_tpu.parallel import (
-        barrier,
+        allgather_host_ints,
+        any_across_processes,
         get_mesh,
         metric_allreduce,
         replicate,
@@ -72,6 +71,12 @@ def main(coordinator: str, process_id: int, ckpt_dir: str) -> None:
     assert got["n"] == 3.0, got  # 1 + 2
     assert got["s"] == 20.0, got
 
+    # --- the checkpoint-consensus / preemption-agreement primitives.
+    rows = allgather_host_ints([process_id * 10, 7])
+    np.testing.assert_array_equal(rows, [[0, 7], [10, 7]])
+    assert any_across_processes(process_id == 1)  # one host's flag -> all
+    assert not any_across_processes(False)
+
     # --- TopKAccumulator.reduce(cross_process=True): processes accumulate
     # DIFFERENT batches; the reduced metrics must reflect both.
     from genrec_tpu.ops.metrics import TopKAccumulator
@@ -97,7 +102,7 @@ def main(coordinator: str, process_id: int, ckpt_dir: str) -> None:
     }
     mgr = CheckpointManager(ckpt_dir)
     mgr.save(0, state)
-    mgr._mgr.wait_until_finished()
+    mgr.wait()
     like = {
         "w": replicate(mesh, jnp.zeros((4,))),
         "data_sharded": shard_batch(mesh, {"x": np.zeros((8, 2), np.float32)})["x"],
@@ -107,9 +112,171 @@ def main(coordinator: str, process_id: int, ckpt_dir: str) -> None:
     np.testing.assert_array_equal(to_host(restored["data_sharded"]), batch["x"])
     mgr.close()
 
+
+def _scenario_consensus(process_id: int, ckpt_dir: str) -> None:
+    """One host's newest checkpoint corrupted -> both hosts restore the
+    SAME older step through `restore_latest_valid_consensus`."""
+    import numpy as np
+
+    from genrec_tpu.core import chaos
+    from genrec_tpu.core.checkpoint import CheckpointManager
+    from genrec_tpu.parallel import barrier
+
+    # Per-host record trees (host-local numpy state): <dir>/p<process>/.
+    mgr = CheckpointManager(ckpt_dir, per_host=True, max_to_keep=4)
+    assert mgr.directory.endswith(f"p{process_id}"), mgr.directory
+    for s in (1, 2):
+        mgr.save(s, {"w": np.full((4,), float(s), np.float32)})
+    mgr.wait()
+    barrier("per-host-saves-done")
+
+    # Per-host fault injection: garble the NEWEST step on process 1 ONLY
+    # (scoped exactly like ChaosPlan(only_process=1) scopes live faults).
+    plan = chaos.ChaosPlan(only_process=1)
+    if chaos._this_process_targeted(plan):
+        chaos.garble_checkpoint(mgr.directory, 2)
+    barrier("corruption-injected")
+
+    like = {"w": np.zeros((4,), np.float32)}
+    restored, step = mgr.restore_latest_valid_consensus(like)
+    # Process 0's newest-valid is 2, process 1's is 1 after its local
+    # ladder quarantines the garbled step: the fleet minimum wins on
+    # BOTH hosts — never a forked restore.
+    assert step == 1, f"p{process_id} restored step {step}, want 1"
+    np.testing.assert_array_equal(restored["w"], np.full((4,), 1.0))
+    if process_id == 1:
+        q = os.path.join(mgr.directory, "quarantine", "p1", "2")
+        assert os.path.isdir(q), "garbled step not quarantined per-host"
+    # Process 0's locally-VALID step 2 was abandoned by the fleet-agreed
+    # restore at step 1 and must be quarantined too: retained, orbax
+    # would silently drop every future save keyed below it, and the
+    # stale-step refusal would abort p0 alone while p1 trains on.
+    if process_id == 0:
+        q = os.path.join(mgr.directory, "quarantine", "p0", "2")
+        assert os.path.isdir(q), "consensus-abandoned step not quarantined"
+    mgr.close()
+
+    # --- the PRODUCTION restore path (`resume_exact`) over the same
+    # fork: p1's newest resume point garbled -> BOTH hosts must get the
+    # older cursor back (no per-host stale-step refusal, no deadlock),
+    # and a post-restore save must actually land.
+    from genrec_tpu.core import fault_tolerance as ft
+
+    mgr2 = CheckpointManager(
+        os.path.join(ckpt_dir, "exact"), per_host=True, max_to_keep=4
+    )
+    for s, (ep, nb) in ((3, (0, 3)), (6, (1, 2))):
+        ft.save_resume_point(
+            mgr2, {"w": np.full((4,), float(s), np.float32)},
+            epoch=ep, next_batch=nb, global_step=s, data_seed=17,
+        )
+    mgr2.wait()
+    barrier("exact-saves-done")
+    if chaos._this_process_targeted(plan):
+        chaos.garble_checkpoint(mgr2.directory, 6)
+    barrier("exact-corruption-injected")
+    point = ft.resume_exact(
+        mgr2, {"w": np.zeros((4,), np.float32)}, data_seed=17
+    )
+    assert point is not None, f"p{process_id} got no resume point"
+    assert (point.global_step, point.epoch, point.next_batch) == (3, 0, 3), (
+        f"p{process_id} cursor ({point.global_step}, {point.epoch}, "
+        f"{point.next_batch}), want (3, 0, 3)"
+    )
+    np.testing.assert_array_equal(point.state["w"], np.full((4,), 3.0))
+    # The hazard resume_exact refuses elsewhere is really gone: a save
+    # keyed above the restore point lands (CheckpointManager.save raises
+    # on an orbax-refused save).
+    ft.save_resume_point(
+        mgr2, {"w": np.full((4,), 4.0, np.float32)},
+        epoch=0, next_batch=4, global_step=4, data_seed=17, wait=True,
+    )
+    assert mgr2.latest_step() == 4, mgr2.latest_step()
+    mgr2.close()
+
+
+def _scenario_commit(process_id: int, ckpt_dir: str) -> None:
+    """Process 1 dies (SIGKILL) mid-save of a cross-process-sharded
+    array: the step must never gain a commit marker anywhere."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from genrec_tpu.core import chaos
+    from genrec_tpu.core.checkpoint import _COMMIT_MARKER, CheckpointManager
+    from genrec_tpu.parallel import get_mesh, replicate, shard_batch
+
+    mesh = get_mesh()
+    sharded = shard_batch(
+        mesh, {"x": np.arange(16, dtype=np.float32).reshape(8, 2)}
+    )
+    state = {"w": replicate(mesh, jnp.full((4,), 3.0)), "xs": sharded["x"]}
+    # Bounded commit barrier: the lost host must surface as an error on
+    # the survivor within seconds, not orbax's 10-minute default.
+    mgr = CheckpointManager(ckpt_dir, commit_timeout_secs=20)
+    mgr.save(1, state)
+    mgr.wait()  # a known-good committed step first
+
+    with chaos.inject(
+        chaos.ChaosPlan(die_in_save_at_step=2, only_process=1)
+    ):
+        mgr.save(2, state)  # process 1 never returns from this call
+
+    assert process_id == 0, "process 1 should have died in save"
+    try:
+        mgr.wait()
+        raise SystemExit("commit completed with a dead peer — marker race")
+    except SystemExit:
+        raise
+    except Exception as e:  # barrier timeout / peer-failure error
+        print(f"commit blocked as expected: {type(e).__name__}", flush=True)
+    marker = os.path.join(ckpt_dir, "2", _COMMIT_MARKER)
+    assert not os.path.exists(marker), "half-written step gained a marker"
+    # The previous committed step is untouched.
+    assert os.path.exists(os.path.join(ckpt_dir, "1", _COMMIT_MARKER))
+
+
+def main(coordinator: str, process_id: int, ckpt_dir: str,
+         scenario: str = "base") -> None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = [
+        f for f in os.environ.get("XLA_FLAGS", "").split()
+        if not f.startswith("--xla_force_host_platform_device_count")
+    ]
+    flags.append("--xla_force_host_platform_device_count=4")
+    os.environ["XLA_FLAGS"] = " ".join(flags)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    # Cross-process computations on the CPU backend need an explicit
+    # collectives implementation (the default errors with "Multiprocess
+    # computations aren't implemented on the CPU backend").
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    jax.distributed.initialize(
+        coordinator_address=coordinator, num_processes=2, process_id=process_id
+    )
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.device_count() == 8, jax.device_count()
+    assert jax.local_device_count() == 4
+
+    fn = {
+        "base": _scenario_base,
+        "consensus": _scenario_consensus,
+        "commit": _scenario_commit,
+    }[scenario]
+    fn(process_id, ckpt_dir)
+
+    if scenario == "commit":
+        # Process 1 is dead: an end-of-test barrier would hang, and the
+        # distributed client's shutdown may block on the lost peer too.
+        print(f"MULTIHOST_OK {process_id}", flush=True)
+        os._exit(0)
+    from genrec_tpu.parallel import barrier
+
     barrier("multihost-test-done")
     print(f"MULTIHOST_OK {process_id}", flush=True)
 
 
 if __name__ == "__main__":
-    main(sys.argv[1], int(sys.argv[2]), sys.argv[3])
+    main(sys.argv[1], int(sys.argv[2]), sys.argv[3],
+         sys.argv[4] if len(sys.argv) > 4 else "base")

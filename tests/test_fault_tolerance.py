@@ -7,18 +7,26 @@ the FULL suite (smoke mode runs the @chaos_unit subset); the tier-1
 
 Covers, end to end on the CPU virtual mesh:
 
-- exact mid-epoch resume: SIGTERM injected at an arbitrary step of a
-  packed sasrec/hstu/tiger run, then resume — per-step losses and final
-  params match an uninterrupted run (no replayed or skipped batches);
+- exact mid-epoch resume: SIGTERM injected at an arbitrary step of ANY
+  of the seven trainers (packed sasrec/hstu/tiger AND the converted
+  cobra/lcrec/notellm/rqvae), then resume — per-step losses and final
+  params match an uninterrupted run (no replayed or skipped batches).
+  cobra/lcrec killed DURING THEIR FINAL EPOCH resume exactly too — the
+  old epoch-granular path saved nothing there (a hole this file used to
+  pin as documented; now pinned as CLOSED);
 - the checkpoint integrity ladder: truncated/garbled/uncommitted/NaN
   checkpoint dirs are quarantined and restore falls back to the previous
   retained step, both at the manager level and through a real trainer;
 - the jitted non-finite step guard + host NonFiniteMonitor: NaN batches
   skip the optimizer update without corrupting params/opt_state, dump
   the offending batch, and abort after N consecutive bad steps;
-- the epoch-granularity `maybe_resume` arithmetic of the legacy
-  trainers, including the fire-during-final-epoch case that saves no
-  checkpoint (documented gap, pinned here).
+- the epoch-keyed `maybe_resume` arithmetic, kept ONLY for restoring
+  pre-PR4 bare-TrainState records (no trainer calls it anymore —
+  scripts/ci_checks.sh enforces the no-import rule).
+
+The multi-host halves of this layer (consensus restore, coordinated
+commit, per-host fault injection) live in tests/test_multihost.py — they
+need real jax.distributed processes.
 """
 
 import json
@@ -41,6 +49,7 @@ from genrec_tpu.core.checkpoint import (
 from genrec_tpu.core.fault_tolerance import (
     NonFiniteLossError,
     NonFiniteMonitor,
+    restore_for_eval,
     resume_exact,
     save_resume_point,
 )
@@ -177,7 +186,7 @@ def test_packed_loop_nan_injection_skips_and_aborts(tmp_path):
             mesh=mesh, guard=None, ckpt=None,
             rows_per_step=8, row_len=1, seed=0, pack_sequences=False,
             train_arrays=arrays, wandb_log_interval=1000,
-            nonfinite_dump_dir=str(tmp_path / "dumps"),
+            save_dir_root=str(tmp_path),
             max_consecutive_nonfinite=3,
         )
 
@@ -285,6 +294,52 @@ def test_resume_exact_roundtrip_and_seed_check(tmp_path):
     # A different data seed would silently break exactness: refuse it.
     with pytest.raises(ValueError, match="data seed"):
         resume_exact(mgr, state, data_seed=8)
+    mgr.close()
+
+
+@pytest.mark.chaos_unit
+def test_restore_for_eval_skips_exactness_preconditions(tmp_path):
+    """A pure evaluation consumes no training data, so the exact-resume
+    preconditions must not refuse it: a resume point written with a
+    DIFFERENT data seed restores fine, a stale foreign record above the
+    restore point is ignored, and a pre-PR4 bare TrainState record (no
+    cursor) still evaluates via the legacy-layout fallback."""
+    from genrec_tpu.core import fault_tolerance as ft
+
+    _, opt, state = _toy_setup()
+
+    # Seed mismatch + foreign record above: both refuse resume_exact but
+    # must not refuse evaluation.
+    mgr = CheckpointManager(str(tmp_path / "ck"), max_to_keep=4)
+    save_resume_point(mgr, state, epoch=2, next_batch=5, global_step=17,
+                      data_seed=7, wait=True)
+    mgr.save(20, {
+        "state": state,
+        "cursor": dict(ft._cursor_arrays(3, 0, 20, 7, 0),
+                       format=np.asarray(99, np.int32)),
+    })
+    mgr.wait()
+    with pytest.raises(RuntimeError, match="Refusing to resume below"):
+        resume_exact(mgr, state, data_seed=8)
+    got, step = restore_for_eval(mgr, state)
+    assert step == 17
+    _tree_equal(got.params, state.params)
+    mgr.close()
+
+    # Pre-PR4 bare TrainState record: the composite ladder mismatches
+    # everything, the bare fallback restores it.
+    mgr = CheckpointManager(str(tmp_path / "bare"))
+    mgr.save(3, state)
+    mgr.wait()
+    got, step = restore_for_eval(mgr, state)
+    assert step == 3
+    _tree_equal(got.params, state.params)
+    mgr.close()
+
+    # Nothing on disk: the initial state comes back with step None.
+    mgr = CheckpointManager(str(tmp_path / "empty"))
+    got, step = restore_for_eval(mgr, state)
+    assert step is None and got is state
     mgr.close()
 
 
@@ -420,17 +475,19 @@ def test_poison_batches_targets_float_leaves_only():
 # ---------------------------------------------------------------------------
 
 
-def _losses_by_step(save_dir):
-    """metrics.jsonl train/loss entries keyed by global step (the resumed
-    run APPENDS to the same file; a step may appear at most once)."""
+def _losses_by_step(save_dir, loss_key="train/loss"):
+    """metrics.jsonl loss entries keyed by global step (the resumed
+    run APPENDS to the same file; a step may appear at most once).
+    ``loss_key`` follows the trainer's step_log payload (rqvae logs
+    ``total_loss``)."""
     out = {}
     with open(os.path.join(save_dir, "metrics.jsonl")) as f:
         for line in f:
             rec = json.loads(line)
-            if "train/loss" in rec and "global_step" in rec:
+            if loss_key in rec and "global_step" in rec:
                 step = int(rec["global_step"])
                 assert step not in out, f"step {step} logged twice (replayed batch)"
-                out[step] = rec["train/loss"]
+                out[step] = rec[loss_key]
     return out
 
 
@@ -446,9 +503,10 @@ def _load_final_resume_point(save_dir):
     return step, raw
 
 
-def _assert_parity(dir_a, dir_b):
+def _assert_parity(dir_a, dir_b, loss_key="train/loss"):
     """Same per-step losses (no replay/skip) and identical final params."""
-    la, lb = _losses_by_step(dir_a), _losses_by_step(dir_b)
+    la = _losses_by_step(dir_a, loss_key)
+    lb = _losses_by_step(dir_b, loss_key)
     assert sorted(la) == sorted(lb), "replayed or skipped batches"
     for s in la:
         assert la[s] == pytest.approx(lb[s], abs=1e-5), f"loss diverged at step {s}"
@@ -471,15 +529,19 @@ _SASREC_CFG = dict(
 )
 
 
-def _run_interrupted_and_resume(train, cfg, tmp_path, kill_at_step):
-    """(uninterrupted_dir, interrupted+resumed_dir) for _assert_parity."""
+def _run_interrupted_and_resume(train, cfg, tmp_path, kill_at_step,
+                                preempt_rv=({}, {})):
+    """(uninterrupted_dir, interrupted+resumed_dir) for _assert_parity.
+    ``preempt_rv`` is the trainer's preempted-exit return value (None to
+    skip the check for trainers whose return holds arrays)."""
     dir_a = str(tmp_path / "uninterrupted")
     train(**cfg, save_dir_root=dir_a)
 
     dir_b = str(tmp_path / "interrupted")
     with chaos.inject(chaos.ChaosPlan(kill_at_step=kill_at_step)):
         out = train(**cfg, save_dir_root=dir_b)
-    assert out == ({}, {})  # preempted exit
+    if preempt_rv is not None:
+        assert out == preempt_rv  # preempted exit
     # The mid-epoch resume point exists and sits at the kill step.
     ckdir = os.path.join(dir_b, "checkpoints")
     assert kill_at_step in [int(s) for s in os.listdir(ckdir) if s.isdigit()]
@@ -552,7 +614,7 @@ def test_sasrec_resume_survives_corrupt_latest(tmp_path):
 
 
 # ---------------------------------------------------------------------------
-# legacy epoch-granularity maybe_resume arithmetic (satellite)
+# legacy epoch-keyed maybe_resume arithmetic (pre-PR4 records only)
 # ---------------------------------------------------------------------------
 
 
@@ -577,62 +639,37 @@ def test_maybe_resume_epoch_arithmetic(tmp_path):
     mgr.close()
 
 
+# ---------------------------------------------------------------------------
+# exact resume for the converted epoch-trainers (cobra/lcrec/notellm/rqvae)
+# ---------------------------------------------------------------------------
+
+
 _RQVAE_CFG = dict(
     epochs=3, batch_size=64, learning_rate=1e-3,
     vae_input_dim=16, vae_hidden_dims=(16,), vae_embed_dim=4,
     vae_codebook_size=8, vae_n_layers=2, kmeans_warmup_rows=64,
     dataset="synthetic", do_eval=False, eval_every=100,
-    wandb_log_interval=1000, seed=0,
+    wandb_log_interval=1, seed=0,
 )
 
 
 @pytest.mark.slow
-def test_rqvae_epoch_preemption_saves_last_completed_epoch(tmp_path):
-    """The legacy `epoch > start_epoch -> save(epoch - 1)` path: a signal
-    during epoch 1 persists epoch 1 at the top of epoch 2, and the
-    resumed run continues from epoch 2 (visible in train.log)."""
+def test_rqvae_exact_resume_after_midepoch_sigterm(tmp_path):
+    """rqvae through the shared step-granular loop: SIGTERM mid-epoch 1
+    writes a resume point at the exact kill step; the resumed run matches
+    an uninterrupted one per-step (rqvae logs ``total_loss``)."""
     from genrec_tpu.trainers.rqvae_trainer import train
 
-    d = str(tmp_path / "rq")
-    with chaos.inject(chaos.ChaosPlan(kill_at_epoch=1)):
-        train(**_RQVAE_CFG, save_dir_root=d, sem_ids_path=None)
-    mgr = CheckpointManager(os.path.join(d, "checkpoints"))
-    assert mgr.latest_step() == 1
-    mgr.close()
-    train(**_RQVAE_CFG, save_dir_root=d, sem_ids_path=None,
-          resume_from_checkpoint=True)
-    log = open(os.path.join(d, "train.log")).read()
-    assert "resumed after epoch 1" in log
+    # ~28 steps/epoch at this scale: step 40 is mid-epoch 1.
+    dir_a, dir_b = _run_interrupted_and_resume(
+        train, _RQVAE_CFG, tmp_path, 40, preempt_rv=None
+    )
+    _assert_parity(dir_a, dir_b, loss_key="total_loss")
 
 
-@pytest.mark.slow
-def test_rqvae_final_epoch_save_closes_the_preemption_hole(tmp_path):
-    """rqvae's unconditional final-epoch save means a signal during the
-    FINAL epoch (which never reaches the next top-of-loop preemption
-    check) still leaves a resumable checkpoint — pinned so nobody removes
-    that save thinking the guard covers it."""
-    from genrec_tpu.trainers.rqvae_trainer import train
-
-    d = str(tmp_path / "rq")
-    cfg = dict(_RQVAE_CFG, epochs=1)
-    with chaos.inject(chaos.ChaosPlan(kill_at_epoch=0)):
-        train(**cfg, save_dir_root=d, sem_ids_path=None)
-    mgr = CheckpointManager(os.path.join(d, "checkpoints"))
-    assert mgr.latest_step() == 0  # the final-epoch save, not the guard
-    mgr.close()
-
-
-@pytest.mark.slow
-def test_cobra_preemption_during_final_epoch_saves_nothing(tmp_path):
-    """Documented gap of the epoch-granular path, pinned: with a pure
-    save_every_epoch cadence (cobra keeps no unconditional final save,
-    unlike rqvae/notellm), a signal during the FINAL epoch never reaches
-    the next top-of-loop check, so NO checkpoint is written — the run
-    completes, but a crash after it would have nothing to resume. The
-    packed trainers' step-granular path does not have this hole."""
+def _tiny_cobra_cfg():
     from genrec_tpu.data.cobra_seq import CobraSeqData
     from genrec_tpu.data.sem_ids import random_unique_sem_ids
-    from genrec_tpu.trainers.cobra_trainer import train
 
     rng = np.random.default_rng(0)
     n_items, C, K = 24, 3, 8
@@ -643,18 +680,96 @@ def test_cobra_preemption_during_final_epoch_saves_nothing(tmp_path):
         np.asarray(rng.integers(1, n_items + 1, rng.integers(5, 9)), np.int64)
         for _ in range(48)
     ]
-    d = str(tmp_path / "cobra")
+    return dict(
+        dataset=lambda: CobraSeqData(
+            seqs, sem_ids, texts, id_vocab_size=K, max_items=6
+        ),
+        epochs=1, batch_size=8, learning_rate=1e-3, num_warmup_steps=2,
+        encoder_n_layers=1, encoder_hidden_dim=16, encoder_num_heads=2,
+        encoder_vocab_size=64, d_model=16, decoder_n_layers=1,
+        decoder_num_heads=2, max_items=6, n_beam=4, do_eval=False,
+        save_every_epoch=50, test_on_best=False, wandb_log_interval=1,
+        seed=0,
+    )
+
+
+@pytest.mark.slow
+def test_cobra_final_epoch_sigterm_resumes_exactly(tmp_path):
+    """The pinned hole, CLOSED: the old epoch-granular cobra wrote NO
+    checkpoint when signalled during the final epoch with a
+    save_every_epoch cadence that never fires (this file used to pin
+    `latest_step() is None` for exactly this setup). Through the shared
+    step-granular loop, the same kill leaves a mid-final-epoch resume
+    point and the resumed run matches the uninterrupted one exactly."""
+    from genrec_tpu.trainers.cobra_trainer import train
+
+    # epochs=1: every step is inside the final epoch; 6 steps/epoch.
+    dir_a, dir_b = _run_interrupted_and_resume(
+        train, _tiny_cobra_cfg(), tmp_path, 3
+    )
+    _assert_parity(dir_a, dir_b)
+
+
+@pytest.mark.slow
+def test_lcrec_final_epoch_sigterm_resumes_exactly(tmp_path):
+    """lcrec killed DURING ITS FINAL epoch (the other half of the pinned
+    cobra/lcrec hole) resumes step-exactly."""
+    from genrec_tpu.trainers.lcrec_trainer import train
+
+    cfg = dict(
+        epochs=2, batch_size=16, eval_every_epoch=10, do_eval=False,
+        eval_batch_size=16, hidden_size=32, intermediate_size=64,
+        n_layers=1, num_heads=2, num_kv_heads=2, max_text_len=64,
+        eval_item_tasks=False, save_every_epoch=1, wandb_log_interval=1,
+        seed=0,
+    )
+    dir_a = str(tmp_path / "uninterrupted")
+    train(**cfg, save_dir_root=dir_a)
+    # Pick a kill step inside the FINAL epoch from the uninterrupted
+    # run's step count (synthetic task mix size is a data detail).
+    n = max(_losses_by_step(dir_a))
+    kill = n // 2 + max(1, n // 4)
+    dir_b = str(tmp_path / "interrupted")
+    with chaos.inject(chaos.ChaosPlan(kill_at_step=kill)):
+        out = train(**cfg, save_dir_root=dir_b)
+    assert out == ({}, {})
+    ckdir = os.path.join(dir_b, "checkpoints")
+    assert kill in [int(s) for s in os.listdir(ckdir) if s.isdigit()]
+    train(**cfg, save_dir_root=dir_b, resume_from_checkpoint=True)
+    _assert_parity(dir_a, dir_b)
+
+
+@pytest.mark.slow
+def test_notellm_exact_resume_after_midepoch_sigterm(tmp_path):
+    from genrec_tpu.trainers.notellm_trainer import train
+
+    cfg = dict(
+        epochs=2, batch_pairs=16, do_eval=False, eval_every_epoch=10,
+        num_topics=32, eval_topics=16, pairs_per_topic=4,
+        hidden_size=32, intermediate_size=64, n_layers=1,
+        num_heads=2, num_kv_heads=1, save_every_epoch=1,
+        wandb_log_interval=1, seed=0,
+    )
+    # 8 steps/epoch: step 5 is mid-epoch 0.
+    dir_a, dir_b = _run_interrupted_and_resume(
+        train, cfg, tmp_path, 5, preempt_rv={}
+    )
+    _assert_parity(dir_a, dir_b)
+
+
+@pytest.mark.slow
+def test_sasrec_between_epoch_sigterm_resumes_exactly(tmp_path):
+    """kill_at_epoch fires in the eval/checkpoint window AFTER an epoch
+    (the loop's top-of-epoch preemption branch): the next run_epoch call
+    writes a (next epoch, batch 0) resume point without running a step,
+    and the resumed run still matches exactly."""
+    from genrec_tpu.trainers.sasrec_trainer import train
+
+    dir_a = str(tmp_path / "uninterrupted")
+    train(**_SASREC_CFG, save_dir_root=dir_a)
+    dir_b = str(tmp_path / "interrupted")
     with chaos.inject(chaos.ChaosPlan(kill_at_epoch=0)):
-        train(
-            dataset=lambda: CobraSeqData(
-                seqs, sem_ids, texts, id_vocab_size=K, max_items=6
-            ),
-            epochs=1, batch_size=8, learning_rate=1e-3, num_warmup_steps=2,
-            encoder_n_layers=1, encoder_hidden_dim=16, encoder_num_heads=2,
-            encoder_vocab_size=64, d_model=16, decoder_n_layers=1,
-            decoder_num_heads=2, max_items=6, n_beam=4, do_eval=False,
-            save_every_epoch=50, test_on_best=False, save_dir_root=d,
-        )
-    mgr = CheckpointManager(os.path.join(d, "checkpoints"))
-    assert mgr.latest_step() is None
-    mgr.close()
+        out = train(**_SASREC_CFG, save_dir_root=dir_b)
+    assert out == ({}, {})
+    train(**_SASREC_CFG, save_dir_root=dir_b, resume_from_checkpoint=True)
+    _assert_parity(dir_a, dir_b)

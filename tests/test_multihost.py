@@ -4,20 +4,26 @@ The 8-device single-process mesh the rest of the suite uses never takes
 the `jax.process_count() > 1` branches (VERDICT r3 weak #7): shard_batch's
 make_array_from_process_local_data upload, metric_allreduce /
 TopKAccumulator(cross_process=True) partial-sum reduction, to_host's
-process_allgather, barrier, and orbax checkpointing of non-addressable
-arrays. This test launches two ACTUAL processes (4 virtual CPU devices
-each -> one 8-device global mesh over the gRPC coordinator) running
-tests/_multihost_worker.py.
+process_allgather, barrier, orbax checkpointing of non-addressable
+arrays — and, since PR 4, the multi-host fault-tolerance guarantees:
+checkpoint-restore CONSENSUS (one host's corrupt newest checkpoint pulls
+every host to the same older step instead of forking the fleet) and
+COORDINATED COMMIT (a host SIGKILLed mid-save never yields a
+commit-markered checkpoint). Each test launches two ACTUAL processes
+(4 virtual CPU devices each -> one 8-device global mesh over the gRPC
+coordinator) running tests/_multihost_worker.py.
 """
 
 import os
+import signal
 import socket
 import subprocess
 import sys
+import time
 
 import pytest
 
-pytestmark = pytest.mark.slow  # two extra jax processes; heavy for fast pass
+pytestmark = pytest.mark.slow  # extra jax processes; heavy for fast pass
 
 
 def _free_port() -> int:
@@ -26,7 +32,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_distributed(tmp_path):
+def _launch_workers(tmp_path, scenario):
     here = os.path.dirname(os.path.abspath(__file__))
     worker = os.path.join(here, "_multihost_worker.py")
     coordinator = f"127.0.0.1:{_free_port()}"
@@ -39,7 +45,7 @@ def test_two_process_distributed(tmp_path):
     env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
     procs = [
         subprocess.Popen(
-            [sys.executable, worker, coordinator, str(pid), ckpt_dir],
+            [sys.executable, worker, coordinator, str(pid), ckpt_dir, scenario],
             env=env,
             cwd=os.path.dirname(here),
             stdout=subprocess.PIPE,
@@ -48,8 +54,6 @@ def test_two_process_distributed(tmp_path):
         )
         for pid in range(2)
     ]
-    import time
-
     deadline = time.monotonic() + 420  # ONE shared budget for both workers
     outs = [None, None]
     timed_out = False
@@ -67,7 +71,74 @@ def test_two_process_distributed(tmp_path):
             "multihost workers timed out:\n"
             + "\n---\n".join(o[-4000:] for o in outs if o)
         )
+    return procs, outs, ckpt_dir
 
+
+@pytest.mark.parametrize("scenario", ["base", "consensus"])
+def test_two_process_distributed(tmp_path, scenario):
+    procs, outs, _ = _launch_workers(tmp_path, scenario)
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {pid} failed:\n{out[-4000:]}"
         assert f"MULTIHOST_OK {pid}" in out, out[-2000:]
+
+
+def test_midsave_host_kill_never_commits(tmp_path):
+    """Coordinated commit: process 1 is SIGKILLed after its array
+    snapshot with the commit still in flight. The survivor's bounded
+    commit barrier errors (no silent hang) and the half-written step
+    never gains a commit marker — on restart no host could restore it,
+    so the fleet cannot fork on a step that exists only for some."""
+    from genrec_tpu.core.checkpoint import _COMMIT_MARKER
+
+    procs, outs, ckpt_dir = _launch_workers(tmp_path, "commit")
+    # The survivor proved the guarantee...
+    assert procs[0].returncode == 0, f"worker 0 failed:\n{outs[0][-4000:]}"
+    assert "MULTIHOST_OK 0" in outs[0], outs[0][-2000:]
+    # ...and the injected host really died HARD mid-save.
+    assert procs[1].returncode == -signal.SIGKILL, (
+        procs[1].returncode, outs[1][-2000:]
+    )
+    assert "MULTIHOST_OK" not in outs[1]
+    # Independent of the worker's own assertions: step 1 committed,
+    # step 2 never did.
+    assert os.path.exists(os.path.join(ckpt_dir, "1", _COMMIT_MARKER))
+    assert not os.path.exists(os.path.join(ckpt_dir, "2", _COMMIT_MARKER))
+
+
+def test_distributed_init_timeout_is_actionable(tmp_path):
+    """A host that cannot reach the coordinator fails with a bounded,
+    actionable error naming the coordinator address / process id /
+    expected count — not JAX's bare hang-then-stack-trace."""
+    port = _free_port()  # nothing listens here
+    code = (
+        "import os\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        f"os.environ['JAX_COORDINATOR_ADDRESS'] = '127.0.0.1:{port}'\n"
+        "os.environ['JAX_PROCESS_COUNT'] = '2'\n"
+        "os.environ['JAX_NUM_PROCESSES'] = '2'\n"
+        "os.environ['JAX_PROCESS_ID'] = '1'\n"
+        "from genrec_tpu.parallel.mesh import distributed_init\n"
+        "try:\n"
+        "    distributed_init(initialization_timeout=5)\n"
+        "except RuntimeError as e:\n"
+        "    msg = str(e)\n"
+        f"    assert '127.0.0.1:{port}' in msg, msg\n"
+        "    assert 'GENREC_DIST_INIT_TIMEOUT' in msg, msg\n"
+        "    assert 'JAX_PROCESS_COUNT' in msg, msg\n"
+        "    print('TIMEOUT_ERROR_OK')\n"
+        "else:\n"
+        "    print('NO_ERROR')\n"
+    )
+    here = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (
+        os.path.dirname(here) + os.pathsep + env.get("PYTHONPATH", "")
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code], env=env, capture_output=True,
+        text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert "TIMEOUT_ERROR_OK" in proc.stdout, (
+        proc.stdout, proc.stderr[-2000:]
+    )
