@@ -17,6 +17,9 @@ built TPU-first:
   flash-style backward), fused full-softmax linear+CE for the
   SASRec/HSTU/LCRec heads (no materialized logits), residual quantizer
   distance/assign
+- an online serving engine (genrec_tpu.serving): dynamic micro-batching
+  over a bucketed compilation ladder, trie-constrained generative +
+  sharded retrieval heads, hot checkpoint reload, graceful drain
 """
 
 __version__ = "0.1.0"

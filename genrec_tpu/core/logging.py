@@ -68,6 +68,34 @@ def log_occupancy(logger, tracker, epoch: int, real_tokens: float,
     return occ
 
 
+def log_serving_stats(logger, tracker, stats: Mapping[str, Any]) -> None:
+    """Per-interval serving health line + tracker forwarding.
+
+    ``stats`` is a ServingEngine.stats() snapshot. One human-readable
+    line (QPS + the three latency percentiles + recompile count — the
+    fields an operator scans first) goes to the logger; the full flattened
+    snapshot goes to the tracker under the ``serve/`` namespace so wandb /
+    metrics.jsonl dashboards get every counter."""
+    t = stats.get("total_ms", {})
+    logger.info(
+        f"serving: qps={stats.get('qps', 0):.1f} "
+        f"p50={t.get('p50', 0):.1f}ms p95={t.get('p95', 0):.1f}ms "
+        f"p99={t.get('p99', 0):.1f}ms completed={stats.get('completed', 0)} "
+        f"rejected={stats.get('rejected', 0)} "
+        f"recompilations={stats.get('recompilations', 0)} "
+        f"step={stats.get('params_step')}"
+    )
+    flat: dict[str, Any] = {}
+    for k, v in stats.items():
+        if isinstance(v, Mapping):
+            for kk, vv in v.items():
+                if isinstance(vv, (int, float)):
+                    flat[f"serve/{k}/{kk}"] = vv
+        elif isinstance(v, (int, float)):
+            flat[f"serve/{k}"] = v
+    tracker.log(flat)
+
+
 class Tracker:
     """wandb-compatible metric tracker with a JSONL fallback.
 

@@ -593,6 +593,23 @@ class Cobra(nn.Module):
         return self.decoder.decode(x, hist_kvs, hist_pad, caches, slot)
 
 
+def _constrained_logp(logits, trie, prefix_idx, step: int):
+    """Log-probs over a (..., V) logit block, trie-masked when a trie is
+    given: illegal continuations of ``prefix_idx`` (same leading shape)
+    are -1e32 BEFORE the softmax (scores renormalize over legal codes
+    only) and again AFTER (a dead beam — no legal continuation — yields
+    a flat softmax that must still never win the top-k). trie=None is
+    the plain log_softmax. The one definition shared by every codebook
+    step of both the cached and uncached searches."""
+    if trie is None:
+        return jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    legal = trie.legal_mask(prefix_idx, step)
+    logp = jax.nn.log_softmax(
+        jnp.where(legal, logits, -1e32).astype(jnp.float32), axis=-1
+    )
+    return jnp.where(legal, logp, -1e32)
+
+
 def cobra_generate(
     model: Cobra,
     params,
@@ -602,6 +619,7 @@ def cobra_generate(
     temperature: float = 1.0,
     item_vecs=None,
     use_cache: bool = True,
+    trie=None,
 ) -> CobraGenerationOutput:
     """Deterministic top-k beam search over the C codebooks (jit-friendly,
     static shapes per step, mirroring cobra.py:531-665).
@@ -610,6 +628,13 @@ def cobra_generate(
     batch and advances only the sem-id suffix per codebook step against
     per-layer KV caches; use_cache=False re-runs the full decoder per step
     (the original path, kept as the parity reference).
+
+    ``trie`` (ops.trie.DenseTrie/PackedTrie over the item corpus's C-code
+    tuples) constrains decoding to REAL items: each codebook step's logits
+    are masked to the trie-legal continuations before the softmax (so beam
+    scores renormalize over legal codes only) and again after (so a dead
+    beam — one with no legal continuation — can never win the top-k).
+    With trie=None the behavior is exactly the unconstrained search.
     """
     C = model.n_codebooks
     K = n_candidates
@@ -623,10 +648,13 @@ def cobra_generate(
     )
     T_items = vecs.shape[1]
     if use_cache and input_ids.shape[1] == C * T_items:
-        return _cobra_generate_cached(model, params, input_ids, vecs, K, temperature)
+        return _cobra_generate_cached(
+            model, params, input_ids, vecs, K, temperature, trie
+        )
 
     beam_tokens = None  # (B, K, c)
     beam_scores = None
+    prefix_idx = None  # (B, K) trie prefixes of each beam
     h_last = None
     for c in range(C):
         if c == 0:
@@ -637,9 +665,11 @@ def cobra_generate(
             seq_lens = seq_mask.sum(axis=1)
             h_c = h[jnp.arange(B), seq_lens - 1]  # (B, D) last dense pos
             logits = _apply_head(model, params, 0, h_c) / temperature
-            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            logp = _constrained_logp(logits, trie, jnp.zeros((B,), jnp.int32), 0)
             beam_scores, tok = jax.lax.top_k(logp, K)  # (B, K)
             beam_tokens = tok[..., None]  # (B, K, 1)
+            if trie is not None:
+                prefix_idx = trie.advance(jnp.zeros((B, K), jnp.int32), tok, 0)
             if C == 1:
                 h_last = jnp.broadcast_to(h_c[:, None], (B, K, h_c.shape[-1]))
         else:
@@ -660,7 +690,7 @@ def cobra_generate(
             seq_lens = seq_mask.sum(axis=1)
             h_c = h[jnp.arange(B * K), seq_lens - 1]  # (B*K, D)
             logits = _apply_head(model, params, c, h_c) / temperature
-            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1).reshape(B, K, V)
+            logp = _constrained_logp(logits.reshape(B, K, V), trie, prefix_idx, c)
             combined = (beam_scores[..., None] + logp).reshape(B, K * V)
             beam_scores, idx = jax.lax.top_k(combined, K)
             parent = idx // V
@@ -672,6 +702,10 @@ def cobra_generate(
                 ],
                 axis=-1,
             )
+            if trie is not None:
+                prefix_idx = trie.advance(
+                    jnp.take_along_axis(prefix_idx, parent, axis=1), tok, c
+                )
             if c == C - 1:
                 h_k = h_c.reshape(B, K, -1)
                 h_last = jnp.take_along_axis(h_k, parent[..., None], axis=1)
@@ -684,7 +718,7 @@ def cobra_generate(
 
 
 def _cobra_generate_cached(
-    model: Cobra, params, input_ids, vecs, K: int, temperature: float
+    model: Cobra, params, input_ids, vecs, K: int, temperature: float, trie=None
 ) -> CobraGenerationOutput:
     """KV-cached beam search: one prefill over the interleaved history at
     batch size B, then one suffix position per codebook step at (B, K).
@@ -711,9 +745,12 @@ def _cobra_generate_cached(
 
     h_c = h_pre[rows, n_valid - 1]  # (B, d) last dense position
     logits = _apply_head(model, params, 0, h_c) / temperature
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    logp = _constrained_logp(logits, trie, jnp.zeros((B,), jnp.int32), 0)
     beam_scores, tok = jax.lax.top_k(logp, K)
     beam_tokens = tok[..., None]  # (B, K, 1)
+    prefix_idx = (
+        None if trie is None else trie.advance(jnp.zeros((B, K), jnp.int32), tok, 0)
+    )
     if C == 1:
         h_last = jnp.broadcast_to(h_c[:, None], (B, K, h_c.shape[-1]))
         return CobraGenerationOutput(
@@ -737,7 +774,7 @@ def _cobra_generate_cached(
         pos = jnp.clip(n_valid + c - 1, 0, Lint - 1)
         h_c = jnp.where(full[:, None, None], h_new, h_pre[rows, pos][:, None, :])
         logits = _apply_head(model, params, c, h_c) / temperature
-        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)  # (B, K, V)
+        logp = _constrained_logp(logits, trie, prefix_idx, c)  # (B, K, V)
         combined = (beam_scores[..., None] + logp).reshape(B, K * V)
         beam_scores, idx = jax.lax.top_k(combined, K)
         parent = idx // V
@@ -749,6 +786,10 @@ def _cobra_generate_cached(
             ],
             axis=-1,
         )
+        if trie is not None:
+            prefix_idx = trie.advance(
+                jnp.take_along_axis(prefix_idx, parent, axis=1), tok, c
+            )
         caches = gather_beam_caches(caches, parent)
         if c == C - 1:
             h_last = jnp.take_along_axis(h_c, parent[..., None], axis=1)
@@ -778,6 +819,7 @@ def beam_fusion(
     alpha: float = 0.5,
     item_vecs=None,
     use_cache: bool = True,
+    trie=None,
 ) -> BeamFusionOutput:
     """Beam candidates + dense nearest-neighbour, alpha-fused (cobra.py:679-760).
 
@@ -786,7 +828,7 @@ def beam_fusion(
     gen = cobra_generate(
         model, params, input_ids, encoder_input_ids,
         n_candidates=n_beam, temperature=temperature, item_vecs=item_vecs,
-        use_cache=use_cache,
+        use_cache=use_cache, trie=trie,
     )
     item_vecs_n = l2norm(item_dense_vecs.astype(jnp.float32))
     sim = jnp.einsum("bkd,nd->bkn", gen.dense_vecs, item_vecs_n)

@@ -190,15 +190,10 @@ class HSTU(nn.Module):
         ]
         self.final_norm = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="final_norm")
 
-    def __call__(self, input_ids, timestamps=None, targets=None, deterministic=True,
-                 segment_ids=None):
-        """``segment_ids`` ((B, L) int32, 0 = pad) switches attention to
-        (causal ∧ same-segment) for packed rows. HSTU's position bias is
-        relative-only (and its temporal bias reads pairwise diffs), so
-        within-segment distances are preserved without an explicit
-        positions operand; cross-segment pairs — including their temporal
-        buckets — are masked outright. segment_ids=None is exactly the
-        original forward."""
+    def _encode(self, input_ids, timestamps=None, deterministic: bool = True,
+                segment_ids=None):
+        """Backbone shared by training/eval (`__call__`) and serving
+        (`last_hidden`): embeddings -> HSTU layers -> final norm."""
         padding_mask = input_ids == 0
         # padding_idx=0 semantics: pad row reads zero, no lookup gradient.
         x = self.item_embedding[input_ids].astype(self.dtype)
@@ -208,7 +203,18 @@ class HSTU(nn.Module):
         for layer in self.layers:
             x = layer(x, padding_mask, timestamps, deterministic, segment_ids)
 
-        x = self.final_norm(x).astype(self.dtype)
+        return self.final_norm(x).astype(self.dtype)
+
+    def __call__(self, input_ids, timestamps=None, targets=None, deterministic=True,
+                 segment_ids=None):
+        """``segment_ids`` ((B, L) int32, 0 = pad) switches attention to
+        (causal ∧ same-segment) for packed rows. HSTU's position bias is
+        relative-only (and its temporal bias reads pairwise diffs), so
+        within-segment distances are preserved without an explicit
+        positions operand; cross-segment pairs — including their temporal
+        buckets — are masked outright. segment_ids=None is exactly the
+        original forward."""
+        x = self._encode(input_ids, timestamps, deterministic, segment_ids)
         if targets is not None and self.fused_ce:
             from genrec_tpu.kernels.fused_ce import fused_ce_mean_loss
 
@@ -224,8 +230,17 @@ class HSTU(nn.Module):
             loss = per_tok.sum() / jnp.maximum(valid.sum(), 1.0)
         return logits, loss
 
+    def last_hidden(self, input_ids, timestamps=None):
+        """Serving entry point: final-norm hidden state at the LAST slot,
+        (B, d) — see SASRec.last_hidden for the right-alignment contract
+        and the skipped full-sequence logits matmul."""
+        return self._encode(input_ids, timestamps, deterministic=True)[:, -1]
+
     def predict(self, input_ids, timestamps=None, top_k: int = 10):
-        logits, _ = self(input_ids, timestamps, deterministic=True)
-        last = logits[:, -1, :].astype(jnp.float32).at[:, 0].set(-jnp.inf)
-        _, items = jax.lax.top_k(last, top_k)
+        """Shares the serving head's score-vs-table/pad-mask/top-k
+        definition (parallel.shardings.item_topk)."""
+        from genrec_tpu.parallel.shardings import item_topk
+
+        h = self.last_hidden(input_ids, timestamps)
+        _, items = item_topk(h, self.item_embedding.astype(self.dtype), top_k)
         return items

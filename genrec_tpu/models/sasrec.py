@@ -143,13 +143,10 @@ class SASRec(nn.Module):
         self.final_norm = nn.LayerNorm(epsilon=1e-8, name="final_norm", dtype=jnp.float32)
         self.emb_dropout = nn.Dropout(self.dropout)
 
-    def __call__(self, input_ids, targets=None, deterministic: bool = True,
-                 segment_ids=None, positions=None):
-        """``segment_ids``/``positions`` (both (B, L) int32) switch on the
-        packed-row path: attention becomes (causal ∧ same-segment) and the
-        learned position embedding is looked up at the WITHIN-SEGMENT
-        position instead of the row slot. With both None the behavior is
-        exactly the original single-example-per-row forward."""
+    def _encode(self, input_ids, deterministic: bool, segment_ids=None,
+                positions=None):
+        """Backbone shared by training/eval (`__call__`) and serving
+        (`last_hidden`): embeddings -> blocks -> final norm, (B, L, d)."""
         B, L = input_ids.shape
         mask = (input_ids != 0)[..., None].astype(self.dtype)
 
@@ -165,7 +162,16 @@ class SASRec(nn.Module):
             x = block(x, mask, deterministic, segment_ids)
             x = x * mask  # re-mask after every block (official-impl quirk)
 
-        x = self.final_norm(x)
+        return self.final_norm(x)
+
+    def __call__(self, input_ids, targets=None, deterministic: bool = True,
+                 segment_ids=None, positions=None):
+        """``segment_ids``/``positions`` (both (B, L) int32) switch on the
+        packed-row path: attention becomes (causal ∧ same-segment) and the
+        learned position embedding is looked up at the WITHIN-SEGMENT
+        position instead of the row slot. With both None the behavior is
+        exactly the original single-example-per-row forward."""
+        x = self._encode(input_ids, deterministic, segment_ids, positions)
         if targets is not None and self.fused_ce:
             from genrec_tpu.kernels.fused_ce import fused_ce_mean_loss
 
@@ -181,9 +187,20 @@ class SASRec(nn.Module):
             loss = per_tok.sum() / jnp.maximum(valid.sum(), 1.0)
         return logits, loss
 
+    def last_hidden(self, input_ids):
+        """Serving entry point: final-norm hidden state at the LAST slot,
+        (B, d). Callers right-align histories so slot L-1 holds the newest
+        item. Skips the (B, L, V) full-sequence logits matmul of
+        `__call__` — the retrieval head scores only this one position
+        against the item table (O(B·V·d) instead of O(B·L·V·d))."""
+        return self._encode(input_ids, deterministic=True)[:, -1]
+
     def predict(self, input_ids, top_k: int = 10):
-        """Top-k next items from the last position; pad id excluded."""
-        logits, _ = self(input_ids, deterministic=True)
-        last = logits[:, -1, :].astype(jnp.float32).at[:, 0].set(-jnp.inf)
-        _, items = jax.lax.top_k(last, top_k)
+        """Top-k next items from the last position; pad id excluded.
+        Same scoring as the serving retrieval head (one shared
+        definition of score-vs-table / pad-mask / top-k)."""
+        from genrec_tpu.parallel.shardings import item_topk
+
+        h = self.last_hidden(input_ids).astype(self.dtype)
+        _, items = item_topk(h, self.item_embedding.astype(self.dtype), top_k)
         return items

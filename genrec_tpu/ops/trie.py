@@ -118,3 +118,27 @@ def build_trie(valid_ids: np.ndarray, codebook_size: int, dense_max_bits: int = 
     if codebook_size**D <= dense_max_bits:
         return DenseTrie.build(valid_ids, codebook_size)
     return PackedTrie.build(valid_ids, codebook_size)
+
+
+def tuples_are_valid(trie, seqs: jax.Array) -> jax.Array:
+    """(..., D) sem-id tuples -> (...) bool: is each a complete legal item?
+
+    Walks legal_mask/advance from the root, so it works for BOTH trie
+    types despite their different prefix representations (packed base-K
+    ints vs ranks). Fully on device and jit-able. This is the property
+    constrained decoding guarantees — the serving engine and the
+    trie-constraint tests use it to certify that every emitted tuple is a
+    real item id.
+    """
+    if seqs.shape[-1] != trie.depth:
+        raise ValueError(f"tuples of depth {seqs.shape[-1]} vs trie depth {trie.depth}")
+    lead = seqs.shape[:-1]
+    flat = seqs.reshape(-1, trie.depth)
+    prefix = jnp.zeros(flat.shape[0], jnp.int32)
+    ok = jnp.ones(flat.shape[0], bool)
+    for t in range(trie.depth):
+        tok = flat[:, t]
+        legal = trie.legal_mask(prefix, t)  # (N, K)
+        ok = ok & jnp.take_along_axis(legal, tok[:, None], axis=1)[:, 0]
+        prefix = trie.advance(prefix, tok, t)
+    return ok.reshape(lead)

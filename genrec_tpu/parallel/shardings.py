@@ -10,9 +10,11 @@ automatically (optax init inherits placements).
 
 from __future__ import annotations
 
+import functools
 from typing import Callable, Sequence
 
 import jax
+import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 # A rule: (path-substring predicate, axis index to shard, mesh axis name).
@@ -53,6 +55,62 @@ def moe_rules(expert_axis: str = "expert") -> Sequence[Rule]:
             expert_axis,
         ),
     )
+
+
+def retrieval_rules(model_axis: str = "model") -> Sequence[Rule]:
+    """Serving-retrieval sharding: the tied item-embedding table (the only
+    big tensor in SASRec/HSTU) sharded by ROWS (items) over the model
+    axis, so the last-hidden scoring matmul h @ emb.T shards the item
+    axis and `item_topk` merges per-shard top-k — the full (B, V) score
+    matrix never lives on one device."""
+    return ((lambda p: p.endswith("item_embedding"), 0, model_axis),)
+
+
+def item_topk(h, item_emb, k: int, *, mesh: Mesh | None = None,
+              model_axis: str = "model"):
+    """Top-k items from last-hidden states: (B, d) x (V, d) -> scores/ids
+    (B, k), fp32, with the pad row (item id 0) excluded.
+
+    With a mesh whose ``model_axis`` divides V, runs as a shard_map over
+    the item axis: each device scores and top-k's only ITS slice of the
+    table, then the (B, k*n_shards) locals merge with one small top-k —
+    per-device score memory drops n_shards-fold. Otherwise (mesh=None,
+    degree 1, or non-divisible V) the plain single-device computation.
+    """
+    V = item_emb.shape[0]
+    k = min(k, V)
+
+    def plain(h, emb):
+        scores = (h @ emb.T).astype(jnp.float32)
+        scores = scores.at[:, 0].set(-jnp.inf)
+        return jax.lax.top_k(scores, k)
+
+    if mesh is None or model_axis not in mesh.shape:
+        return plain(h, item_emb)
+    n = mesh.shape[model_axis]
+    if n <= 1 or V % n != 0 or V // n < k:
+        return plain(h, item_emb)
+    try:  # jax >= 0.5 exports shard_map at top level
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(), P(model_axis, None)),
+        out_specs=(P(None, model_axis), P(None, model_axis)),
+    )
+    def local_topk(h, emb_shard):
+        offset = jax.lax.axis_index(model_axis) * emb_shard.shape[0]
+        scores = (h @ emb_shard.T).astype(jnp.float32)
+        ids = offset + jnp.arange(emb_shard.shape[0])
+        scores = jnp.where(ids[None, :] == 0, -jnp.inf, scores)
+        s, i = jax.lax.top_k(scores, k)
+        return s, i + offset
+
+    s, i = local_topk(h, item_emb)  # (B, k*n) each
+    s_top, sel = jax.lax.top_k(s, k)
+    return s_top, jnp.take_along_axis(i, sel, axis=1)
 
 
 def param_specs(params, rules: Sequence[Rule], mesh: Mesh, log_fn=None):

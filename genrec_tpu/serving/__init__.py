@@ -1,0 +1,35 @@
+"""Online serving: dynamic micro-batching, bucketed compilation,
+trie-constrained generative + sharded retrieval heads, hot checkpoint
+reload, graceful drain. See docs/SERVING.md for the architecture."""
+
+from genrec_tpu.serving.buckets import BucketLadder, default_ladder
+from genrec_tpu.serving.engine import ServingEngine
+from genrec_tpu.serving.heads import (
+    CobraGenerativeHead,
+    RetrievalHead,
+    TigerGenerativeHead,
+)
+from genrec_tpu.serving.metrics import LatencyHistogram, ServingMetrics
+from genrec_tpu.serving.types import (
+    DrainingError,
+    Request,
+    Response,
+    ServingError,
+    UnknownHeadError,
+)
+
+__all__ = [
+    "BucketLadder",
+    "CobraGenerativeHead",
+    "DrainingError",
+    "LatencyHistogram",
+    "Request",
+    "Response",
+    "RetrievalHead",
+    "ServingEngine",
+    "ServingError",
+    "ServingMetrics",
+    "TigerGenerativeHead",
+    "UnknownHeadError",
+    "default_ladder",
+]

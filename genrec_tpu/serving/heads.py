@@ -1,0 +1,330 @@
+"""Engine heads: how each model family answers a padded micro-batch.
+
+A head owns a model + its item-corpus tables and exposes four hooks the
+engine composes:
+
+- ``make_batch(reqs, B, L)``: pad a list of requests into device arrays
+  at the (B, L) bucket — fewer rows than B are zero/pad-filled, histories
+  longer than L keep their newest items;
+- ``make_fn(B, L)``: the pure function (params, *batch) -> outputs that
+  the engine AOT-compiles once per bucket;
+- ``finalize(outputs, reqs)``: host-side split of the batch outputs into
+  per-request payloads;
+- ``on_params(params)``: refresh derived tables after a hot reload (the
+  COBRA head re-encodes its item tower here).
+
+Two families:
+
+- **Generative** (TIGER, COBRA): trie-constrained KV-cached beam search —
+  `ops/trie` legal-item masking is fused into every decode step, so each
+  emitted sem-id tuple is a REAL item and maps back to an item id through
+  the corpus lookup ("Vectorizing the Trie", arxiv 2602.22647: the mask
+  must live on-accelerator or the decode loop syncs to host every step).
+- **Retrieval** (SASRec, HSTU): `last_hidden` (one position, not the full
+  sequence) scored against the tied item-embedding table through
+  `parallel.shardings.item_topk`, which shards the item axis when the
+  engine runs on a mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from genrec_tpu.ops.trie import build_trie
+
+
+class Head:
+    """Interface + shared history padding helpers."""
+
+    name: str
+    top_k: int
+    generative = False
+
+    def on_params(self, params) -> None:  # derived-table refresh hook
+        del params
+
+    def validate(self, req) -> None:
+        """Reject malformed requests AT SUBMIT TIME, so the error goes to
+        the one bad caller — not (via the batch-failure path) to every
+        innocent request co-batched with it. Negative ids would silently
+        wrap through numpy/jnp indexing; ids past the corpus/vocab are
+        silently CLAMPED by jax's out-of-bounds gather — both would make
+        the engine answer confidently from the wrong history."""
+        h = np.asarray(req.history, np.int64).reshape(-1)
+        if h.size and h.min() < 0:
+            raise ValueError(f"negative item ids in request history: {h[h < 0][:5]}")
+        hi = self.max_item_id()
+        if hi is not None and h.size and h.max() > hi:
+            raise ValueError(
+                f"request history ids exceed the corpus (max valid id {hi}): "
+                f"{h[h > hi][:5]}"
+            )
+
+    def max_item_id(self):
+        """Largest valid history item id, or None when unknown."""
+        return None
+
+    def natural_len(self, req) -> int:
+        return len(req.history)
+
+    def dummy_request(self, length: int = 1):
+        from genrec_tpu.serving.types import Request
+
+        return Request(head=self.name, history=np.zeros(length, np.int64))
+
+    def make_batch(self, reqs, B: int, L: int):
+        raise NotImplementedError
+
+    def make_fn(self, B: int, L: int):
+        raise NotImplementedError
+
+    def finalize(self, outputs, reqs) -> list[dict]:
+        raise NotImplementedError
+
+
+def _clip_history(history, L: int) -> np.ndarray:
+    """Newest-L items of a history (the informative tail). Id-range
+    checks happen in Head.validate at submit time; the batch path only
+    backstops against wrap-around indexing."""
+    h = np.asarray(history, np.int64).reshape(-1)
+    if len(h) and h.min() < 0:
+        raise ValueError(f"negative item ids in request history: {h[h < 0][:5]}")
+    return h[-L:] if len(h) > L else h
+
+
+class _CorpusLookup:
+    """sem-id tuple -> corpus item id, for mapping generative beams back
+    to servable items. Constrained decoding guarantees every tuple is in
+    the corpus; -1 (never expected) would flag a constraint violation."""
+
+    def __init__(self, item_sem_ids: np.ndarray):
+        self._map = {tuple(int(c) for c in row): i for i, row in enumerate(item_sem_ids)}
+
+    def __call__(self, tuples: np.ndarray) -> np.ndarray:
+        return np.asarray(
+            [self._map.get(tuple(int(c) for c in t), -1) for t in tuples], np.int64
+        )
+
+
+class TigerGenerativeHead(Head):
+    """TIGER beam search through the PR-1 KV-cached engine, trie-masked.
+
+    ``item_sem_ids``: (N, D) sem-id tuple per corpus item; requests carry
+    item ids indexing this table. Beam search is deterministic (pure beam,
+    no Gumbel sampling) so identical requests get identical answers.
+    """
+
+    generative = True
+
+    def __init__(self, model, item_sem_ids: np.ndarray, trie=None,
+                 top_k: int = 10, name: str = "tiger"):
+        self.model = model
+        self.name = name
+        self.top_k = top_k
+        self.item_sem_ids = np.asarray(item_sem_ids, np.int64)
+        self.trie = trie if trie is not None else build_trie(
+            self.item_sem_ids, model.num_item_embeddings
+        )
+        self._lookup = _CorpusLookup(self.item_sem_ids)
+
+    def max_item_id(self):
+        return len(self.item_sem_ids) - 1
+
+    def make_batch(self, reqs, B: int, L: int):
+        D = self.model.sem_id_dim
+        ids = np.zeros((B, L * D), np.int32)
+        mask = np.zeros((B, L * D), np.int32)
+        user = np.zeros((B,), np.int32)
+        for i, r in enumerate(reqs):
+            h = _clip_history(r.history, L)
+            if len(h):
+                ids[i, : len(h) * D] = self.item_sem_ids[h].reshape(-1)
+                mask[i, : len(h) * D] = 1
+            user[i] = int(r.user_id) % self.model.num_user_embeddings
+        types = np.tile(np.arange(D, dtype=np.int32), (B, L))
+        return (jnp.asarray(user), jnp.asarray(ids), jnp.asarray(types),
+                jnp.asarray(mask))
+
+    def make_fn(self, B: int, L: int):
+        from genrec_tpu.models.tiger import tiger_generate
+
+        def fn(params, user, ids, types, mask):
+            out = tiger_generate(
+                self.model, params, self.trie, user, ids, types, mask,
+                jax.random.key(0), n_top_k_candidates=self.top_k,
+                deterministic=True, use_cache=True,
+            )
+            return out.sem_ids, out.log_probas
+
+        return fn
+
+    def finalize(self, outputs, reqs) -> list[dict]:
+        sem_ids, logp = outputs
+        return [
+            dict(items=self._lookup(sem_ids[i]), scores=np.asarray(logp[i]),
+                 sem_ids=np.asarray(sem_ids[i]))
+            for i in range(len(reqs))
+        ]
+
+
+class CobraGenerativeHead(Head):
+    """COBRA cached beam search, trie-masked, over a precomputed item tower.
+
+    The sparse side of each history item comes from ``item_sem_ids``
+    (N, C); the dense side from per-item vectors — either supplied
+    directly (``item_vecs``) or re-encoded from ``item_text_tokens``
+    through the model's text encoder on every params (re)load, so a hot
+    checkpoint reload refreshes the item tower too.
+    """
+
+    generative = True
+
+    def __init__(self, model, item_sem_ids: np.ndarray,
+                 item_vecs: Optional[np.ndarray] = None,
+                 item_text_tokens: Optional[np.ndarray] = None,
+                 trie=None, top_k: int = 10, name: str = "cobra"):
+        if item_vecs is None and item_text_tokens is None:
+            raise ValueError("need item_vecs or item_text_tokens")
+        self.model = model
+        self.name = name
+        self.top_k = top_k
+        self.item_sem_ids = np.asarray(item_sem_ids, np.int64)
+        self.item_vecs = None if item_vecs is None else np.asarray(item_vecs)
+        self._text_tokens = (
+            None if item_text_tokens is None else jnp.asarray(item_text_tokens)
+        )
+        self._encode = None
+        self.trie = trie if trie is not None else build_trie(
+            self.item_sem_ids, model.id_vocab_size
+        )
+        self._lookup = _CorpusLookup(self.item_sem_ids)
+
+    def max_item_id(self):
+        return len(self.item_sem_ids) - 1
+
+    def on_params(self, params) -> None:
+        if self._text_tokens is None:
+            return
+        from genrec_tpu.models.cobra import Cobra
+
+        if self._encode is None:
+            self._encode = jax.jit(
+                lambda p, t: self.model.apply(
+                    {"params": p}, t, method=Cobra.encode_items
+                )
+            )
+        self.item_vecs = np.asarray(self._encode(params, self._text_tokens))
+
+    def make_batch(self, reqs, B: int, L: int):
+        C = self.model.n_codebooks
+        d = self.item_vecs.shape[-1]
+        ids = np.full((B, L * C), self.model.pad_id, np.int32)
+        vecs = np.zeros((B, L, d), self.item_vecs.dtype)
+        for i, r in enumerate(reqs):
+            h = _clip_history(r.history, L)
+            if len(h):
+                ids[i, : len(h) * C] = self.item_sem_ids[h].reshape(-1)
+                vecs[i, : len(h)] = self.item_vecs[h]
+        return jnp.asarray(ids), jnp.asarray(vecs)
+
+    def make_fn(self, B: int, L: int):
+        from genrec_tpu.models.cobra import cobra_generate
+
+        def fn(params, ids, vecs):
+            out = cobra_generate(
+                self.model, params, ids, None, n_candidates=self.top_k,
+                temperature=1.0, item_vecs=vecs, use_cache=True,
+                trie=self.trie,
+            )
+            return out.sem_ids, out.scores
+
+        return fn
+
+    def finalize(self, outputs, reqs) -> list[dict]:
+        sem_ids, scores = outputs
+        return [
+            dict(items=self._lookup(sem_ids[i]), scores=np.asarray(scores[i]),
+                 sem_ids=np.asarray(sem_ids[i]))
+            for i in range(len(reqs))
+        ]
+
+
+class RetrievalHead(Head):
+    """SASRec/HSTU: right-aligned history -> last_hidden -> sharded top-k.
+
+    Histories are RIGHT-aligned (newest item in slot L-1, zeros pad the
+    left) so the model's last position is the prediction point — the same
+    layout the SASRec eval path uses. ``use_timestamps=True`` (HSTU with
+    temporal bias) batches each request's timestamps alongside.
+    """
+
+    def __init__(self, name: str, model, top_k: int = 10,
+                 use_timestamps: bool = False, mesh=None,
+                 model_axis: str = "model"):
+        self.name = name
+        self.model = model
+        self.top_k = top_k
+        self.use_timestamps = use_timestamps
+        self.mesh = mesh
+        self.model_axis = model_axis
+        # SASRec/HSTU position tables are sized max_seq_len: a history
+        # bucket past it would crash the warmup trace with an opaque
+        # broadcast error, so buckets clamp here (the over-long tail is
+        # truncated to the newest items, same as the ladder contract).
+        self._max_len = int(getattr(model, "max_seq_len", 0)) or None
+
+    def max_item_id(self):
+        return int(self.model.num_items)
+
+    def _clamp(self, L: int) -> int:
+        return min(L, self._max_len) if self._max_len else L
+
+    def make_batch(self, reqs, B: int, L: int):
+        L = self._clamp(L)
+        ids = np.zeros((B, L), np.int32)
+        ts = np.zeros((B, L), np.int32) if self.use_timestamps else None
+        for i, r in enumerate(reqs):
+            h = _clip_history(r.history, L)
+            if len(h):
+                ids[i, L - len(h):] = h
+                if ts is not None and r.timestamps is not None:
+                    t = np.asarray(r.timestamps, np.int64).reshape(-1)[-len(h):]
+                    ts[i, L - len(t):] = t
+        out = (jnp.asarray(ids),)
+        if ts is not None:
+            out = out + (jnp.asarray(ts),)
+        return out
+
+    def make_fn(self, B: int, L: int):
+        from genrec_tpu.parallel.shardings import item_topk
+
+        del L  # shapes come from make_batch (same clamp)
+        model = self.model
+
+        def fn(params, ids, *rest):
+            if self.use_timestamps:
+                h = model.apply(
+                    {"params": params}, ids, rest[0], method=type(model).last_hidden
+                )
+            else:
+                h = model.apply(
+                    {"params": params}, ids, method=type(model).last_hidden
+                )
+            return item_topk(
+                h.astype(jnp.float32), params["item_embedding"], self.top_k,
+                mesh=self.mesh, model_axis=self.model_axis,
+            )
+
+        return fn
+
+    def finalize(self, outputs, reqs) -> list[dict]:
+        scores, items = outputs
+        return [
+            dict(items=np.asarray(items[i]), scores=np.asarray(scores[i]),
+                 sem_ids=None)
+            for i in range(len(reqs))
+        ]

@@ -1,0 +1,59 @@
+"""Typed request/response surface of the online serving engine.
+
+A `Request` names a head and carries the user's item-id history (oldest
+first); the engine answers with a `Response` holding the top-k items,
+their scores, the checkpoint step that served them, and the per-request
+latency breakdown (queue wait / batch compute / total) that feeds the
+engine's histograms.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+
+class ServingError(RuntimeError):
+    """Base class for engine-surface errors."""
+
+
+class DrainingError(ServingError):
+    """The engine caught SIGTERM/SIGINT (or `stop()` was called) and is
+    draining: every already-accepted request completes, new submissions
+    are rejected with this typed error so callers can fail over."""
+
+
+class UnknownHeadError(ServingError, KeyError):
+    """Request names a head the engine was not built with."""
+
+
+@dataclasses.dataclass
+class Request:
+    """One user query.
+
+    ``history``: (n,) int item ids, oldest -> newest. Generative heads
+    index their corpus tables with these; retrieval heads feed them as
+    vocabulary ids (1-based, 0 = pad). Histories longer than the largest
+    history bucket keep their NEWEST items. ``timestamps`` feeds HSTU's
+    temporal bias when the head was built with use_timestamps=True.
+    """
+
+    head: str
+    history: np.ndarray
+    user_id: int = 0
+    timestamps: Optional[np.ndarray] = None
+
+
+@dataclasses.dataclass
+class Response:
+    head: str
+    items: np.ndarray  # (k,) item ids; -1 for a generative tuple not in corpus
+    scores: np.ndarray  # (k,) fp32
+    sem_ids: Optional[np.ndarray]  # (k, D) for generative heads, else None
+    params_step: Optional[int]  # checkpoint step serving this request
+    bucket: tuple[int, int]  # (batch, history) bucket the micro-batch ran in
+    queue_wait_s: float
+    compute_s: float
+    total_s: float

@@ -1,0 +1,155 @@
+"""Steady-state compilation check for the serving engine (pattern:
+scripts/check_decode_hlo.py): does the bucketed compilation ladder really
+make the serving path shape-stable?
+
+Starts an in-process ServingEngine (TIGER generative head, the deepest
+compile surface: encoder + KV-cached constrained beam loop), warms up the
+full (batch-bucket x history-bucket) grid, then serves N steady-state
+requests across MIXED history lengths and micro-batch sizes and asserts:
+
+  1. the engine's recompilation counter stays ZERO — every steady-state
+     request ran in an executable AOT-compiled at warmup (the engine only
+     compiles on an executable-cache miss, so the counter is exact);
+  2. the traffic genuinely exercised bucket variety (>= 3 distinct
+     (batch, history) buckets hit) — otherwise assertion 1 is vacuous;
+  3. every generative answer is a real corpus item (items >= 0): the
+     trie constraint held through the compiled path.
+
+Run:  python scripts/check_serving_hlo.py             (default shapes)
+      python scripts/check_serving_hlo.py --small     (CI-speed shapes)
+Appends a verdict line to docs/PERF.md when --write-note is passed.
+Prints ONE JSON verdict line on stdout; rc 0 ok / 1 failed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--write-note", action="store_true",
+                    help="append the verdict to docs/PERF.md")
+    ap.add_argument("--small", action="store_true",
+                    help="tiny shapes for fast CI runs")
+    ap.add_argument("--platform", default=None)
+    args = ap.parse_args(argv)
+
+    import jax
+
+    if args.platform:
+        from genrec_tpu.parallel.mesh import pin_platform
+
+        pin_platform(args.platform)
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from genrec_tpu.models.tiger import Tiger
+    from genrec_tpu.serving import BucketLadder, Request, ServingEngine
+    from genrec_tpu.serving.heads import TigerGenerativeHead
+
+    backend = jax.default_backend()
+    if args.small:
+        n_corpus = 50
+        arch = dict(embedding_dim=16, attn_dim=32, dropout=0.0, num_heads=4,
+                    n_layers=2, num_item_embeddings=8, num_user_embeddings=20,
+                    sem_id_dim=3)
+        ladder = BucketLadder((1, 2), (4, 8))
+        n_requests = 16
+    else:
+        n_corpus = 1000
+        arch = dict(embedding_dim=64, attn_dim=128, dropout=0.0, num_heads=4,
+                    n_layers=4, num_item_embeddings=64,
+                    num_user_embeddings=10_000, sem_id_dim=3)
+        ladder = BucketLadder((1, 4, 8), (8, 16))
+        n_requests = 48
+    D = arch["sem_id_dim"]
+    Kcb = arch["num_item_embeddings"]
+    max_hist = ladder.history_buckets[-1]
+
+    model = Tiger(**arch)
+    rng = np.random.default_rng(0)
+    valid_ids = np.unique(rng.integers(0, Kcb, (n_corpus, D)), axis=0)
+    B0, L0 = 2, 2 * D
+    params = model.init(
+        jax.random.key(0),
+        jnp.zeros((B0,), jnp.int32), jnp.zeros((B0, L0), jnp.int32),
+        jnp.zeros((B0, L0), jnp.int32), jnp.zeros((B0, D), jnp.int32),
+        jnp.zeros((B0, D), jnp.int32), jnp.ones((B0, L0), jnp.int32),
+    )["params"]
+
+    head = TigerGenerativeHead(model, valid_ids, top_k=5)
+    engine = ServingEngine(
+        [head], params, ladder=ladder, max_batch=ladder.max_batch,
+        max_wait_ms=1.0, handle_signals=False,
+    ).start()
+
+    # Steady state: groups of varying size (1..max_batch) with histories
+    # spanning every history bucket — the mixed traffic the ladder exists
+    # to keep shape-stable. Submit each group as a burst so micro-batches
+    # of different sizes actually form.
+    served = 0
+    items_ok = True
+    group_sizes = [1, ladder.max_batch, 2, ladder.max_batch, 1, 3]
+    while served < n_requests:
+        g = group_sizes[served % len(group_sizes)]
+        futs = []
+        for _ in range(min(g, n_requests - served)):
+            n = int(rng.integers(1, max_hist + 1))
+            futs.append(engine.submit(Request(
+                head=head.name,
+                history=rng.integers(0, len(valid_ids), n),
+                user_id=int(rng.integers(0, arch["num_user_embeddings"])),
+            )))
+        for f in futs:
+            r = f.result(300)
+            items_ok = items_ok and bool((np.asarray(r.items) >= 0).all())
+        served += len(futs)
+
+    stats = engine.stop()
+    buckets_hit = len(stats["bucket_hits"])
+    recompiles = stats["recompilations"]
+    ok = recompiles == 0 and buckets_hit >= 3 and items_ok and stats[
+        "completed"
+    ] == n_requests
+    verdict = {
+        "backend": backend,
+        "warmup_compiles": stats["warmup_compiles"],
+        "steady_state_requests": served,
+        "recompilations": recompiles,
+        "buckets_hit": buckets_hit,
+        "bucket_hits": stats["bucket_hits"],
+        "constrained_items_valid": items_ok,
+        "p50_ms": stats["total_ms"]["p50"],
+        "p99_ms": stats["total_ms"]["p99"],
+        "ok": ok,
+    }
+    print(json.dumps(verdict))
+
+    if args.write_note:
+        if ok:
+            msg = (
+                f"OK: {served} steady-state requests over {buckets_hit} "
+                f"(batch, history) buckets with 0 recompilations "
+                f"({stats['warmup_compiles']} warmup executables)"
+            )
+        else:
+            msg = "ATTENTION: serving engine recompiled in steady state"
+        note = (
+            f"\n- Serving HLO check (scripts/check_serving_hlo.py, backend="
+            f"{backend}): {msg}\n"
+        )
+        with open(os.path.join(REPO, "docs", "PERF.md"), "a") as f:
+            f.write(note)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -5,6 +5,10 @@
 #   check_decode_hlo.py    — KV-cached decode compiles w/o K-fold memory
 #   check_fused_ce_hlo.py  — fused-CE Mosaic call partitions under the mesh
 #   check_packed_hlo.py    — packed train step has no per-example re-pad
+#   check_serving_hlo.py   — serving engine: zero steady-state XLA
+#                            recompilations across mixed-shape traffic
+#   serving smoke          — CPU in-process engine: all four heads answer,
+#                            SIGTERM drains cleanly, hot reload + quarantine
 #   tpu_kernel_check.py    — Pallas kernels at trainer shapes (TPU only)
 #   test_fault_tolerance   — chaos suite: SIGTERM mid-epoch + exact resume,
 #                            checkpoint integrity ladder, non-finite guard
@@ -69,12 +73,21 @@ if [ "$MODE" = "--smoke" ]; then
     run python scripts/check_decode_hlo.py --small --platform cpu
     run python scripts/check_fused_ce_hlo.py --small --platform cpu
     run python scripts/check_packed_hlo.py --small --platform cpu
+    run python scripts/check_serving_hlo.py --small --platform cpu
     # Chaos-unit subset (checkpoint corruption, non-finite guard, signal
     # latching; no trainer runs) — pytest output goes to stderr so the
     # entrypoint's stdout stays one verdict JSON per HLO check.
     # GENREC_CI_SKIP_CHAOS=1 skips it for callers that already run the
     # chaos suite directly (the tier-1 pytest pass does).
     if [ -z "${GENREC_CI_SKIP_CHAOS:-}" ]; then
+        # CPU serving smoke: in-process engine serves all four heads
+        # (TIGER, COBRA, SASRec, HSTU), SIGTERM drains cleanly mid-load,
+        # a garbled newest checkpoint is quarantined while serving
+        # continues. Output to stderr so stdout stays one verdict JSON
+        # per HLO check; same skip knob as the chaos subset (the tier-1
+        # pytest pass already runs these tests directly).
+        run_strict env JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py \
+            -q -m serving_smoke -p no:cacheprovider 1>&2
         run_strict env JAX_PLATFORMS=cpu python -m pytest tests/test_fault_tolerance.py \
             -q -m chaos_unit -p no:cacheprovider 1>&2
         # Multi-host chaos smoke: 2 real jax.distributed CPU workers prove
@@ -88,6 +101,11 @@ else
     run python scripts/check_decode_hlo.py --write-note
     run python scripts/check_fused_ce_hlo.py --write-note
     run python scripts/check_packed_hlo.py --write-note
+    run python scripts/check_serving_hlo.py --write-note
+    # Full serving suite (incl. the slow all-four-heads drain test and
+    # the slow COBRA trie-constraint pins).
+    run_strict env JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py \
+        tests/test_trie_constrained.py -q -p no:cacheprovider 1>&2
     # Full chaos suite: SIGTERM mid-epoch + exact-resume parity for all
     # seven trainers, ladder fallback, NaN injection — plus the 2-process
     # multi-host chaos (consensus restore, mid-save host kill, init

@@ -102,6 +102,7 @@ def test_main_falls_back_to_committed_artifact(tmp_path, monkeypatch, capsys):
     committed artifact relabeled cached-tpu-committed — never a CPU line."""
     monkeypatch.setattr(bench, "_measure_tpu", lambda *a, **k: None)
     monkeypatch.setattr(bench, "_cpu_packed_supplement", lambda *a, **k: None)
+    monkeypatch.setattr(bench, "_cpu_serve_supplement", lambda *a, **k: None)
     monkeypatch.setattr(bench, "TPU_RESULT_CACHE", str(tmp_path / "absent.json"))
     committed = tmp_path / "bench.json"
     committed.write_text(json.dumps({
@@ -136,6 +137,7 @@ def test_main_committed_fallback_fills_packed_ratio_from_cpu(
         "batch_size": 256,
     }))
     monkeypatch.setattr(bench, "TPU_RESULT_COMMITTED", str(committed))
+    monkeypatch.setattr(bench, "_cpu_serve_supplement", lambda *a, **k: None)
     monkeypatch.setattr(bench, "_cpu_packed_supplement", lambda *a, **k: {
         "backend": "cpu", "n_chips": 1, "train_tokens_per_sec": 192.7,
         "pack_occupancy": 0.9654, "packed_vs_padded": 2.857,
@@ -160,6 +162,7 @@ def test_main_includes_packed_metric_fields(monkeypatch, capsys):
         "pack_occupancy": 0.31, "packed_vs_padded": 2.9,
         "packed_rows": 80, "packed_examples": 1024,
     })
+    monkeypatch.setattr(bench, "_cpu_serve_supplement", lambda *a, **k: None)
     bench.main()
     line = json.loads(capsys.readouterr().out)
     assert line["tiger_train_tokens_per_sec_per_chip"] == 61440.0
@@ -175,6 +178,7 @@ def test_main_live_line_missing_packed_gets_cpu_supplement(monkeypatch, capsys):
         "backend": "tpu", "n_chips": 1, "seq_per_sec": 100.0, "step_ms": 1.0,
         "batch_size": 256,
     })
+    monkeypatch.setattr(bench, "_cpu_serve_supplement", lambda *a, **k: None)
     monkeypatch.setattr(bench, "_cpu_packed_supplement", lambda *a, **k: {
         "backend": "cpu", "n_chips": 1, "train_tokens_per_sec": 530.0,
         "pack_occupancy": 0.88, "packed_vs_padded": 2.0,
@@ -184,6 +188,35 @@ def test_main_live_line_missing_packed_gets_cpu_supplement(monkeypatch, capsys):
     assert line["source"] == "live"
     assert line["packed_vs_padded"] == 2.0
     assert line["packed_source"] == "cpu"
+
+
+def test_main_live_line_missing_serve_gets_cpu_supplement(monkeypatch, capsys):
+    """TPU evidence predating the serving engine gets the same-backend
+    serve section certified live on CPU, stamped serve.source="cpu"; a
+    result already carrying serve passes through unrelabeled."""
+    monkeypatch.setattr(bench, "_measure_tpu", lambda *a, **k: {
+        "backend": "tpu", "n_chips": 1, "seq_per_sec": 100.0, "step_ms": 1.0,
+        "batch_size": 256,
+    })
+    monkeypatch.setattr(bench, "_cpu_packed_supplement", lambda *a, **k: None)
+    monkeypatch.setattr(bench, "_cpu_serve_supplement", lambda *a, **k: {
+        "backend": "cpu", "n_chips": 1,
+        "serve": {"batch": 16, "batched_vs_sequential": 4.9, "p50_ms": 700.0},
+    })
+    bench.main()
+    line = json.loads(capsys.readouterr().out)
+    assert line["serve"]["batched_vs_sequential"] == 4.9
+    assert line["serve"]["source"] == "cpu"
+
+    monkeypatch.setattr(bench, "_measure_tpu", lambda *a, **k: {
+        "backend": "tpu", "n_chips": 1, "seq_per_sec": 100.0, "step_ms": 1.0,
+        "batch_size": 256,
+        "serve": {"batch": 16, "batched_vs_sequential": 11.0, "p50_ms": 9.0},
+    })
+    bench.main()
+    line = json.loads(capsys.readouterr().out)
+    assert line["serve"]["batched_vs_sequential"] == 11.0
+    assert "source" not in line["serve"]  # native measurement, no relabel
 
 
 def test_amazon_like_lengths_short_dominated():
@@ -207,6 +240,7 @@ def test_main_includes_decode_metric_fields(monkeypatch, capsys):
         "decode_vs_uncached": 4.6, "decode_batch_size": 64, "decode_beam_k": 10,
     })
     monkeypatch.setattr(bench, "_cpu_packed_supplement", lambda *a, **k: None)
+    monkeypatch.setattr(bench, "_cpu_serve_supplement", lambda *a, **k: None)
     bench.main()
     line = json.loads(capsys.readouterr().out)
     assert line["tiger_decode_seq_per_sec_per_chip"] == 640.0

@@ -58,6 +58,9 @@ def test_ci_checks_smoke_entrypoint():
         env={**os.environ, "JAX_PLATFORMS": "cpu", "GENREC_CI_SKIP_CHAOS": "1"},
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
-    # One verdict JSON per check on stdout.
+    # One verdict JSON per check on stdout (decode, fused-ce, packed,
+    # serving).
     verdicts = [json.loads(l) for l in proc.stdout.splitlines() if l.strip()]
-    assert len(verdicts) == 3
+    assert len(verdicts) == 4
+    serving = [v for v in verdicts if "recompilations" in v]
+    assert len(serving) == 1 and serving[0]["recompilations"] == 0
