@@ -85,14 +85,28 @@ def log_serving_stats(logger, tracker, stats: Mapping[str, Any]) -> None:
         f"recompilations={stats.get('recompilations', 0)} "
         f"step={stats.get('params_step')}"
     )
+    # Paged decode heads: one pool-pressure line per head (pages + slot
+    # occupancy + churn), so an operator sees "pool-bound" vs "idle" at a
+    # glance — the day-one gauges the paged KV cache ships with.
+    for head, g in (stats.get("kv_pool") or {}).items():
+        logger.info(
+            f"serving kv-pool[{head}]: pages {g.get('pages_in_use', 0)}/"
+            f"{g.get('pages_in_use', 0) + g.get('pages_free', 0)} in use, "
+            f"slots {g.get('slots_active', 0)}/{g.get('slots_total', 0)}, "
+            f"kv_tokens={g.get('kv_tokens_resident', 0)} "
+            f"admits={stats.get('admits', 0)} evictions={stats.get('evictions', 0)} "
+            f"oom_deferred={stats.get('oom_deferred_admits', 0)}"
+        )
+
+    def _flatten(prefix: str, tree: Mapping, out: dict) -> None:
+        for k, v in tree.items():
+            if isinstance(v, Mapping):
+                _flatten(f"{prefix}{k}/", v, out)
+            elif isinstance(v, (int, float)):
+                out[f"{prefix}{k}"] = v
+
     flat: dict[str, Any] = {}
-    for k, v in stats.items():
-        if isinstance(v, Mapping):
-            for kk, vv in v.items():
-                if isinstance(vv, (int, float)):
-                    flat[f"serve/{k}/{kk}"] = vv
-        elif isinstance(v, (int, float)):
-            flat[f"serve/{k}"] = v
+    _flatten("serve/", stats, flat)
     tracker.log(flat)
 
 

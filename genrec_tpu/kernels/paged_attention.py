@@ -1,0 +1,137 @@
+"""Pallas paged decode-attention kernel (Ragged Paged Attention,
+arxiv 2604.15464).
+
+One decode step reads K/V straight from the page pool through the
+per-slot block table — the gathered (S, pages*page_size, H, hd)
+contiguous copy the pure-JAX fallback materializes (ops/paged.py) never
+exists in HBM. The grid walks (slot, head, page); the block table and
+sequence lengths ride as SCALAR-PREFETCH operands so each page's
+index_map can resolve its pool row before the kernel body runs, and the
+softmax accumulates flash-style across the sequentially-executed page
+axis (running max / sum / unnormalized accumulator in revisited output
+blocks, the same accumulation discipline as the HSTU backward kernel).
+
+Numerics contract == ops/paged.py `_stats_fallback` exactly: masked
+positions (token index >= seq_len) are FILLED with -1e9 and stay inside
+the softmax, so paged == dense parity survives the kernel path too
+(pinned in tests/test_kv_pool.py the way test_hstu_kernel pins the HSTU
+kernel against its XLA reference).
+
+Shapes: the page axis is the sublane dimension of the K/V blocks, so
+``page_size`` must be a multiple of 8; beams x heads are tiny for the
+decode heads, so q/acc blocks are padded up to the (8, 128) fp32 tile in
+the wrapper. Off-TPU the kernel runs in interpreter mode (CI parity);
+on TPU `kernels.policy.auto_paged_attention` gates it in.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG = -1e9
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def _kernel(bt_ref, sl_ref, q_ref, k_ref, v_ref, acc_ref, m_ref, l_ref,
+            *, page: int, scale: float):
+    s = pl.program_id(0)
+    p = pl.program_id(2)
+
+    @pl.when(p == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros(acc_ref.shape, acc_ref.dtype)
+        # -1e9 start: a fully-masked slot keeps m == -1e9, so every masked
+        # score contributes exp(0) == 1 — the fallback's exact behavior
+        # (and the dense paths': -1e9 additive fill, not exclusion).
+        m_ref[...] = jnp.full(m_ref.shape, NEG, m_ref.dtype)
+        l_ref[...] = jnp.zeros(l_ref.shape, l_ref.dtype)
+
+    q = q_ref[0, 0].astype(jnp.float32)  # (Kp, hdp)
+    k = k_ref[0, :, 0, :].astype(jnp.float32)  # (page, hdp)
+    v = v_ref[0, :, 0, :].astype(jnp.float32)
+    scores = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale  # (Kp, page)
+
+    tok = p * page + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
+    scores = jnp.where(tok >= sl_ref[s], NEG, scores)
+
+    # m and l live lane-replicated in (Kp, 128) blocks: a lane-1 output
+    # block is not tileable, so every lane carries the row's value.
+    m_prev = m_ref[0, 0]  # (Kp, 128)
+    m_new = jnp.maximum(m_prev, scores.max(axis=1, keepdims=True))
+    e = jnp.exp(scores - m_new[:, :1])  # (Kp, page)
+    corr = jnp.exp(m_prev - m_new)  # (Kp, 128), lane-replicated
+    l_ref[0, 0] = l_ref[0, 0] * corr + e.sum(axis=1, keepdims=True)
+    m_ref[0, 0] = m_new
+    acc_ref[0, 0] = acc_ref[0, 0] * corr[:, :1] + jnp.dot(
+        e, v, preferred_element_type=jnp.float32
+    )
+
+
+def paged_attention_stats_pallas(q, k_pool, v_pool, block_tables, seq_lens,
+                                 interpret: bool | None = None):
+    """Kernel twin of ops/paged.py `_stats_fallback`: (acc, m, l) fp32.
+
+    q (S, K, H, hd); pools (P, page, H, hd); block_tables (S, Pm) int32;
+    seq_lens (S,) int32. Interpreter mode off-TPU (Mosaic compiles only
+    there), matching the HSTU kernel's convention.
+    """
+    S, K, H, hd = q.shape
+    P, page, _, _ = k_pool.shape
+    Pm = block_tables.shape[1]
+    if page % 8 != 0:
+        raise ValueError(f"page_size {page} must be a multiple of 8 (sublanes)")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    Kp = _round_up(K, 8)
+    hdp = _round_up(hd, 128)
+    qp = jnp.pad(q, ((0, 0), (0, Kp - K), (0, 0), (0, hdp - hd)))
+    qp = qp.transpose(0, 2, 1, 3)  # (S, H, Kp, hdp)
+    kp = jnp.pad(k_pool, ((0, 0), (0, 0), (0, 0), (0, hdp - hd)))
+    vp = jnp.pad(v_pool, ((0, 0), (0, 0), (0, 0), (0, hdp - hd)))
+
+    # hd zero-padding leaves q.k dot products unchanged; K(beam) padding
+    # rows produce garbage stats that are sliced away below.
+    grid = (S, H, Pm)
+    kernel = functools.partial(_kernel, page=page, scale=hd**-0.5)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, Kp, hdp), lambda s, h, p, bt, sl: (s, h, 0, 0)),
+            # The paged read: index_map resolves the pool row from the
+            # prefetched block table — page bt[s, p] of head h.
+            pl.BlockSpec((1, page, 1, hdp),
+                         lambda s, h, p, bt, sl: (bt[s, p], 0, h, 0)),
+            pl.BlockSpec((1, page, 1, hdp),
+                         lambda s, h, p, bt, sl: (bt[s, p], 0, h, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, Kp, hdp), lambda s, h, p, bt, sl: (s, h, 0, 0)),
+            pl.BlockSpec((1, 1, Kp, 128), lambda s, h, p, bt, sl: (s, h, 0, 0)),
+            pl.BlockSpec((1, 1, Kp, 128), lambda s, h, p, bt, sl: (s, h, 0, 0)),
+        ],
+    )
+    acc, m, l = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((S, H, Kp, hdp), jnp.float32),
+            jax.ShapeDtypeStruct((S, H, Kp, 128), jnp.float32),
+            jax.ShapeDtypeStruct((S, H, Kp, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), seq_lens.astype(jnp.int32), qp, kp, vp)
+
+    acc = acc[:, :, :K, :hd].transpose(0, 2, 1, 3)  # (S, K, H, hd)
+    m = m[:, :, :K, 0].transpose(0, 2, 1)  # (S, K, H)
+    l = l[:, :, :K, 0].transpose(0, 2, 1)
+    return acc, m, l

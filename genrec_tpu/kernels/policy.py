@@ -45,6 +45,14 @@ def auto_pallas_attention() -> bool:
     return not pallas_disabled() and jax.default_backend() == "tpu"
 
 
+def auto_paged_attention() -> bool:
+    """"auto" policy for the paged decode-attention kernel
+    (kernels/paged_attention.py). TPU-only: off-TPU the serving engine and
+    the parity tests run the pure-JAX gather fallback in ops/paged.py,
+    which is the numerics contract the kernel is pinned against."""
+    return not pallas_disabled() and jax.default_backend() == "tpu"
+
+
 def auto_sharded_fused_ce() -> bool:
     """"auto" policy for the vocab-SHARDED fused CE (LCRec tp>1 head,
     kernels/fused_ce.sharded_fused_linear_ce). No single-chip gate:

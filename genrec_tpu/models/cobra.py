@@ -164,6 +164,43 @@ class _TorchMHA(nn.Module):
         out = out.transpose(0, 2, 1, 3).reshape(B, L, D)
         return self.out_proj(out), (k, v)
 
+    def decode_paged(self, x, k_pool, v_pool, block_tables, seq_lens, cache,
+                     steps):
+        """`decode` with PAGED history K/V and a per-row suffix slot.
+
+        The history keys live in the shared page pool (read through each
+        row's block-table entries, positions >= seq_lens masked); the
+        suffix cache stays dense per beam and is written at the per-row
+        ``steps`` slot. The paged history partial and the dense suffix
+        partial merge through the flash identity into EXACTLY the dense
+        path's joint softmax over [history ++ suffix].
+        """
+        from genrec_tpu.ops.paged import merge_attention_stats, paged_attention_stats
+
+        B, K, D = x.shape
+        H, hd = self.num_heads, D // self.num_heads
+        q, k_new, v_new = jnp.split(self.in_proj(x), 3, axis=-1)
+        q = q.reshape(B, K, H, hd)
+        S = cache["k"].shape[2]
+        hit = (jnp.arange(S)[None, :] == steps[:, None])[:, None, :, None, None]
+        ck = jnp.where(hit, k_new.reshape(B, K, 1, H, hd), cache["k"])
+        cv = jnp.where(hit, v_new.reshape(B, K, 1, H, hd), cache["v"])
+        acc_h, m_h, l_h = paged_attention_stats(
+            q, k_pool, v_pool, block_tables, seq_lens
+        )
+        s_suf = jnp.einsum("bkhd,bkshd->bkhs", q, ck).astype(jnp.float32) * (hd**-0.5)
+        s_suf = jnp.where(
+            jnp.arange(S)[None, None, None, :] > steps[:, None, None, None],
+            -1e9, s_suf,
+        )
+        m_s = s_suf.max(axis=-1)
+        e = jnp.exp(s_suf - m_s[..., None])
+        l_s = e.sum(axis=-1)
+        acc_s = jnp.einsum("bkhs,bkshd->bkhd", e, cv.astype(jnp.float32))
+        out = merge_attention_stats(acc_h, m_h, l_h, acc_s, m_s, l_s)
+        out = out.astype(x.dtype).reshape(B, K, D)
+        return self.out_proj(out), {"k": ck, "v": cv}
+
     def decode(self, x, hist_kv, hist_pad, cache, slot: int):
         """One suffix position for K beams against the shared history K/V.
 
@@ -281,6 +318,13 @@ class _PostNormDecoderLayer(nn.Module):
         h, new_cache = self.self_attn.decode(x, hist_kv, hist_pad, cache, slot)
         return self._post_attn(x, h, True), new_cache
 
+    def decode_paged(self, x, k_pool, v_pool, block_tables, seq_lens, cache,
+                     steps):
+        h, new_cache = self.self_attn.decode_paged(
+            x, k_pool, v_pool, block_tables, seq_lens, cache, steps
+        )
+        return self._post_attn(x, h, True), new_cache
+
 
 class CobraDecoder(nn.Module):
     hidden_dim: int = 768
@@ -322,6 +366,18 @@ class CobraDecoder(nn.Module):
         new_caches = []
         for layer, hkv, cache in zip(self.layers, hist_kvs, caches):
             x, nc = layer.decode(x, hkv, hist_pad, cache, slot)
+            new_caches.append(nc)
+        return x, new_caches
+
+    def decode_paged(self, x, k_pools, v_pools, block_tables, seq_lens,
+                     caches, steps):
+        """`decode` with the per-layer history K/V read from page pools
+        and a per-row suffix slot (slot-level continuous batching)."""
+        new_caches = []
+        for layer, kp, vp, cache in zip(self.layers, k_pools, v_pools, caches):
+            x, nc = layer.decode_paged(
+                x, kp, vp, block_tables, seq_lens, cache, steps
+            )
             new_caches.append(nc)
         return x, new_caches
 
@@ -401,6 +457,18 @@ class CobraEmbedding(nn.Module):
         offset = tok + (slot % self.n_codebooks) * self.id_vocab_size
         h = self.id_embed[offset].astype(self.dtype)
         h = h + self.pos_embed[base_pos + slot].astype(self.dtype)
+        h = h + self.type_embed[0].astype(self.dtype)
+        return h
+
+    def suffix_token_ragged(self, tok, steps, base_pos):
+        """`suffix_token` with per-row suffix slots AND per-row base
+        positions: tok (B, K), steps (B,), base_pos (B,) — each row embeds
+        its token at ITS history end (continuous batching mixes rows whose
+        histories ended at different absolute positions)."""
+        offset = tok + (steps[:, None] % self.n_codebooks) * self.id_vocab_size
+        h = self.id_embed[offset].astype(self.dtype)
+        pos = jnp.clip(base_pos + steps, 0, self.max_len - 1)
+        h = h + self.pos_embed[pos][:, None].astype(self.dtype)
         h = h + self.type_embed[0].astype(self.dtype)
         return h
 
@@ -591,6 +659,16 @@ class Cobra(nn.Module):
         """
         x = self.cobra_emb.suffix_token(tok, slot, base_pos)
         return self.decoder.decode(x, hist_kvs, hist_pad, caches, slot)
+
+    def decode_suffix_step_paged(self, tok, steps, base_pos, k_pools, v_pools,
+                                 block_tables, seq_lens, caches):
+        """`decode_suffix_step` through the paged history pools with
+        per-row suffix slots (steps) and per-row history ends (base_pos).
+        """
+        x = self.cobra_emb.suffix_token_ragged(tok, steps, base_pos)
+        return self.decoder.decode_paged(
+            x, k_pools, v_pools, block_tables, seq_lens, caches, steps
+        )
 
 
 def _constrained_logp(logits, trie, prefix_idx, step: int):
@@ -804,6 +882,249 @@ def _cobra_generate_cached(
 def _apply_head(model: Cobra, params, c: int, x):
     k = params[f"sparse_head_{c}"]
     return x @ k["kernel"] + k["bias"]
+
+
+# ---- paged decode (ragged paged KV + slot-level continuous batching) --------
+#
+# Mirror of the TIGER section in models/tiger.py: the interleaved-history
+# K/V moves into shared page pools, the suffix cache stays dense per beam,
+# and the per-step body takes a PER-ROW codebook index so the serving
+# engine can advance slots sitting at different steps in one fixed-shape
+# call. `cobra_generate_paged` drives it in lockstep as the parity
+# reference against `_cobra_generate_cached` (pinned <=1e-5).
+
+
+def init_cobra_paged_state(model: Cobra, n_slots: int, beams: int):
+    """Zeroed slot-major decode state (see init_tiger_paged_state)."""
+    C = model.n_codebooks
+    nl = model.decoder_n_layers
+    H = model.decoder_num_heads
+    hd = model.d_model // H
+    return {
+        "beam_tokens": jnp.zeros((n_slots, beams, C), jnp.int32),
+        "beam_scores": jnp.zeros((n_slots, beams), jnp.float32),
+        "prefix_idx": jnp.zeros((n_slots, beams), jnp.int32),
+        "cache_k": jnp.zeros((n_slots, nl, beams, max(C - 1, 1), H, hd), model.dtype),
+        "cache_v": jnp.zeros((n_slots, nl, beams, max(C - 1, 1), H, hd), model.dtype),
+        "tail_hidden": jnp.zeros((n_slots, C, model.d_model), jnp.float32),
+        "full": jnp.zeros((n_slots,), bool),
+        "base_pos": jnp.zeros((n_slots,), jnp.int32),
+        "h_last": jnp.zeros((n_slots, beams, model.d_model), jnp.float32),
+    }
+
+
+def cobra_prefill_paged(model: Cobra, params, input_ids, vecs, block_tables,
+                        k_pools, v_pools, trie, n_candidates: int,
+                        temperature: float = 1.0):
+    """Bucketed prefill writing the interleaved-history K/V into the page
+    pools, plus everything the suffix steps need per slot.
+
+    Returns (k_pools, v_pools, init) where init holds the codebook-0 beam
+    (the step-0 head reads the prefill's last dense position — no suffix
+    step needed), the C prefill tail hiddens serving partially-padded
+    rows' reads, the full-row flag, base_pos (= valid interleaved length;
+    also the pool seq_lens), and h_last seeded for the C == 1 edge.
+    """
+    from genrec_tpu.ops.paged import write_pages
+
+    C = model.n_codebooks
+    B = input_ids.shape[0]
+    T_items = vecs.shape[1]
+    h_pre, seq_mask, hist_kvs = model.apply(
+        {"params": params}, input_ids, vecs, T_items, method=Cobra.decode_prefill
+    )
+    k_pools = tuple(
+        write_pages(pool, block_tables, kv[0]) for pool, kv in zip(k_pools, hist_kvs)
+    )
+    v_pools = tuple(
+        write_pages(pool, block_tables, kv[1]) for pool, kv in zip(v_pools, hist_kvs)
+    )
+    Lint = seq_mask.shape[1]
+    n_valid = seq_mask.sum(axis=1).astype(jnp.int32)
+    rows = jnp.arange(B)
+    tail = jnp.stack(
+        [
+            h_pre[rows, jnp.clip(n_valid + c - 1, 0, Lint - 1)].astype(jnp.float32)
+            for c in range(C)
+        ],
+        axis=1,
+    )  # (B, C, d): c=0 feeds the step-0 head; c>=1 serve partial rows
+
+    logits = _apply_head(model, params, 0, tail[:, 0]) / temperature
+    logp = _constrained_logp(logits, trie, jnp.zeros((B,), jnp.int32), 0)
+    beam_scores, tok = jax.lax.top_k(logp, n_candidates)
+    beam_tokens = jnp.zeros((B, n_candidates, C), jnp.int32)
+    beam_tokens = beam_tokens.at[:, :, 0].set(tok)
+    prefix_idx = (
+        jnp.zeros((B, n_candidates), jnp.int32)
+        if trie is None
+        else trie.advance(jnp.zeros((B, n_candidates), jnp.int32), tok, 0)
+    )
+    init = {
+        "beam_tokens": beam_tokens,
+        "beam_scores": beam_scores,
+        "prefix_idx": prefix_idx,
+        "tail_hidden": tail,
+        "full": n_valid == Lint,
+        "base_pos": n_valid,
+        "h_last": jnp.broadcast_to(
+            tail[:, 0][:, None], (B, n_candidates, model.d_model)
+        ),
+    }
+    return k_pools, v_pools, init
+
+
+def cobra_paged_decode_step(
+    model: Cobra,
+    params,
+    trie,
+    state: dict,
+    steps,
+    block_tables,
+    seq_lens,
+    k_pools,
+    v_pools,
+    temperature: float = 1.0,
+):
+    """One suffix codebook position for every slot; steps (S,) carries
+    each row's codebook index c in [1, C-1]. Mirrors one iteration of
+    `_cobra_generate_cached`'s loop with the static c replaced by the
+    per-row operand: the sparse head, trie tables, suffix slot and token
+    write column are all row-selected.
+    """
+    from genrec_tpu.ops.trie import advance_ragged, legal_mask_ragged
+
+    C = model.n_codebooks
+    V = model.id_vocab_size
+    S_, K, _ = state["beam_tokens"].shape
+    caches = [
+        {"k": state["cache_k"][:, i], "v": state["cache_v"][:, i]}
+        for i in range(state["cache_k"].shape[1])
+    ]
+
+    tok_prev = jnp.take_along_axis(
+        state["beam_tokens"], jnp.clip(steps - 1, 0, C - 1)[:, None, None], axis=2
+    )[:, :, 0]
+    h_new, caches = model.apply(
+        {"params": params}, tok_prev, steps - 1, state["base_pos"],
+        k_pools, v_pools, block_tables, seq_lens, caches,
+        method=Cobra.decode_suffix_step_paged,
+    )  # (S, K, d)
+    c_idx = jnp.clip(steps, 0, C - 1)
+    h_tail = jnp.take_along_axis(
+        state["tail_hidden"], c_idx[:, None, None], axis=1
+    )[:, 0]
+    h_c = jnp.where(
+        state["full"][:, None, None], h_new, h_tail[:, None, :].astype(h_new.dtype)
+    )
+
+    logits = None
+    for c in range(C):  # every sparse head computed, row-selected (C tiny)
+        lc = _apply_head(model, params, c, h_c)
+        logits = lc if logits is None else jnp.where(
+            (steps == c)[:, None, None], lc, logits
+        )
+    logits = logits / temperature
+    if trie is None:
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    else:
+        legal = legal_mask_ragged(trie, state["prefix_idx"], steps)
+        logp = jax.nn.log_softmax(
+            jnp.where(legal, logits, -1e32).astype(jnp.float32), axis=-1
+        )
+        logp = jnp.where(legal, logp, -1e32)
+
+    combined = (state["beam_scores"][..., None] + logp).reshape(S_, K * V)
+    beam_scores, idx = jax.lax.top_k(combined, K)
+    parent = idx // V
+    tok = idx % V
+    beam_tokens = jnp.take_along_axis(
+        state["beam_tokens"], parent[..., None], axis=1
+    )
+    hit = jnp.arange(C)[None, None, :] == steps[:, None, None]
+    beam_tokens = jnp.where(hit, tok[..., None], beam_tokens)
+    prefix_idx = (
+        jnp.zeros_like(state["prefix_idx"])
+        if trie is None
+        else advance_ragged(
+            trie,
+            jnp.take_along_axis(state["prefix_idx"], parent, axis=1),
+            tok, steps,
+        )
+    )
+    from genrec_tpu.models.t5transformer import gather_beam_caches
+
+    caches = gather_beam_caches(caches, parent)
+    h_last = jnp.take_along_axis(h_c, parent[..., None], axis=1).astype(jnp.float32)
+
+    return {
+        "beam_tokens": beam_tokens,
+        "beam_scores": beam_scores,
+        "prefix_idx": prefix_idx,
+        "cache_k": jnp.stack([c["k"] for c in caches], axis=1),
+        "cache_v": jnp.stack([c["v"] for c in caches], axis=1),
+        "tail_hidden": state["tail_hidden"],
+        "full": state["full"],
+        "base_pos": state["base_pos"],
+        "h_last": h_last,
+    }
+
+
+def cobra_generate_paged(
+    model: Cobra,
+    params,
+    input_ids,
+    encoder_input_ids,
+    n_candidates: int = 10,
+    temperature: float = 1.0,
+    item_vecs=None,
+    trie=None,
+    page_size: int = 8,
+) -> CobraGenerationOutput:
+    """`cobra_generate(use_cache=True)` through the paged decode path —
+    prefill into a freshly built pool, then the slot-level suffix step
+    with every row in lockstep (the parity reference for serving).
+    """
+    C = model.n_codebooks
+    B = input_ids.shape[0]
+    vecs = (
+        item_vecs
+        if item_vecs is not None
+        else model.apply({"params": params}, encoder_input_ids, method=Cobra.encode_items)
+    )
+    T_items = vecs.shape[1]
+    if input_ids.shape[1] != C * T_items:
+        raise ValueError("paged decode requires complete-item histories")
+
+    nl = model.decoder_n_layers
+    H = model.decoder_num_heads
+    hd = model.d_model // H
+    Lint = T_items * (C + 1)
+    pages_per_slot = -(-Lint // page_size)
+    num_pages = 1 + B * pages_per_slot
+    block_tables = jnp.asarray(
+        1 + jnp.arange(B * pages_per_slot).reshape(B, pages_per_slot), jnp.int32
+    )
+    zeros = lambda: tuple(
+        jnp.zeros((num_pages, page_size, H, hd), model.dtype) for _ in range(nl)
+    )
+    k_pools, v_pools, init = cobra_prefill_paged(
+        model, params, input_ids, vecs, block_tables, zeros(), zeros(),
+        trie, n_candidates, temperature,
+    )
+    state = init_cobra_paged_state(model, B, n_candidates)
+    state.update(init)
+    seq_lens = init["base_pos"]
+    for c in range(1, C):
+        state = cobra_paged_decode_step(
+            model, params, trie, state, jnp.full((B,), c, jnp.int32),
+            block_tables, seq_lens, k_pools, v_pools, temperature=temperature,
+        )
+    return CobraGenerationOutput(
+        sem_ids=state["beam_tokens"],
+        dense_vecs=l2norm(state["h_last"]),
+        scores=state["beam_scores"],
+    )
 
 
 def beam_fusion(

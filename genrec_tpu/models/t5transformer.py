@@ -162,6 +162,42 @@ class T5Attention(nn.Module):
         out = jnp.einsum("bkhs,bkshd->bkhd", attn, cv).reshape(B, K, self.d_model)
         return self.o(out), {"k": ck, "v": cv}
 
+    def decode_self_ragged(self, x, cache, steps):
+        """`decode_self` with a PER-ROW step operand (steps: (B,) int32).
+
+        Slot-level continuous batching advances rows sitting at different
+        decode positions in ONE fixed-shape call, so the write slot, the
+        relative-position bias and the causal mask all come from ``steps``
+        instead of a static int. Row b with steps[b] == t computes exactly
+        what `decode_self(..., step=t)` computes for it.
+        """
+        B, K, _ = x.shape
+        H, hd = self.n_heads, self.d_model // self.n_heads
+        k_new, v_new = jnp.split(self.kv(x), 2, axis=-1)
+        q = self.q(x).reshape(B, K, H, hd)
+        S = cache["k"].shape[2]
+        hit = (jnp.arange(S)[None, :] == steps[:, None])[:, None, :, None, None]
+        ck = jnp.where(hit, k_new.reshape(B, K, 1, H, hd), cache["k"])
+        cv = jnp.where(hit, v_new.reshape(B, K, 1, H, hd), cache["v"])
+        scores = jnp.einsum("bkhd,bkshd->bkhs", q, ck) * (hd**-0.5)
+        scores = scores.astype(jnp.float32)
+        if self.has_relative_bias:
+            rel = jnp.arange(S)[None, :] - steps[:, None]  # (B, S) mem - ctx
+            buckets = t5_relative_position_bucket(
+                rel, self.num_relative_buckets, self.max_distance,
+                bidirectional=True,
+            )
+            head_offset = jnp.arange(self.n_heads)[:, None] * self.num_relative_buckets
+            bias = self.rel_bias[buckets[:, None, :] + head_offset[None], 0]
+            scores = scores + bias[:, None]  # (B, 1, H, S)
+        scores = jnp.where(
+            jnp.arange(S)[None, None, None, :] > steps[:, None, None, None],
+            _NEG, scores,
+        )
+        attn = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+        out = jnp.einsum("bkhs,bkshd->bkhd", attn, cv).reshape(B, K, self.d_model)
+        return self.o(out), {"k": ck, "v": cv}
+
     def project_kv(self, memory):
         """Cross-attention K/V from the un-expanded encoder memory, computed
         once per eval batch: (B, Lm, d) -> two (B, H, Lm, hd)."""
@@ -170,6 +206,22 @@ class T5Attention(nn.Module):
         k = self.k(memory).reshape(B, Lm, H, hd).transpose(0, 2, 1, 3)
         v = self.v(memory).reshape(B, Lm, H, hd).transpose(0, 2, 1, 3)
         return k, v
+
+    def decode_cross_paged(self, x, k_pool, v_pool, block_tables, seq_lens):
+        """`decode_cross` against PAGED K/V: the memory keys live in a
+        page pool and each row reads its own pages through a block-table
+        row; positions >= seq_lens[b] are masked (the serving layout's
+        contiguous-valid-prefix contract replaces key_padding_mask).
+        Beams share the row's pages — no K-fold gather, no remap on beam
+        reorder.
+        """
+        B, K, _ = x.shape
+        H, hd = self.n_heads, self.d_model // self.n_heads
+        from genrec_tpu.ops.paged import paged_attention
+
+        q = self.q(x).reshape(B, K, H, hd)
+        out = paged_attention(q, k_pool, v_pool, block_tables, seq_lens)
+        return self.o(out.reshape(B, K, self.d_model))
 
     def decode_cross(self, x, kv, key_padding_mask=None):
         """Cross-attention of K beams against shared cached K/V.
@@ -273,6 +325,19 @@ class TransformerBlock(nn.Module):
         h = self.ff(self.norm2(x), deterministic=True)
         return x + h, new_cache
 
+    def decode_step_paged(self, x, cache, k_pool, v_pool, block_tables,
+                          seq_lens, steps):
+        """`decode_step` with per-row steps and paged cross-attention K/V."""
+        h, new_cache = self.self_attn.decode_self_ragged(self.norm1(x), cache, steps)
+        x = x + h
+        if self.cross_attn:
+            h = self.cross.decode_cross_paged(
+                self.norm_cross(x), k_pool, v_pool, block_tables, seq_lens
+            )
+            x = x + h
+        h = self.ff(self.norm2(x), deterministic=True)
+        return x + h, new_cache
+
 
 class TransformerEncoder(nn.Module):
     dim: int
@@ -352,6 +417,19 @@ class TransformerDecoder(nn.Module):
         for layer, cache, ckv in zip(self.layers, caches, cross_kvs):
             x, nc = layer.decode_step(
                 x, cache, ckv, memory_key_padding_mask, step=step
+            )
+            new_caches.append(nc)
+        return x, new_caches
+
+    def decode_step_paged(self, x, caches, k_pools, v_pools, block_tables,
+                          seq_lens, steps):
+        """Advance all layers one per-row position against the paged
+        cross-attention pools (one (pages, page, H, hd) K and V pool per
+        layer)."""
+        new_caches = []
+        for layer, cache, kp, vp in zip(self.layers, caches, k_pools, v_pools):
+            x, nc = layer.decode_step_paged(
+                x, cache, kp, vp, block_tables, seq_lens, steps
             )
             new_caches.append(nc)
         return x, new_caches

@@ -322,6 +322,33 @@ class Tiger(nn.Module):
         logits = self._mask_pad_logits(self.output_head(x))
         return logits.astype(jnp.float32), new_caches
 
+    def decode_step_paged(self, last_tok, caches, k_pools, v_pools,
+                          block_tables, seq_lens, steps):
+        """`decode_step_cached` over PAGED cross-attention K/V with a
+        per-row step operand — the slot-level continuous-batching decode:
+        every row advances one position, rows may sit at different steps.
+
+        last_tok: (S, K) int32; rows with steps[s] == 0 ignore it and
+        start from BOS. caches: per-layer dense suffix caches (S, K,
+        sem_id_dim, H, hd) — tiny, per-beam; the big history K/V stays in
+        the shared pools, read through block_tables/seq_lens.
+        """
+        S_, K = last_tok.shape
+        bos = jnp.broadcast_to(
+            self.bos_embedding.astype(self.dtype), (S_, K, self.embedding_dim)
+        )
+        tok_type = jnp.broadcast_to(
+            jnp.clip(steps - 1, 0, self.sem_id_dim - 1)[:, None], (S_, K)
+        )
+        emb = self.sem_id_embedding(last_tok, tok_type)
+        x = jnp.where((steps == 0)[:, None, None], bos, emb)
+        x = self.in_proj(self.norm(x))
+        x, new_caches = self.transformer.decoder.decode_step_paged(
+            x, caches, k_pools, v_pools, block_tables, seq_lens, steps
+        )
+        logits = self._mask_pad_logits(self.output_head(x))
+        return logits.astype(jnp.float32), new_caches
+
 
 def _dedup_top_k(scores, keys, k):
     """Per-row: keep the best-scoring instance of each key, return top-k.
@@ -453,3 +480,195 @@ def tiger_generate(
             caches = gather_beam_caches(caches, sel_parent)
 
     return TigerGenerationOutput(sem_ids=beam_seqs, log_probas=beam_logps)
+
+
+# ---- paged decode (ragged paged KV + slot-level continuous batching) --------
+#
+# The serving engine keeps the decode heads' history K/V in a shared page
+# pool (serving/kv_pool.py) and advances up to max_slots requests — each
+# possibly at a DIFFERENT decode step — in one fixed-shape call. The step
+# below is that call's body; `tiger_generate_paged` drives it with all
+# rows in lockstep as the parity reference against the dense-cache
+# `tiger_generate` (pinned <=1e-5 in tests/test_paged_parity.py).
+
+
+def init_tiger_paged_state(model: Tiger, n_slots: int, beams: int):
+    """Zeroed slot-major decode state. cache_k/cache_v stack the per-layer
+    suffix caches on axis 1 so the whole state is a flat dict of arrays
+    (the engine scatters admitted rows into it host-side)."""
+    nl = model.n_layers // 2
+    H = model.num_heads
+    hd = model.attn_dim // H
+    D = model.sem_id_dim
+    return {
+        "beam_seqs": jnp.zeros((n_slots, beams, D), jnp.int32),
+        "beam_logps": jnp.zeros((n_slots, beams), jnp.float32),
+        "prefix_idx": jnp.zeros((n_slots, beams), jnp.int32),
+        "cache_k": jnp.zeros((n_slots, nl, beams, D, H, hd), model.dtype),
+        "cache_v": jnp.zeros((n_slots, nl, beams, D, H, hd), model.dtype),
+    }
+
+
+def tiger_paged_decode_step(
+    model: Tiger,
+    params,
+    trie,
+    state: dict,
+    steps,
+    block_tables,
+    seq_lens,
+    k_pools,
+    v_pools,
+    rng=None,
+    temperature: float = 0.2,
+    sample_factor: int = 6,
+):
+    """Advance every slot one constrained-beam position (per-slot steps).
+
+    Mirrors one iteration of `tiger_generate`'s loop exactly, with the
+    static ``step`` replaced by the (S,) ``steps`` operand: the vocab
+    window, trie tables and cache write slot are all row-selected.
+    rng=None is deterministic pure beam search (the serving default);
+    passing a key reproduces the Gumbel-top-k sampling path.
+    Inactive/garbage rows (the engine's free slots) compute harmlessly —
+    nothing here reduces across rows.
+    """
+    from genrec_tpu.ops.trie import advance_ragged, legal_mask_ragged
+
+    S_, K, D = state["beam_seqs"].shape
+    Kcb = model.num_item_embeddings
+    KK = min(K * sample_factor, Kcb)
+    caches = [
+        {"k": state["cache_k"][:, i], "v": state["cache_v"][:, i]}
+        for i in range(state["cache_k"].shape[1])
+    ]
+
+    last_tok = jnp.take_along_axis(
+        state["beam_seqs"], jnp.clip(steps - 1, 0, D - 1)[:, None, None], axis=2
+    )[:, :, 0]
+    logits, caches = model.apply(
+        {"params": params}, last_tok, caches, k_pools, v_pools,
+        block_tables, seq_lens, steps, method=Tiger.decode_step_paged,
+    )  # (S, K, V)
+    flat = logits.reshape(S_ * K, -1)
+    window = jax.vmap(
+        lambda row, st: jax.lax.dynamic_slice(row, (st * Kcb,), (Kcb,))
+    )(flat, jnp.repeat(steps, K))  # per-row vocab window at its own step
+    legal = legal_mask_ragged(trie, state["prefix_idx"], steps).reshape(S_ * K, Kcb)
+    masked = jnp.where(legal, window, -1e32)
+    logp = jax.nn.log_softmax(masked / temperature, axis=-1)
+
+    perturbed = logp if rng is None else logp + jax.random.gumbel(rng, logp.shape)
+    _, cand_tok = jax.lax.top_k(perturbed, KK)
+    cand_logp = jnp.take_along_axis(logp, cand_tok, axis=1)
+    cand_legal = jnp.take_along_axis(legal, cand_tok, axis=1)
+    cand_logp = jnp.where(cand_legal, cand_logp, -1e32)
+
+    total = (state["beam_logps"].reshape(S_ * K, 1) + cand_logp).reshape(S_, K * KK)
+    toks = cand_tok.reshape(S_, K * KK)
+    parents = jnp.broadcast_to(jnp.arange(K)[:, None], (K, KK)).reshape(1, K * KK)
+    parents = jnp.broadcast_to(parents, (S_, K * KK))
+
+    parent_prefix = jnp.take_along_axis(state["prefix_idx"], parents, axis=1)
+    keys = parent_prefix * Kcb + toks
+    top_scores, top_idx = jax.vmap(lambda s, c: _dedup_top_k(s, c, K))(total, keys)
+
+    sel_parent = jnp.take_along_axis(parents, top_idx, axis=1)  # (S, K)
+    sel_tok = jnp.take_along_axis(toks, top_idx, axis=1)
+    beam_seqs = jnp.take_along_axis(state["beam_seqs"], sel_parent[..., None], axis=1)
+    hit = jnp.arange(D)[None, None, :] == steps[:, None, None]
+    beam_seqs = jnp.where(hit, sel_tok[..., None], beam_seqs)
+    sel_prefix = jnp.take_along_axis(state["prefix_idx"], sel_parent, axis=1)
+    prefix_idx = advance_ragged(trie, sel_prefix, sel_tok, steps)
+    caches = gather_beam_caches(caches, sel_parent)
+
+    return {
+        "beam_seqs": beam_seqs,
+        "beam_logps": top_scores,
+        "prefix_idx": prefix_idx,
+        "cache_k": jnp.stack([c["k"] for c in caches], axis=1),
+        "cache_v": jnp.stack([c["v"] for c in caches], axis=1),
+    }
+
+
+def tiger_prefill_paged(model: Tiger, params, user_input_ids, item_input_ids,
+                        token_type_ids, seq_mask, block_tables,
+                        k_pools, v_pools):
+    """Bucketed prefill that writes its cross-attention K/V straight into
+    the page pools. Returns (k_pools, v_pools, seq_lens) — seq_lens is
+    the per-row valid KV length (user token + real sem-id tokens), which
+    assumes the serving layout's CONTIGUOUS valid prefix in seq_mask.
+    Rows padded beyond their page allocation scatter into the reserved
+    null page (block-table entry 0) and are never read unmasked.
+    """
+    from genrec_tpu.ops.paged import write_pages
+
+    cross_kvs, pad = model.apply(
+        {"params": params}, user_input_ids, item_input_ids, token_type_ids,
+        seq_mask, method=Tiger.encode_for_decode,
+    )
+    seq_lens = (~pad).sum(axis=1).astype(jnp.int32)
+    k_pools = tuple(
+        write_pages(pool, block_tables, kv[0]) for pool, kv in zip(k_pools, cross_kvs)
+    )
+    v_pools = tuple(
+        write_pages(pool, block_tables, kv[1]) for pool, kv in zip(v_pools, cross_kvs)
+    )
+    return k_pools, v_pools, seq_lens
+
+
+def tiger_generate_paged(
+    model: Tiger,
+    params,
+    trie,
+    user_input_ids,
+    item_input_ids,
+    token_type_ids,
+    seq_mask,
+    rng: jax.Array,
+    temperature: float = 0.2,
+    n_top_k_candidates: int = 10,
+    sample_factor: int = 6,
+    deterministic: bool = False,
+    page_size: int = 8,
+) -> TigerGenerationOutput:
+    """`tiger_generate` through the paged decode path: prefill into a
+    freshly built page pool (contiguous block tables) and run the
+    slot-level decode step with every row in lockstep. The parity
+    reference for serving, which composes the same pieces with a real
+    allocator and per-slot steps. Requires seq_mask rows to be contiguous
+    valid prefixes (the serving layout).
+    """
+    B = item_input_ids.shape[0]
+    K = n_top_k_candidates
+    D = model.sem_id_dim
+    nl = model.n_layers // 2
+    H = model.num_heads
+    hd = model.attn_dim // H
+    Lm = seq_mask.shape[1] + 1  # + user token
+    pages_per_slot = -(-Lm // page_size)
+    num_pages = 1 + B * pages_per_slot  # page 0 = reserved null page
+    block_tables = jnp.asarray(
+        1 + jnp.arange(B * pages_per_slot).reshape(B, pages_per_slot), jnp.int32
+    )
+    zeros = lambda: tuple(
+        jnp.zeros((num_pages, page_size, H, hd), model.dtype) for _ in range(nl)
+    )
+    k_pools, v_pools, seq_lens = tiger_prefill_paged(
+        model, params, user_input_ids, item_input_ids, token_type_ids,
+        seq_mask, block_tables, zeros(), zeros(),
+    )
+
+    state = init_tiger_paged_state(model, B, K)
+    for step in range(D):
+        sub = None
+        if not deterministic:
+            rng, sub = jax.random.split(rng)
+        state = tiger_paged_decode_step(
+            model, params, trie, state, jnp.full((B,), step, jnp.int32),
+            block_tables, seq_lens, k_pools, v_pools, rng=sub,
+            temperature=temperature, sample_factor=sample_factor,
+        )
+    return TigerGenerationOutput(
+        sem_ids=state["beam_seqs"], log_probas=state["beam_logps"]
+    )

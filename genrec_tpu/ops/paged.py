@@ -1,0 +1,156 @@
+"""Paged KV attention: the pure-JAX reference for the ragged decode path.
+
+Ragged Paged Attention (PAPERS.md, arxiv 2604.15464) decouples decode KV
+memory from the serving bucket a request landed in: K/V live in a global
+page pool ``(num_pages, page_size, heads, head_dim)`` and each decode
+slot names its pages through a block-table row, so HBM scales with the
+tokens actually resident, not with ``max_slots x max_history``.
+
+This module is the gather/segment fallback (and the numerics contract)
+for the Pallas kernel in ``kernels/paged_attention.py``: CPU tests and
+non-TPU backends run these exact ops, and the kernel is pinned against
+them the same way the HSTU kernel is pinned against its XLA reference.
+
+Conventions shared by fallback and kernel:
+
+- page 0 is the reserved NULL page: unused block-table entries point at
+  it, prefill writes of padded tails land in it, and attention never
+  reads it unmasked (every position >= ``seq_lens[s]`` scores -1e9);
+- masked positions are FILLED with -1e9 and kept inside the softmax —
+  the same additive-mask semantics as the dense decode paths, so
+  ``exp(-1e9 - max)`` underflows to exactly 0 and paged == dense holds
+  bit-for-bit up to float association;
+- valid tokens must be a CONTIGUOUS prefix of the slot's pages (the
+  serving layout; ``seq_lens`` is the only mask).
+
+The stats form ``(acc, m, l)`` (unnormalized flash accumulator, running
+max, running sum) exists so COBRA can merge the paged history scores
+with its dense suffix-cache scores into ONE softmax — flash-attention's
+merge identity makes the two-part softmax exactly equal to the dense
+path's joint softmax over ``[history ++ suffix]``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG = -1e9
+
+
+def gather_pages(pool: jax.Array, block_tables: jax.Array) -> jax.Array:
+    """(P, page, H, hd) pool + (S, Pm) block tables -> (S, Pm*page, H, hd)
+    contiguous per-slot K or V (the fallback's materialized view)."""
+    S, Pm = block_tables.shape
+    page = pool.shape[1]
+    out = pool[block_tables]  # (S, Pm, page, H, hd)
+    return out.reshape(S, Pm * page, *pool.shape[2:])
+
+
+def write_pages(pool: jax.Array, block_tables: jax.Array, kv: jax.Array) -> jax.Array:
+    """Scatter one layer's prefill K or V into its slots' pages.
+
+    kv: (B, H, L, hd) — the (batch-major, head-split) layout the decode
+    prefills produce. block_tables: (B, Pm) page ids per batch row; rows
+    whose request occupies fewer than Pm pages pad with page 0, which
+    absorbs the padded-tail writes harmlessly (never read unmasked).
+    Requires L <= Pm * page_size (the engine sizes pages_per_slot off the
+    largest history bucket, so this is a config invariant, asserted).
+    """
+    B, H, L, hd = kv.shape
+    page = pool.shape[1]
+    Pm = block_tables.shape[1]
+    cap = Pm * page
+    if L > cap:
+        raise ValueError(
+            f"prefill KV of {L} tokens exceeds the {Pm} x {page} page "
+            f"capacity of a slot's block-table row"
+        )
+    rows = jnp.moveaxis(kv, 1, 2)  # (B, L, H, hd)
+    rows = jnp.pad(rows, ((0, 0), (0, cap - L), (0, 0), (0, 0)))
+    rows = rows.reshape(B, Pm, page, H, hd).astype(pool.dtype)
+    return pool.at[block_tables].set(rows)
+
+
+def paged_attention_stats(
+    q: jax.Array,
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    block_tables: jax.Array,
+    seq_lens: jax.Array,
+    use_kernel: bool | None = None,
+):
+    """Flash-stats attention of per-slot queries over paged K/V.
+
+    q: (S, K, H, hd) — K beams per slot, all sharing the slot's pages
+    (beam-sharing: a beam reorder never remaps pages, only the tiny
+    dense suffix caches). Pools: (P, page, H, hd). block_tables: (S, Pm)
+    int32. seq_lens: (S,) int32 valid-token counts.
+
+    Returns (acc, m, l) all fp32: acc (S, K, H, hd) = sum_j exp(s_j - m)
+    v_j, m (S, K, H) running max, l (S, K, H) = sum_j exp(s_j - m) —
+    over ALL Pm*page positions with masked ones at -1e9 (see module
+    docstring for why that matches the dense additive mask exactly).
+
+    use_kernel: None resolves through kernels.policy.auto_paged_attention
+    (TPU-only); True forces the Pallas kernel (interpret mode off-TPU);
+    False forces this pure-JAX gather.
+    """
+    if use_kernel is None:
+        from genrec_tpu.kernels.policy import auto_paged_attention
+
+        use_kernel = auto_paged_attention()
+    if use_kernel:
+        from genrec_tpu.kernels.paged_attention import paged_attention_stats_pallas
+
+        return paged_attention_stats_pallas(q, k_pool, v_pool, block_tables, seq_lens)
+    return _stats_fallback(q, k_pool, v_pool, block_tables, seq_lens)
+
+
+def _stats_fallback(q, k_pool, v_pool, block_tables, seq_lens):
+    S, K, H, hd = q.shape
+    k = gather_pages(k_pool, block_tables)  # (S, M, H, hd)
+    v = gather_pages(v_pool, block_tables)
+    M = k.shape[1]
+    s = jnp.einsum("skhd,smhd->skhm", q, k).astype(jnp.float32) * (hd**-0.5)
+    tok = jnp.arange(M)
+    s = jnp.where(tok[None, None, None, :] >= seq_lens[:, None, None, None], NEG, s)
+    m = s.max(axis=-1)  # (S, K, H)
+    e = jnp.exp(s - m[..., None])
+    l = e.sum(axis=-1)
+    acc = jnp.einsum("skhm,smhd->skhd", e, v.astype(jnp.float32))
+    return acc, m, l
+
+
+def merge_attention_stats(acc_a, m_a, l_a, acc_b, m_b, l_b):
+    """Combine two flash partials into the jointly-softmaxed output.
+
+    Exactly softmax(concat(scores_a, scores_b)) @ concat(values) up to
+    float association — the COBRA paged suffix step merges its paged
+    history partial with its dense suffix partial through this.
+    """
+    m = jnp.maximum(m_a, m_b)
+    ca = jnp.exp(m_a - m)
+    cb = jnp.exp(m_b - m)
+    l = l_a * ca + l_b * cb
+    acc = acc_a * ca[..., None] + acc_b * cb[..., None]
+    return acc / jnp.maximum(l, 1e-30)[..., None]
+
+
+def paged_attention(
+    q: jax.Array,
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    block_tables: jax.Array,
+    seq_lens: jax.Array,
+    use_kernel: bool | None = None,
+) -> jax.Array:
+    """Normalized paged attention output, (S, K, H, hd) in q's dtype.
+
+    The full-softmax form (TIGER's cross-attention — no suffix to merge
+    with): out = acc / l from the stats primitive.
+    """
+    acc, _, l = paged_attention_stats(
+        q, k_pool, v_pool, block_tables, seq_lens, use_kernel=use_kernel
+    )
+    return (acc / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)

@@ -120,6 +120,51 @@ def build_trie(valid_ids: np.ndarray, codebook_size: int, dense_max_bits: int = 
     return PackedTrie.build(valid_ids, codebook_size)
 
 
+def legal_mask_ragged(trie, prefix_idx: jax.Array, steps: jax.Array) -> jax.Array:
+    """`trie.legal_mask` with a PER-ROW step operand.
+
+    Slot-level continuous batching decodes rows at DIFFERENT trie depths
+    in one fixed-shape call, but both trie types store per-step tables of
+    different shapes, so ``step`` cannot be traced directly. Depth is
+    tiny (3-4), so the mask is computed at every step and row-selected:
+    prefix_idx (S, ...) with steps (S,) -> (S, ..., K) bool.
+
+    Rows evaluated at a foreign step index clip/clamp into that step's
+    table (jax gathers clamp out-of-range indices) — garbage, but never
+    selected.
+    """
+    sel_shape = steps.shape + (1,) * prefix_idx.ndim  # broadcast over rows
+    out = None
+    for t in range(trie.depth):
+        mask_t = trie.legal_mask(_clip_prefix(trie, prefix_idx, t), t)
+        out = mask_t if out is None else jnp.where(
+            (steps == t).reshape(sel_shape), mask_t, out
+        )
+    return out
+
+
+def advance_ragged(trie, prefix_idx: jax.Array, token: jax.Array,
+                   steps: jax.Array) -> jax.Array:
+    """`trie.advance` with a per-row step operand (see legal_mask_ragged)."""
+    sel_shape = steps.shape + (1,) * (prefix_idx.ndim - 1)
+    out = None
+    for t in range(trie.depth):
+        adv_t = trie.advance(_clip_prefix(trie, prefix_idx, t), token, t)
+        out = adv_t if out is None else jnp.where(
+            (steps == t).reshape(sel_shape), adv_t, out
+        )
+    return out
+
+
+def _clip_prefix(trie, prefix_idx, step: int):
+    """Keep foreign-step prefixes in a table's index range. PackedTrie's
+    searchsorted accepts any int; DenseTrie's gather would clamp anyway
+    under jit, but the clip keeps eager evaluation in-bounds too."""
+    if isinstance(trie, DenseTrie):
+        return jnp.minimum(prefix_idx, trie.tables[step].shape[0] - 1)
+    return prefix_idx
+
+
 def tuples_are_valid(trie, seqs: jax.Array) -> jax.Array:
     """(..., D) sem-id tuples -> (...) bool: is each a complete legal item?
 

@@ -4,6 +4,12 @@ reload, graceful drain. See docs/SERVING.md for the architecture."""
 
 from genrec_tpu.serving.buckets import BucketLadder, default_ladder
 from genrec_tpu.serving.engine import ServingEngine
+from genrec_tpu.serving.kv_pool import (
+    KVPagePool,
+    PageAllocator,
+    PagedConfig,
+    PoolExhausted,
+)
 from genrec_tpu.serving.heads import (
     CobraGenerativeHead,
     RetrievalHead,
@@ -22,7 +28,11 @@ __all__ = [
     "BucketLadder",
     "CobraGenerativeHead",
     "DrainingError",
+    "KVPagePool",
     "LatencyHistogram",
+    "PageAllocator",
+    "PagedConfig",
+    "PoolExhausted",
     "Request",
     "Response",
     "RetrievalHead",

@@ -13,6 +13,17 @@ The request path (ROADMAP north star: "serves heavy traffic"):
 4. Outputs are split per-request, futures resolve, and queue-wait /
    compute / total latencies land in the metrics histograms.
 
+Generative (paged) heads replace steps 3-4 with slot-level continuous
+batching (`_PagedRunner`): requests are ADMITTED into free decode slots
+(a bucketed prefill writes their history K/V into the fixed-budget page
+pool of serving/kv_pool.py), every batcher iteration advances ALL active
+slots one decode position through one fixed-shape executable with
+per-slot step operands, and finished slots EVICT mid-decode — freeing
+pages for the next admission without waiting for their co-admitted
+batch. Decode-side compile surface: a handful of
+(slot-count, pages_per_slot) shapes per head instead of the whole
+bucket grid.
+
 Hot checkpoint reload: a watcher thread polls a checkpoint directory of
 params-only steps (published by the trainer or a sidecar) and restores
 strictly NEWER steps through `CheckpointManager.restore_latest_valid` —
@@ -44,10 +55,12 @@ from concurrent.futures import Future
 from typing import Optional, Sequence
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from genrec_tpu.core import chaos
 from genrec_tpu.serving.buckets import BucketLadder, default_ladder
+from genrec_tpu.serving.kv_pool import KVPagePool, PagedConfig, PoolExhausted
 from genrec_tpu.serving.metrics import ServingMetrics
 from genrec_tpu.serving.types import (
     DrainingError,
@@ -55,6 +68,304 @@ from genrec_tpu.serving.types import (
     Response,
     UnknownHeadError,
 )
+
+
+def _sds(tree):
+    """Pytree -> ShapeDtypeStructs for AOT lowering without live buffers."""
+    return jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(jnp.shape(x), jnp.result_type(x)), tree
+    )
+
+
+class _PagedRunner:
+    """Slot-level continuous batching for ONE paged generative head.
+
+    The PR-5 engine decoded a whole micro-batch per executable call:
+    requests admitted together finished together, and the KV cache was a
+    dense (bucket-batch x bucket-history) tensor per executable. This
+    runner replaces that for heads implementing the paged protocol
+    (serving/heads.py): the head's history K/V lives in a fixed-budget
+    page pool (serving/kv_pool.py), prefill stays on the (batch, history)
+    bucket ladder but WRITES its K/V straight into pages, and decode is
+    a fixed-shape step over the slot set that every batcher iteration
+    advances by one position — requests are admitted into free slots and
+    evicted on finish MID-decode, so the decode side's compile surface
+    collapses from the whole bucket grid to a handful of
+    (slot-count, pages_per_slot) shapes.
+
+    All methods run on the batcher thread (same single-writer discipline
+    as the executable cache); slot state is host-resident numpy between
+    steps, pools stay device-resident.
+    """
+
+    def __init__(self, engine: "ServingEngine", head, cfg: PagedConfig):
+        max_kv = head.paged_kv_tokens(10**9, engine._ladder.history_buckets[-1])
+        if cfg.max_kv_tokens < max_kv:
+            raise ValueError(
+                f"paged config holds {cfg.max_kv_tokens} KV tokens/slot but "
+                f"head {head.name!r} needs {max_kv} at the largest history "
+                "bucket; raise pages_per_slot or page_size"
+            )
+        self.engine = engine
+        self.head = head
+        self.cfg = cfg
+        n_layers, n_heads, head_dim, dtype = head.paged_layout()
+        self.pool = KVPagePool(cfg, n_layers, n_heads, head_dim, dtype)
+        self.state = head.paged_state_zeros(cfg.max_slots)
+        self.steps = np.zeros(cfg.max_slots, np.int32)
+        self.active = np.zeros(cfg.max_slots, bool)
+        self.entries: list = [None] * cfg.max_slots  # (req, fut, t_enq, t_admit)
+        self.buckets: list = [None] * cfg.max_slots  # prefill (B, L) per slot
+        # The collapsed decode-side ladder: a handful of slot-count
+        # shapes (max_slots halving down to max_batch). Slots fill
+        # lowest-index-first (kv_pool heap), so the step runs at the
+        # smallest shape covering the highest active slot — a lightly
+        # loaded engine doesn't pay max_slots of decode compute.
+        shapes = []
+        s = cfg.max_slots
+        while True:
+            shapes.append(s)
+            if s <= engine._max_batch:
+                break
+            s = max(s // 2, engine._max_batch)
+        self.slot_shapes = sorted(set(shapes))
+        self._decode: dict[int, object] = {}
+        self._prefill: dict[tuple[int, int], object] = {}
+        # Futures already counted as OOM-deferred: the gauge counts
+        # REQUESTS deferred, not per-batcher-iteration retries.
+        self._oom_counted: set[int] = set()
+
+    @property
+    def idle(self) -> bool:
+        return not self.active.any()
+
+    # -- compilation ---------------------------------------------------------
+
+    def warmup(self) -> None:
+        """Decode executables at the handful of (slot-count,
+        pages_per_slot) shapes + the prefill bucket grid. Everything else
+        the dense path compiled per bucket (the whole generate loop) is
+        gone from the decode side."""
+        for S in self.slot_shapes:
+            self._decode[S] = self._compile_decode(S)
+        for B, L in self.engine._ladder.combos():
+            self._prefill[(B, L)] = self._compile_prefill(B, L)
+
+    def _donate(self, *argnums):
+        # CPU has no buffer donation; avoid the per-call warning there.
+        return argnums if jax.default_backend() != "cpu" else ()
+
+    def _compile_decode(self, S: int):
+        eng = self.engine
+        fn = self.head.make_decode_paged_fn()
+        args = (
+            eng._select(self.head, eng._params),
+            _sds({k: v[:S] for k, v in self.state.items()}),
+            jax.ShapeDtypeStruct((S,), np.int32),
+            jax.ShapeDtypeStruct((S, self.cfg.pages_per_slot), np.int32),
+            jax.ShapeDtypeStruct((S,), np.int32),
+            _sds(self.pool.k_pools),
+            _sds(self.pool.v_pools),
+        )
+        compiled = jax.jit(fn).lower(*args).compile()
+        eng.metrics.record_compile()
+        return compiled
+
+    def _compile_prefill(self, B: int, L: int):
+        eng = self.engine
+        fn = self.head.make_prefill_paged_fn(B, L)
+        batch = self.head.make_batch([self.head.dummy_request()], B, L)
+        n_batch = len(batch)
+        args = (
+            eng._select(self.head, eng._params),
+            *batch,
+            jax.ShapeDtypeStruct((B, self.cfg.pages_per_slot), np.int32),
+            _sds(self.pool.k_pools),
+            _sds(self.pool.v_pools),
+        )
+        compiled = jax.jit(
+            fn, donate_argnums=self._donate(n_batch + 2, n_batch + 3)
+        ).lower(*args).compile()
+        eng.metrics.record_compile()
+        return compiled
+
+    # -- admission (prefill into pages) --------------------------------------
+
+    def admit(self) -> bool:
+        """Drain the head's queue into free slots, one bucketed prefill
+        micro-batch at a time. Requests that don't fit (no free slot or
+        no free pages) STAY QUEUED — they retry as evictions free pages —
+        and the deferral is counted (metrics.oom_deferred_admits)."""
+        eng = self.engine
+        progressed = False
+        while True:
+            budget = min(self.pool.free_slot_count, eng._max_batch)
+            if budget == 0:
+                return progressed
+            now = time.monotonic()
+            with eng._lock:
+                q = eng._queues[self.head.name]
+                if not q:
+                    return progressed
+                # Coalesce trickling arrivals into bucket-sized prefills
+                # (the dense batcher's deadline discipline): admitting
+                # one-by-one would pay a prefill dispatch + a decode step
+                # per request. Deadline, drain, or a full group flushes.
+                if (
+                    len(q) < budget
+                    and now - q[0][2] < eng._max_wait_s
+                    and not eng._draining
+                ):
+                    return progressed
+                entries = [q.popleft() for _ in range(min(len(q), budget))]
+            slots, admitted = [], []
+            L = eng._ladder.history_bucket(
+                max(max(self.head.natural_len(e[0]) for e in entries), 1)
+            )
+            for e in entries:
+                try:
+                    n_tok = self.head.paged_kv_tokens(self.head.natural_len(e[0]), L)
+                    slots.append(self.pool.admit(n_tok))
+                    admitted.append(e)
+                except PoolExhausted:
+                    break
+            leftover = entries[len(admitted):]
+            if leftover:  # out of pages: requeue at the FRONT (FIFO order)
+                with eng._lock:
+                    eng._queues[self.head.name].extendleft(reversed(leftover))
+                fresh = [e for e in leftover if id(e[1]) not in self._oom_counted]
+                if fresh:  # count each request's deferral ONCE, not per retry
+                    self._oom_counted.update(id(e[1]) for e in fresh)
+                    eng.metrics.record_oom_admit(len(fresh))
+            if admitted:
+                self._oom_counted.difference_update(id(e[1]) for e in admitted)
+                try:
+                    self._run_prefill(admitted, slots, L)
+                except Exception as e:  # noqa: BLE001 — fail THESE futures only
+                    eng._log.exception(
+                        f"serving: paged prefill on head {self.head.name} failed"
+                    )
+                    for slot, (_req, fut, _t) in zip(slots, admitted):
+                        self.pool.evict(slot)
+                        # Undo any slot bookkeeping a partial prefill set,
+                        # or step() would decode an entry-less slot.
+                        self.active[slot] = False
+                        self.entries[slot] = None
+                        self.buckets[slot] = None
+                        if not fut.done():
+                            fut.set_exception(e)
+                    eng.metrics.record_failure(len(admitted))
+                progressed = True
+            if leftover:
+                return progressed
+
+    def _run_prefill(self, entries, slots, L: int) -> None:
+        eng = self.engine
+        head = self.head
+        t_admit = time.monotonic()
+        reqs = [e[0] for e in entries]
+        B = eng._ladder.batch_bucket(len(reqs))
+        compiled = self._prefill.get((B, L))
+        if compiled is None:  # off-grid (should not happen): counted
+            compiled = self._prefill[(B, L)] = self._compile_prefill(B, L)
+        args = head.make_batch(reqs, B, L)
+        bt = np.zeros((B, self.cfg.pages_per_slot), np.int32)
+        bt[: len(slots)] = self.pool.block_tables[slots]
+        k_pools, v_pools, init = compiled(
+            eng._select(head, eng._params), *args, jnp.asarray(bt),
+            self.pool.k_pools, self.pool.v_pools,
+        )
+        self.pool.k_pools, self.pool.v_pools = k_pools, v_pools
+        n = len(slots)
+        for key in self.state:
+            self.state[key][slots] = 0
+        for key, val in init.items():
+            self.state[key][slots] = np.asarray(val)[:n]
+        self.steps[slots] = head.paged_init_step
+        self.active[slots] = True
+        for e, slot in zip(entries, slots):
+            self.entries[slot] = (*e, t_admit)
+            self.buckets[slot] = (B, L)
+        eng.metrics.record_admit(n)
+        eng.metrics.record_batch(head.name, (B, L))
+        self._sweep_finished()  # heads whose init step == total finish here
+
+    # -- decode (one fixed-shape step over all slots) ------------------------
+
+    def step(self) -> bool:
+        """Advance every active slot one decode position; finished slots
+        resolve their futures and free their pages immediately, so the
+        NEXT admit() can reuse them — eviction mid-decode, no batch
+        barrier."""
+        if self.idle:
+            return False
+        eng = self.engine
+        # Smallest compiled slot shape covering the highest active slot
+        # (slots fill lowest-first, so this tracks the active count).
+        hi = int(np.nonzero(self.active)[0][-1]) + 1
+        S = next(s for s in self.slot_shapes if s >= hi)
+        out = self._decode[S](
+            eng._select(self.head, eng._params),
+            {k: jnp.asarray(v[:S]) for k, v in self.state.items()},
+            jnp.asarray(np.where(self.active[:S], self.steps[:S], 0).astype(np.int32)),
+            jnp.asarray(self.pool.block_tables[:S]),
+            jnp.asarray(self.pool.seq_lens[:S]),
+            self.pool.k_pools,
+            self.pool.v_pools,
+        )
+        for k, v in out.items():  # write back into the host rows
+            self.state[k][:S] = np.asarray(v)
+        self.steps[self.active] += 1
+        eng.metrics.record_decode_step()
+        self._sweep_finished()
+        # Chaos hook: a real SIGTERM after the Nth decode step exercises
+        # drain mid-churn for the continuous-batching loop.
+        chaos.maybe_kill(step=eng.metrics.decode_steps)
+        return True
+
+    def _sweep_finished(self) -> None:
+        eng = self.engine
+        head = self.head
+        total = head.paged_total_steps
+        done = np.nonzero(self.active & (self.steps >= total))[0]
+        step_id = eng._step
+        for slot in done:
+            req, fut, t_enq, t_admit = self.entries[slot]
+            now = time.monotonic()
+            try:
+                payload = head.paged_finalize(
+                    {k: v[slot] for k, v in self.state.items()}, req
+                )
+                resp = Response(
+                    head=head.name,
+                    items=payload["items"],
+                    scores=payload["scores"],
+                    sem_ids=payload.get("sem_ids"),
+                    params_step=step_id,
+                    bucket=self.buckets[slot],
+                    queue_wait_s=t_admit - t_enq,
+                    compute_s=now - t_admit,
+                    total_s=now - t_enq,
+                )
+            except Exception as e:  # noqa: BLE001 — one bad slot, not the loop
+                eng._log.exception(
+                    f"serving: paged finalize failed on head {head.name}"
+                )
+                if not fut.done():
+                    fut.set_exception(e)
+                eng.metrics.record_failure(1)
+            else:
+                eng.metrics.record_response(
+                    resp.queue_wait_s, resp.compute_s, resp.total_s
+                )
+                if not fut.done():
+                    fut.set_result(resp)
+            self.pool.evict(int(slot))
+            self.active[slot] = False
+            self.entries[slot] = None
+            self.buckets[slot] = None
+            eng.metrics.record_evict(1)
+        eng.metrics.set_pool_gauges(head.name, self.pool.stats())
 
 
 class ServingEngine:
@@ -73,6 +384,8 @@ class ServingEngine:
         handle_signals: bool = True,
         guard=None,
         logger: Optional[logging.Logger] = None,
+        paged: bool = True,
+        paged_config: Optional[PagedConfig] = None,
     ):
         self._heads = {h.name: h for h in heads}
         if len(self._heads) != len(heads):
@@ -97,6 +410,13 @@ class ServingEngine:
             )
         self._max_batch = max_batch
         self._max_wait_s = max_wait_ms / 1e3
+        # Paged decode (default): heads implementing the paged protocol go
+        # through slot-level continuous batching; paged=False keeps every
+        # head on the dense whole-generate bucket executables (the parity
+        # baseline bench.py measures against).
+        self._paged = paged
+        self._paged_config = paged_config
+        self._runners: dict[str, _PagedRunner] = {}
         self._ckpt_dir = ckpt_dir
         self._ckpt_poll_secs = ckpt_poll_secs
         self._handle_signals = handle_signals
@@ -127,6 +447,12 @@ class ServingEngine:
             raise RuntimeError("engine already started")
         for head in self._heads.values():
             head.on_params(self._select(head, self._params))
+        if self._paged:
+            for head in self._heads.values():
+                if getattr(head, "supports_paged", False):
+                    self._runners[head.name] = _PagedRunner(
+                        self, head, self._paged_config or self._default_paged_config(head)
+                    )
         self.warmup()
         if self._guard is None and self._handle_signals:
             from genrec_tpu.core.preemption import PreemptionGuard
@@ -147,18 +473,40 @@ class ServingEngine:
         self._batcher.start()
         return self
 
+    def _default_paged_config(self, head) -> PagedConfig:
+        """Pool shapes sized off the ladder: pages_per_slot covers the
+        largest history bucket, max_slots defaults to 4x the micro-batch
+        (continuous batching's whole point is holding MORE concurrent
+        decodes than one dense micro-batch), and the page budget covers
+        every slot at max history (no OOM by default — shrink num_pages
+        to run the pool under pressure)."""
+        page_size = 16
+        max_kv = head.paged_kv_tokens(10**9, self._ladder.history_buckets[-1])
+        return PagedConfig(
+            max_slots=4 * self._max_batch,
+            page_size=page_size,
+            pages_per_slot=-(-max_kv // page_size),
+        )
+
     def warmup(self) -> None:
         """AOT-compile every (head, batch-bucket, history-bucket) combo so
-        steady state is pure executable lookup."""
+        steady state is pure executable lookup. Paged heads compile the
+        prefill bucket grid + ONE decode executable instead of a
+        whole-generate executable per bucket."""
         t0 = time.monotonic()
         for head in self._heads.values():
-            for B, L in self._ladder.combos():
-                self._compile(head, B, L)
+            runner = self._runners.get(head.name)
+            if runner is not None:
+                runner.warmup()
+            else:
+                for B, L in self._ladder.combos():
+                    self._compile(head, B, L)
         self.metrics.mark_warm()
         self._log.info(
             f"serving warmup: {self.metrics.warmup_compiles} executables "
             f"({len(self._heads)} heads x {len(list(self._ladder.combos()))} "
-            f"buckets) in {time.monotonic() - t0:.1f}s"
+            f"buckets; {len(self._runners)} paged decode heads) "
+            f"in {time.monotonic() - t0:.1f}s"
         )
 
     def stop(self, timeout: float = 60.0) -> dict:
@@ -211,7 +559,7 @@ class ServingEngine:
         self._heads[req.head].validate(req)
         with self._lock:
             if self._draining:
-                self.metrics.record_reject()
+                self.metrics.record_reject(req.head)
                 raise DrainingError(
                     "engine is draining (shutdown signal received); "
                     "request rejected — fail over to another replica"
@@ -243,14 +591,26 @@ class ServingEngine:
                             "serving: shutdown signal latched — draining "
                             "in-flight requests, rejecting new submissions"
                         )
-                    self._apply_pending_params()
+                    swap_pending = self._apply_pending_params()
+                    # Slot-level continuous batching: admit queued requests
+                    # into free slots (paused while a params swap is
+                    # staged, so every request decodes under ONE version),
+                    # then advance every active slot one decode step.
+                    progressed = False
+                    for runner in self._runners.values():
+                        if not swap_pending:
+                            progressed |= runner.admit()
+                        progressed |= runner.step()
                     batch = self._next_batch()
                     if batch is not None:
                         self._run_batch(*batch)
                         continue
+                    if progressed:
+                        continue
                     with self._lock:
                         empty = all(not q for q in self._queues.values())
-                        if self._draining and empty:
+                        runners_idle = all(r.idle for r in self._runners.values())
+                        if self._draining and empty and runners_idle:
                             break
                         # Wake on submit/stop notify; when requests are
                         # queued, cap the wait so deadline flushes stay
@@ -258,7 +618,7 @@ class ServingEngine:
                         # polls tolerate 50ms; a 1 kHz idle spin does not).
                         self._work.wait(
                             timeout=max(self._max_wait_s / 4, 1e-3)
-                            if not empty
+                            if not (empty and runners_idle)
                             else 0.05
                         )
                 except Exception:  # noqa: BLE001 — the batcher must survive
@@ -275,7 +635,9 @@ class ServingEngine:
         scanned round-robin from just past the last-flushed one, so a
         head under sustained full-batch load cannot starve the others."""
         now = time.monotonic()
-        names = list(self._queues)
+        names = [n for n in self._queues if n not in self._runners]
+        if not names:
+            return None
         with self._lock:
             for i in range(len(names)):
                 name = names[(self._rr + i) % len(names)]
@@ -397,13 +759,25 @@ class ServingEngine:
         ):
             raise RuntimeError("restored params tree does not match the serving tree")
 
-    def _apply_pending_params(self) -> None:
-        """Atomic swap BETWEEN micro-batches (batcher thread only)."""
+    def _apply_pending_params(self) -> bool:
+        """Atomic swap BETWEEN micro-batches (batcher thread only).
+
+        With paged heads the swap additionally waits for every decode
+        slot to drain (admission pauses, in-flight slots finish within
+        sem_id_dim steps) so each request is answered by exactly ONE
+        params version — the same guarantee the dense path gets for free
+        from whole-batch executables. Returns True while a swap is still
+        staged (callers pause admission on it)."""
         with self._lock:
             pending = self._pending_params
-            self._pending_params = None
         if pending is None:
-            return
+            return False
+        if any(not r.idle for r in self._runners.values()):
+            return True  # swap barrier: drain decode slots first
+        with self._lock:
+            pending, self._pending_params = self._pending_params, None
+        if pending is None:
+            return False
         restored, step = pending
         self._params = restored
         self._step = step
@@ -411,3 +785,4 @@ class ServingEngine:
         for head in self._heads.values():
             head.on_params(self._select(head, restored))
         self._log.info(f"serving: now serving checkpoint step {step}")
+        return False

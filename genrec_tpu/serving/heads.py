@@ -38,11 +38,33 @@ from genrec_tpu.ops.trie import build_trie
 
 
 class Head:
-    """Interface + shared history padding helpers."""
+    """Interface + shared history padding helpers.
+
+    Heads with ``supports_paged = True`` additionally implement the paged
+    decode protocol (ragged paged KV + slot-level continuous batching —
+    the engine's `_PagedRunner` composes these):
+
+    - ``paged_layout() -> (n_layers, n_heads, head_dim, dtype)``: the
+      per-layer page-pool geometry;
+    - ``paged_kv_tokens(n_items, L_bucket) -> int``: KV tokens a request
+      occupies after prefill at history bucket L (page allocation +
+      seq_lens);
+    - ``paged_init_step`` / ``paged_total_steps``: a slot enters decode at
+      init_step and finishes when its step counter reaches total_steps;
+    - ``paged_state_zeros(n_slots)``: the slot-major decode-state dict;
+    - ``make_prefill_paged_fn(B, L)``: compiled per (batch, history)
+      bucket — runs the encoder/prefill, WRITES its K/V into the pools
+      through the batch's block tables, returns (k_pools, v_pools, init)
+      with init rows scattered into admitted slots;
+    - ``make_decode_paged_fn()``: compiled ONCE at max_slots — advances
+      every slot one step (per-slot step operands);
+    - ``paged_finalize(state_row, req)``: slot state -> response payload.
+    """
 
     name: str
     top_k: int
     generative = False
+    supports_paged = False
 
     def on_params(self, params) -> None:  # derived-table refresh hook
         del params
@@ -170,6 +192,68 @@ class TigerGenerativeHead(Head):
             for i in range(len(reqs))
         ]
 
+    # ---- paged decode protocol ---------------------------------------------
+
+    supports_paged = True
+
+    @property
+    def paged_init_step(self) -> int:
+        return 0
+
+    @property
+    def paged_total_steps(self) -> int:
+        return self.model.sem_id_dim
+
+    def paged_layout(self):
+        m = self.model
+        return m.n_layers // 2, m.num_heads, m.attn_dim // m.num_heads, m.dtype
+
+    def paged_kv_tokens(self, n_items: int, L_bucket: int) -> int:
+        # user token + D sem-id tokens per (bucket-clipped) history item
+        return 1 + min(int(n_items), L_bucket) * self.model.sem_id_dim
+
+    def paged_state_zeros(self, n_slots: int) -> dict:
+        from genrec_tpu.models.tiger import init_tiger_paged_state
+
+        # np.array (copy): the runner mutates these rows in place, and a
+        # numpy view of a jax buffer is read-only.
+        return {
+            k: np.array(v)
+            for k, v in init_tiger_paged_state(self.model, n_slots, self.top_k).items()
+        }
+
+    def make_prefill_paged_fn(self, B: int, L: int):
+        from genrec_tpu.models.tiger import tiger_prefill_paged
+
+        del B, L  # shapes come from make_batch/block_tables
+
+        def fn(params, user, ids, types, mask, block_tables, k_pools, v_pools):
+            k_pools, v_pools, _ = tiger_prefill_paged(
+                self.model, params, user, ids, types, mask, block_tables,
+                k_pools, v_pools,
+            )
+            return k_pools, v_pools, {}
+
+        return fn
+
+    def make_decode_paged_fn(self):
+        from genrec_tpu.models.tiger import tiger_paged_decode_step
+
+        def fn(params, state, steps, block_tables, seq_lens, k_pools, v_pools):
+            # Deterministic pure beam (the serving contract: identical
+            # requests get identical answers), same as the dense make_fn.
+            return tiger_paged_decode_step(
+                self.model, params, self.trie, state, steps, block_tables,
+                seq_lens, k_pools, v_pools, rng=None,
+            )
+
+        return fn
+
+    def paged_finalize(self, row: dict, req) -> dict:
+        sem = np.asarray(row["beam_seqs"])
+        return dict(items=self._lookup(sem), scores=np.asarray(row["beam_logps"]),
+                    sem_ids=sem)
+
 
 class CobraGenerativeHead(Head):
     """COBRA cached beam search, trie-masked, over a precomputed item tower.
@@ -251,6 +335,68 @@ class CobraGenerativeHead(Head):
                  sem_ids=np.asarray(sem_ids[i]))
             for i in range(len(reqs))
         ]
+
+    # ---- paged decode protocol ---------------------------------------------
+
+    supports_paged = True
+
+    @property
+    def paged_init_step(self) -> int:
+        # Codebook 0 resolves AT PREFILL (the step-0 head reads the
+        # history's last dense position); suffix steps cover 1..C-1.
+        return 1
+
+    @property
+    def paged_total_steps(self) -> int:
+        return self.model.n_codebooks
+
+    def paged_layout(self):
+        m = self.model
+        return (
+            m.decoder_n_layers, m.decoder_num_heads,
+            m.d_model // m.decoder_num_heads, m.dtype,
+        )
+
+    def paged_kv_tokens(self, n_items: int, L_bucket: int) -> int:
+        # C sparse + 1 dense token per (bucket-clipped) history item
+        return min(int(n_items), L_bucket) * (self.model.n_codebooks + 1)
+
+    def paged_state_zeros(self, n_slots: int) -> dict:
+        from genrec_tpu.models.cobra import init_cobra_paged_state
+
+        return {
+            k: np.array(v)  # copy: the runner mutates rows in place
+            for k, v in init_cobra_paged_state(self.model, n_slots, self.top_k).items()
+        }
+
+    def make_prefill_paged_fn(self, B: int, L: int):
+        from genrec_tpu.models.cobra import cobra_prefill_paged
+
+        del B, L
+
+        def fn(params, ids, vecs, block_tables, k_pools, v_pools):
+            return cobra_prefill_paged(
+                self.model, params, ids, vecs, block_tables, k_pools, v_pools,
+                self.trie, self.top_k, temperature=1.0,
+            )
+
+        return fn
+
+    def make_decode_paged_fn(self):
+        from genrec_tpu.models.cobra import cobra_paged_decode_step
+
+        def fn(params, state, steps, block_tables, seq_lens, k_pools, v_pools):
+            return cobra_paged_decode_step(
+                self.model, params, self.trie, state, steps, block_tables,
+                seq_lens, k_pools, v_pools, temperature=1.0,
+            )
+
+        return fn
+
+    def paged_finalize(self, row: dict, req) -> dict:
+        sem = np.asarray(row["beam_tokens"])
+        return dict(items=self._lookup(sem), scores=np.asarray(row["beam_scores"]),
+                    sem_ids=sem)
 
 
 class RetrievalHead(Head):

@@ -1,0 +1,284 @@
+"""Paged KV cache for the decode heads: fixed HBM budget, free-list pages.
+
+PR 5's engine AOT-compiled a dense (batch x history) KV cache per bucket,
+so decode memory scaled with the BUCKET a request landed in. Here the
+history K/V of every in-flight request lives in ONE pool per decoder
+layer, shaped (num_pages, page_size, heads, head_dim), and each decode
+slot names its pages through a block-table row (Ragged Paged Attention,
+arxiv 2604.15464): HBM is a fixed budget, occupancy tracks the tokens
+actually resident, and admission is denied (never over-allocated) when
+the pool is out of pages.
+
+Three layers, separable for testing:
+
+- ``PageAllocator``: host-side free list with per-page REFCOUNTS. Plain
+  admits hold one ref per page; ``addref`` lets two holders share pages
+  copy-on-write-style (the beam-sharing primitive: all K beams of a slot
+  read the same history pages, and a hand-off — e.g. prefill worker to
+  decode worker on the roadmap's disaggregated split — shares instead of
+  copying). A page returns to the free list only when its last ref is
+  dropped; freeing an unheld page raises.
+- ``KVPagePool``: the device pools + per-slot block tables + seq_lens.
+  ``admit(n_tokens)`` binds a free slot to freshly allocated pages,
+  ``evict(slot)`` releases them. Block-table rows pad with page 0, the
+  reserved NULL page — prefill's padded-tail writes land there and
+  attention never reads it unmasked (ops/paged.py contract).
+- ``PagedConfig``: the handful of static shapes the decode side compiles
+  against — (max_slots, pages_per_slot) replaces the whole decode-side
+  bucket ladder.
+
+Host-side bookkeeping is intentionally NOT thread-safe on its own: the
+engine's batcher thread is the only caller (same discipline as the
+executable cache).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class PoolExhausted(RuntimeError):
+    """Not enough free pages (or free slots) to admit the request; the
+    engine counts these and leaves the request queued instead of
+    over-committing the budget."""
+
+
+@dataclasses.dataclass(frozen=True)
+class PagedConfig:
+    """Static shape surface of the paged decode path.
+
+    The decode executable is compiled ONCE at (max_slots, pages_per_slot);
+    prefill stays on the (batch, history) bucket ladder but writes into
+    pages. ``num_pages`` includes the reserved null page 0.
+    """
+
+    max_slots: int = 32
+    page_size: int = 16
+    pages_per_slot: int = 8
+    num_pages: int = 0  # 0 = full budget: every slot can hold max pages
+
+    def __post_init__(self):
+        if self.max_slots <= 0 or self.page_size <= 0 or self.pages_per_slot <= 0:
+            raise ValueError(f"invalid paged config {self}")
+        if self.page_size % 8:
+            raise ValueError(
+                f"page_size {self.page_size} must be a multiple of 8 "
+                "(TPU sublane tile of the paged-attention kernel)"
+            )
+        if self.num_pages == 0:
+            object.__setattr__(
+                self, "num_pages", 1 + self.max_slots * self.pages_per_slot
+            )
+        if self.num_pages < 1 + self.pages_per_slot:
+            # A pool that cannot hold even ONE max-size slot would let an
+            # admissible max-history request defer forever (PoolExhausted
+            # on every retry) and head-of-line-block its queue.
+            raise ValueError(
+                f"num_pages {self.num_pages} cannot hold one full slot "
+                f"({self.pages_per_slot} pages + the null page); the pool "
+                "must fit at least one max-history request"
+            )
+
+    @property
+    def max_kv_tokens(self) -> int:
+        """Largest history (in KV tokens) one slot can hold."""
+        return self.pages_per_slot * self.page_size
+
+    def pages_for(self, n_tokens: int) -> int:
+        n = -(-int(n_tokens) // self.page_size)
+        if n > self.pages_per_slot:
+            raise ValueError(
+                f"{n_tokens} KV tokens need {n} pages > pages_per_slot "
+                f"{self.pages_per_slot}; size the config off the largest "
+                "history bucket"
+            )
+        return max(n, 1)
+
+    def hbm_bytes(self, n_layers: int, n_heads: int, head_dim: int,
+                  itemsize: int = 4) -> int:
+        """Pool HBM footprint (K + V, all layers) — the fixed budget."""
+        return (
+            2 * n_layers * self.num_pages * self.page_size * n_heads
+            * head_dim * itemsize
+        )
+
+
+class PageAllocator:
+    """Free-list page allocator with refcounts; page 0 is never handed out."""
+
+    def __init__(self, num_pages: int):
+        if num_pages < 2:
+            raise ValueError("need at least 2 pages (page 0 is the null page)")
+        self.num_pages = int(num_pages)
+        # LIFO free list: recently-freed pages are reused first (their
+        # stale KV is overwritten by the next prefill before any read).
+        self._free = list(range(self.num_pages - 1, 0, -1))
+        self._refs = np.zeros(self.num_pages, np.int64)
+
+    @property
+    def pages_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def pages_in_use(self) -> int:
+        return self.num_pages - 1 - len(self._free)
+
+    def alloc(self, n: int) -> list[int]:
+        """n fresh pages at refcount 1 — all-or-nothing."""
+        if n < 0:
+            raise ValueError(f"alloc({n})")
+        if n > len(self._free):
+            raise PoolExhausted(
+                f"need {n} pages, {len(self._free)} free of "
+                f"{self.num_pages - 1} allocatable"
+            )
+        pages = [self._free.pop() for _ in range(n)]
+        self._refs[pages] += 1
+        return pages
+
+    def addref(self, pages) -> None:
+        """Share already-live pages (copy-on-write ref, beam/worker
+        sharing). Refusing dead pages catches use-after-free at the
+        source."""
+        pages = list(pages)
+        if any(self._refs[p] <= 0 for p in pages):
+            raise ValueError("addref on a page that is not live")
+        self._refs[pages] += 1
+
+    def free(self, pages) -> None:
+        """Drop one ref per page; a page returns to the free list at zero.
+        Double-frees raise instead of corrupting the free list."""
+        for p in pages:
+            if p <= 0 or p >= self.num_pages:
+                raise ValueError(f"free of invalid page id {p}")
+            if self._refs[p] <= 0:
+                raise ValueError(f"double free of page {p}")
+            self._refs[p] -= 1
+            if self._refs[p] == 0:
+                self._free.append(p)
+
+    def check_invariants(self) -> None:
+        """Accounting self-check (the property tests call this after every
+        random op): free + live == capacity, no negative refs, free list
+        has no duplicates and no live pages."""
+        free = set(self._free)
+        if len(free) != len(self._free):
+            raise AssertionError("free list contains duplicates")
+        if 0 in free:
+            raise AssertionError("null page on the free list")
+        if (self._refs < 0).any():
+            raise AssertionError("negative refcount")
+        live = {p for p in range(self.num_pages) if self._refs[p] > 0}
+        if live & free:
+            raise AssertionError("page both live and free")
+        if len(live) + len(free) != self.num_pages - 1:
+            raise AssertionError("pages leaked")
+
+
+class KVPagePool:
+    """Device page pools + slot bindings for ONE head's decode layers."""
+
+    def __init__(self, cfg: PagedConfig, n_layers: int, n_heads: int,
+                 head_dim: int, dtype=jnp.float32):
+        self.cfg = cfg
+        self.n_layers = n_layers
+        shape = (cfg.num_pages, cfg.page_size, n_heads, head_dim)
+        self.k_pools = tuple(jnp.zeros(shape, dtype) for _ in range(n_layers))
+        self.v_pools = tuple(jnp.zeros(shape, dtype) for _ in range(n_layers))
+        self.allocator = PageAllocator(cfg.num_pages)
+        self.block_tables = np.zeros((cfg.max_slots, cfg.pages_per_slot), np.int32)
+        self.seq_lens = np.zeros((cfg.max_slots,), np.int32)
+        self._slot_pages: list[list[int] | None] = [None] * cfg.max_slots
+        # Min-heap: slots fill LOWEST-INDEX-FIRST so the active set stays
+        # quasi-compact and the decode step can run at the smallest slot
+        # shape covering max(active index) (the collapsed decode ladder).
+        self._free_slots = list(range(cfg.max_slots))
+        heapq.heapify(self._free_slots)
+
+    @property
+    def free_slot_count(self) -> int:
+        return len(self._free_slots)
+
+    @property
+    def active_slot_count(self) -> int:
+        return self.cfg.max_slots - len(self._free_slots)
+
+    def live_slots(self) -> list[int]:
+        return [s for s, p in enumerate(self._slot_pages) if p is not None]
+
+    def admit(self, n_tokens: int) -> int:
+        """Bind a free slot to pages covering ``n_tokens`` of KV. Returns
+        the slot id; raises PoolExhausted (state unchanged) when out of
+        slots or pages."""
+        if not self._free_slots:
+            raise PoolExhausted("no free decode slots")
+        pages = self.allocator.alloc(self.cfg.pages_for(n_tokens))  # may raise
+        slot = heapq.heappop(self._free_slots)
+        self._slot_pages[slot] = pages
+        row = np.zeros(self.cfg.pages_per_slot, np.int32)
+        row[: len(pages)] = pages
+        self.block_tables[slot] = row
+        self.seq_lens[slot] = n_tokens
+        return slot
+
+    def evict(self, slot: int) -> None:
+        """Release the slot's pages (their last ref, unless shared) and
+        return the slot to the free list."""
+        pages = self._slot_pages[slot]
+        if pages is None:
+            raise ValueError(f"evict of inactive slot {slot}")
+        self.allocator.free(pages)
+        self._slot_pages[slot] = None
+        self.block_tables[slot] = 0
+        self.seq_lens[slot] = 0
+        heapq.heappush(self._free_slots, slot)
+
+    def share_into(self, src_slot: int, dst_slot_tokens: int) -> int:
+        """Admit a NEW slot that shares the source slot's pages (COW ref,
+        no copy) — the page-remapping hand-off primitive. The new slot
+        sees the first ``dst_slot_tokens`` of the shared history."""
+        pages = self._slot_pages[src_slot]
+        if pages is None:
+            raise ValueError(f"share from inactive slot {src_slot}")
+        if not self._free_slots:
+            raise PoolExhausted("no free decode slots")
+        if dst_slot_tokens > len(pages) * self.cfg.page_size:
+            raise ValueError("shared view exceeds the source slot's pages")
+        self.allocator.addref(pages)
+        slot = heapq.heappop(self._free_slots)
+        self._slot_pages[slot] = list(pages)
+        row = np.zeros(self.cfg.pages_per_slot, np.int32)
+        row[: len(pages)] = pages
+        self.block_tables[slot] = row
+        self.seq_lens[slot] = dst_slot_tokens
+        return slot
+
+    def check_invariants(self) -> None:
+        """Property-test hook: allocator accounting holds AND no page is
+        bound by two live slots unless deliberately shared (refcount >=
+        the number of slots binding it)."""
+        self.allocator.check_invariants()
+        bound: dict[int, int] = {}
+        for pages in self._slot_pages:
+            for p in pages or ():
+                bound[p] = bound.get(p, 0) + 1
+        for p, n in bound.items():
+            if self.allocator._refs[p] < n:
+                raise AssertionError(
+                    f"page {p} bound by {n} slots but holds "
+                    f"{self.allocator._refs[p]} refs (aliasing without a ref)"
+                )
+
+    def stats(self) -> dict:
+        """Operator gauges (serving/metrics.py forwards these)."""
+        return {
+            "pages_in_use": self.allocator.pages_in_use,
+            "pages_free": self.allocator.pages_free,
+            "slots_active": self.active_slot_count,
+            "slots_total": self.cfg.max_slots,
+            "kv_tokens_resident": int(self.seq_lens.sum()),
+        }

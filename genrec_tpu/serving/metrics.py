@@ -79,6 +79,15 @@ class ServingMetrics:
         self.warmup_compiles = 0
         self.recompilations = 0  # post-warmup compiles: steady state => 0
         self.params_swaps = 0
+        # Paged decode (slot-level continuous batching): admit/evict churn,
+        # deferred-for-OOM admits, decode-step count, and per-head KV-pool
+        # gauges so pool pressure is visible in the operator line.
+        self.admits = 0
+        self.evictions = 0
+        self.oom_deferred_admits = 0
+        self.decode_steps = 0
+        self.rejected_by_head: collections.Counter = collections.Counter()
+        self.pool_gauges: dict[str, dict] = {}
         self._recent = collections.deque(maxlen=recent_window)
         self._started = time.monotonic()
         self._warm = False
@@ -100,9 +109,38 @@ class ServingMetrics:
         with self._lock:
             self.submitted += 1
 
-    def record_reject(self) -> None:
+    def record_reject(self, head: str | None = None) -> None:
+        """Draining rejection; per-head attribution feeds the drain report
+        (rejections only ever happen while draining, so the per-head
+        counter IS "rejected during drain" for each head)."""
         with self._lock:
             self.rejected += 1
+            if head is not None:
+                self.rejected_by_head[head] += 1
+
+    def record_admit(self, n: int = 1) -> None:
+        with self._lock:
+            self.admits += n
+
+    def record_evict(self, n: int = 1) -> None:
+        with self._lock:
+            self.evictions += n
+
+    def record_oom_admit(self, n: int = 1) -> None:
+        """Admissions DEFERRED because the KV pool had no pages/slots —
+        the request stays queued and retries as evictions free pages, so
+        a nonzero rate means the pool budget, not the arrival rate, is
+        the bottleneck."""
+        with self._lock:
+            self.oom_deferred_admits += n
+
+    def record_decode_step(self) -> None:
+        with self._lock:
+            self.decode_steps += 1
+
+    def set_pool_gauges(self, head: str, gauges: dict) -> None:
+        with self._lock:
+            self.pool_gauges[head] = dict(gauges)
 
     def record_failure(self, n: int = 1) -> None:
         with self._lock:
@@ -154,7 +192,13 @@ class ServingMetrics:
                 warmup_compiles=self.warmup_compiles,
                 recompilations=self.recompilations,
                 params_swaps=self.params_swaps,
+                admits=self.admits,
+                evictions=self.evictions,
+                oom_deferred_admits=self.oom_deferred_admits,
+                decode_steps=self.decode_steps,
             )
+            rejected_by_head = dict(sorted(self.rejected_by_head.items()))
+            kv_pool = {h: dict(g) for h, g in sorted(self.pool_gauges.items())}
         return {
             **counts,
             "qps": round(self.qps(), 3),
@@ -163,4 +207,6 @@ class ServingMetrics:
             "compute_ms": self.compute.summary(),
             "total_ms": self.total.summary(),
             "bucket_hits": bucket_hits,
+            "rejected_by_head": rejected_by_head,
+            "kv_pool": kv_pool,
         }

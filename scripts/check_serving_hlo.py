@@ -1,19 +1,23 @@
 """Steady-state compilation check for the serving engine (pattern:
-scripts/check_decode_hlo.py): does the bucketed compilation ladder really
-make the serving path shape-stable?
+scripts/check_decode_hlo.py): does the bucketed compilation ladder — and
+the paged decode path's collapsed shape set — really make the serving
+path shape-stable?
 
-Starts an in-process ServingEngine (TIGER generative head, the deepest
-compile surface: encoder + KV-cached constrained beam loop), warms up the
-full (batch-bucket x history-bucket) grid, then serves N steady-state
-requests across MIXED history lengths and micro-batch sizes and asserts:
+Two phases over the TIGER generative head (the deepest compile surface:
+encoder + KV-cached constrained beam loop):
 
-  1. the engine's recompilation counter stays ZERO — every steady-state
-     request ran in an executable AOT-compiled at warmup (the engine only
-     compiles on an executable-cache miss, so the counter is exact);
-  2. the traffic genuinely exercised bucket variety (>= 3 distinct
-     (batch, history) buckets hit) — otherwise assertion 1 is vacuous;
-  3. every generative answer is a real corpus item (items >= 0): the
-     trie constraint held through the compiled path.
+1. **dense** — the PR-5 whole-batch path (paged=False): warm the full
+   (batch-bucket x history-bucket) grid, serve N steady-state requests
+   across MIXED history lengths and micro-batch sizes, assert ZERO
+   recompilations and genuine bucket variety.
+2. **paged** — slot-level continuous batching: ONE decode executable at
+   (max_slots, pages_per_slot) plus the prefill bucket grid. Traffic is
+   deliberately CHURNY: staggered bursts of mixed-length requests are
+   submitted while earlier decodes are still in flight, so slots admit
+   and evict mid-decode. Asserts ZERO recompilations under that churn,
+   every answer a real corpus item, all pages/slots released at the end,
+   and that decode steps genuinely interleaved generations (fewer total
+   steps than sequential whole-batch decoding would need).
 
 Run:  python scripts/check_serving_hlo.py             (default shapes)
       python scripts/check_serving_hlo.py --small     (CI-speed shapes)
@@ -30,6 +34,60 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+
+
+def _drive_dense(engine, head, valid_ids, n_requests, max_hist, n_users, rng):
+    """Original steady-state traffic: bursts of varying size so
+    micro-batches of different (B, L) buckets actually form."""
+    import numpy as np
+
+    from genrec_tpu.serving import Request
+
+    served, items_ok = 0, True
+    group_sizes = [1, engine._max_batch, 2, engine._max_batch, 1, 3]
+    while served < n_requests:
+        g = group_sizes[served % len(group_sizes)]
+        futs = []
+        for _ in range(min(g, n_requests - served)):
+            n = int(rng.integers(1, max_hist + 1))
+            futs.append(engine.submit(Request(
+                head=head.name,
+                history=rng.integers(0, len(valid_ids), n),
+                user_id=int(rng.integers(0, n_users)),
+            )))
+        for f in futs:
+            r = f.result(300)
+            items_ok = items_ok and bool((np.asarray(r.items) >= 0).all())
+        served += len(futs)
+    return served, items_ok
+
+
+def _drive_churn(engine, head, valid_ids, n_requests, max_hist, n_users, rng):
+    """Admit/evict churn: keep a rolling window of in-flight futures and
+    top it up as results stream back, so new requests are admitted into
+    slots WHILE other slots are mid-decode — the traffic shape
+    continuous batching exists for."""
+    import collections
+
+    import numpy as np
+
+    from genrec_tpu.serving import Request
+
+    submitted, items_ok = 0, True
+    inflight = collections.deque()
+    window = 2 * engine._max_batch + 1  # deliberately > max_batch
+    while submitted < n_requests or inflight:
+        while submitted < n_requests and len(inflight) < window:
+            n = int(rng.integers(1, max_hist + 1))
+            inflight.append(engine.submit(Request(
+                head=head.name,
+                history=rng.integers(0, len(valid_ids), n),
+                user_id=int(rng.integers(0, n_users)),
+            )))
+            submitted += 1
+        r = inflight.popleft().result(300)
+        items_ok = items_ok and bool((np.asarray(r.items) >= 0).all())
+    return submitted, items_ok
 
 
 def main(argv=None):
@@ -52,7 +110,7 @@ def main(argv=None):
     import numpy as np
 
     from genrec_tpu.models.tiger import Tiger
-    from genrec_tpu.serving import BucketLadder, Request, ServingEngine
+    from genrec_tpu.serving import BucketLadder, ServingEngine
     from genrec_tpu.serving.heads import TigerGenerativeHead
 
     backend = jax.default_backend()
@@ -73,6 +131,7 @@ def main(argv=None):
     D = arch["sem_id_dim"]
     Kcb = arch["num_item_embeddings"]
     max_hist = ladder.history_buckets[-1]
+    n_users = arch["num_user_embeddings"]
 
     model = Tiger(**arch)
     rng = np.random.default_rng(0)
@@ -85,60 +144,78 @@ def main(argv=None):
         jnp.zeros((B0, D), jnp.int32), jnp.ones((B0, L0), jnp.int32),
     )["params"]
 
-    head = TigerGenerativeHead(model, valid_ids, top_k=5)
-    engine = ServingEngine(
-        [head], params, ladder=ladder, max_batch=ladder.max_batch,
-        max_wait_ms=1.0, handle_signals=False,
-    ).start()
+    phases = {}
+    for phase, paged in (("dense", False), ("paged", True)):
+        head = TigerGenerativeHead(model, valid_ids, top_k=5)
+        engine = ServingEngine(
+            [head], params, ladder=ladder, max_batch=ladder.max_batch,
+            max_wait_ms=1.0, handle_signals=False, paged=paged,
+        ).start()
+        drive = _drive_churn if paged else _drive_dense
+        served, items_ok = drive(
+            engine, head, valid_ids, n_requests, max_hist, n_users, rng
+        )
+        stats = engine.stop()
+        rec = {
+            "warmup_compiles": stats["warmup_compiles"],
+            "steady_state_requests": served,
+            "recompilations": stats["recompilations"],
+            "buckets_hit": len(stats["bucket_hits"]),
+            "bucket_hits": stats["bucket_hits"],
+            "constrained_items_valid": items_ok,
+            "completed": stats["completed"],
+            "p50_ms": stats["total_ms"]["p50"],
+            "p99_ms": stats["total_ms"]["p99"],
+        }
+        ok = (
+            stats["recompilations"] == 0
+            and rec["buckets_hit"] >= 3
+            and items_ok
+            and stats["completed"] == n_requests
+        )
+        if paged:
+            pool = stats["kv_pool"][head.name]
+            rec.update(
+                admits=stats["admits"],
+                evictions=stats["evictions"],
+                decode_steps=stats["decode_steps"],
+                oom_deferred_admits=stats["oom_deferred_admits"],
+                pages_in_use_final=pool["pages_in_use"],
+                slots_active_final=pool["slots_active"],
+            )
+            # Churn really happened (every request cycled a slot), the
+            # pool drained clean, and decode interleaved generations
+            # (strictly fewer steps than sequential decoding: D each).
+            ok = ok and (
+                stats["admits"] == n_requests
+                and stats["evictions"] == n_requests
+                and pool["pages_in_use"] == 0
+                and pool["slots_active"] == 0
+                and 0 < stats["decode_steps"] < n_requests * D
+            )
+        rec["ok"] = ok
+        phases[phase] = rec
 
-    # Steady state: groups of varying size (1..max_batch) with histories
-    # spanning every history bucket — the mixed traffic the ladder exists
-    # to keep shape-stable. Submit each group as a burst so micro-batches
-    # of different sizes actually form.
-    served = 0
-    items_ok = True
-    group_sizes = [1, ladder.max_batch, 2, ladder.max_batch, 1, 3]
-    while served < n_requests:
-        g = group_sizes[served % len(group_sizes)]
-        futs = []
-        for _ in range(min(g, n_requests - served)):
-            n = int(rng.integers(1, max_hist + 1))
-            futs.append(engine.submit(Request(
-                head=head.name,
-                history=rng.integers(0, len(valid_ids), n),
-                user_id=int(rng.integers(0, arch["num_user_embeddings"])),
-            )))
-        for f in futs:
-            r = f.result(300)
-            items_ok = items_ok and bool((np.asarray(r.items) >= 0).all())
-        served += len(futs)
-
-    stats = engine.stop()
-    buckets_hit = len(stats["bucket_hits"])
-    recompiles = stats["recompilations"]
-    ok = recompiles == 0 and buckets_hit >= 3 and items_ok and stats[
-        "completed"
-    ] == n_requests
+    ok = all(p["ok"] for p in phases.values())
     verdict = {
         "backend": backend,
-        "warmup_compiles": stats["warmup_compiles"],
-        "steady_state_requests": served,
-        "recompilations": recompiles,
-        "buckets_hit": buckets_hit,
-        "bucket_hits": stats["bucket_hits"],
-        "constrained_items_valid": items_ok,
-        "p50_ms": stats["total_ms"]["p50"],
-        "p99_ms": stats["total_ms"]["p99"],
+        "dense": phases["dense"],
+        "paged": phases["paged"],
+        # Legacy top-level fields (the dense phase) for note/grep compat.
+        "recompilations": phases["dense"]["recompilations"]
+        + phases["paged"]["recompilations"],
         "ok": ok,
     }
     print(json.dumps(verdict))
 
     if args.write_note:
         if ok:
+            d, p = phases["dense"], phases["paged"]
             msg = (
-                f"OK: {served} steady-state requests over {buckets_hit} "
-                f"(batch, history) buckets with 0 recompilations "
-                f"({stats['warmup_compiles']} warmup executables)"
+                f"OK: dense {d['steady_state_requests']} requests over "
+                f"{d['buckets_hit']} buckets, paged {p['steady_state_requests']} "
+                f"requests through {p['admits']} admit/evict churn cycles "
+                f"({p['decode_steps']} decode steps), 0 recompilations in both"
             )
         else:
             msg = "ATTENTION: serving engine recompiled in steady state"

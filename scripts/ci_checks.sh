@@ -6,7 +6,10 @@
 #   check_fused_ce_hlo.py  — fused-CE Mosaic call partitions under the mesh
 #   check_packed_hlo.py    — packed train step has no per-example re-pad
 #   check_serving_hlo.py   — serving engine: zero steady-state XLA
-#                            recompilations across mixed-shape traffic
+#                            recompilations across mixed-shape traffic,
+#                            incl. paged-decode admit/evict churn
+#   kv_pool / paged parity — page-allocator churn property tests + paged
+#                            decode == dense-cache parity (TIGER, COBRA)
 #   serving smoke          — CPU in-process engine: all four heads answer,
 #                            SIGTERM drains cleanly, hot reload + quarantine
 #   tpu_kernel_check.py    — Pallas kernels at trainer shapes (TPU only)
@@ -88,6 +91,11 @@ if [ "$MODE" = "--smoke" ]; then
         # pytest pass already runs these tests directly).
         run_strict env JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py \
             -q -m serving_smoke -p no:cacheprovider 1>&2
+        # Paged decode subset: allocator never leaks/double-frees/aliases
+        # pages under churn, and the paged pool path answers exactly like
+        # the dense caches (the parity the kernel gate relies on).
+        run_strict env JAX_PLATFORMS=cpu python -m pytest tests/test_kv_pool.py \
+            tests/test_paged_parity.py -q -m 'not slow' -p no:cacheprovider 1>&2
         run_strict env JAX_PLATFORMS=cpu python -m pytest tests/test_fault_tolerance.py \
             -q -m chaos_unit -p no:cacheprovider 1>&2
         # Multi-host chaos smoke: 2 real jax.distributed CPU workers prove
@@ -102,10 +110,11 @@ else
     run python scripts/check_fused_ce_hlo.py --write-note
     run python scripts/check_packed_hlo.py --write-note
     run python scripts/check_serving_hlo.py --write-note
-    # Full serving suite (incl. the slow all-four-heads drain test and
-    # the slow COBRA trie-constraint pins).
+    # Full serving suite (incl. the slow all-four-heads drain test, the
+    # slow COBRA trie-constraint pins, and the full paged-parity matrix).
     run_strict env JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py \
-        tests/test_trie_constrained.py -q -p no:cacheprovider 1>&2
+        tests/test_trie_constrained.py tests/test_kv_pool.py \
+        tests/test_paged_parity.py -q -p no:cacheprovider 1>&2
     # Full chaos suite: SIGTERM mid-epoch + exact-resume parity for all
     # seven trainers, ladder fallback, NaN injection — plus the 2-process
     # multi-host chaos (consensus restore, mid-save host kill, init

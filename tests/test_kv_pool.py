@@ -1,0 +1,213 @@
+"""Paged KV pool: allocator safety under churn + kernel/fallback parity.
+
+The page allocator is the one piece of the paged decode path with
+NON-compiled mutable state, so it gets property tests: random
+admit/evict/share(beam-reorder-style COW) sequences must never leak a
+page, never double-free, and never alias a page across live slots
+without a ref. The paged-attention kernel is pinned against the pure-JAX
+fallback the same way the HSTU kernel is pinned against its XLA
+reference.
+"""
+
+import numpy as np
+import pytest
+
+from genrec_tpu.serving.kv_pool import (
+    KVPagePool,
+    PageAllocator,
+    PagedConfig,
+    PoolExhausted,
+)
+
+
+# ---- PagedConfig ------------------------------------------------------------
+
+
+def test_paged_config_defaults_and_validation():
+    cfg = PagedConfig(max_slots=4, page_size=16, pages_per_slot=3)
+    assert cfg.num_pages == 1 + 4 * 3  # full budget + null page
+    assert cfg.max_kv_tokens == 48
+    assert cfg.pages_for(1) == 1 and cfg.pages_for(16) == 1
+    assert cfg.pages_for(17) == 2 and cfg.pages_for(48) == 3
+    assert cfg.pages_for(0) == 1  # empty history still binds one page
+    with pytest.raises(ValueError):
+        cfg.pages_for(49)
+    with pytest.raises(ValueError):
+        PagedConfig(page_size=12)  # not a sublane multiple
+    with pytest.raises(ValueError):
+        PagedConfig(max_slots=0)
+    with pytest.raises(ValueError):
+        # A pool that can't hold ONE max-size slot would let a max-history
+        # request defer forever (head-of-line block) — refused at config.
+        PagedConfig(max_slots=4, page_size=16, pages_per_slot=3, num_pages=3)
+    assert cfg.hbm_bytes(n_layers=2, n_heads=4, head_dim=8) == (
+        2 * 2 * 13 * 16 * 4 * 8 * 4
+    )
+
+
+# ---- allocator unit behavior ------------------------------------------------
+
+
+def test_allocator_alloc_free_refcounts():
+    a = PageAllocator(6)  # pages 1..5 allocatable
+    p1 = a.alloc(2)
+    p2 = a.alloc(3)
+    assert a.pages_free == 0 and a.pages_in_use == 5
+    with pytest.raises(PoolExhausted):
+        a.alloc(1)
+    # Exhausted alloc left state intact (all-or-nothing).
+    a.check_invariants()
+    a.addref(p1)  # COW share
+    a.free(p1)  # one holder drops; pages stay live
+    assert a.pages_free == 0
+    a.free(p1)  # last ref -> back on the free list
+    assert a.pages_free == 2
+    with pytest.raises(ValueError):
+        a.free(p1)  # double free refuses
+    with pytest.raises(ValueError):
+        a.addref(p1)  # dead pages cannot be shared
+    with pytest.raises(ValueError):
+        a.free([0])  # the null page is never allocatable
+    a.free(p2)
+    assert a.pages_free == 5 and a.pages_in_use == 0
+    a.check_invariants()
+
+
+def test_pool_admit_evict_binds_block_tables():
+    cfg = PagedConfig(max_slots=3, page_size=8, pages_per_slot=2)
+    pool = KVPagePool(cfg, n_layers=1, n_heads=2, head_dim=4)
+    s0 = pool.admit(13)  # 2 pages
+    s1 = pool.admit(3)  # 1 page
+    assert pool.seq_lens[s0] == 13 and pool.seq_lens[s1] == 3
+    assert (pool.block_tables[s0] > 0).sum() == 2
+    assert (pool.block_tables[s1] > 0).sum() == 1
+    # No page appears in two live rows.
+    live = np.concatenate([pool.block_tables[s] for s in (s0, s1)])
+    live = live[live > 0]
+    assert len(set(live)) == len(live)
+    pool.check_invariants()
+    pool.evict(s0)
+    assert pool.seq_lens[s0] == 0 and (pool.block_tables[s0] == 0).all()
+    with pytest.raises(ValueError):
+        pool.evict(s0)  # double evict refuses
+    pool.check_invariants()
+
+
+def test_pool_exhaustion_defers_cleanly():
+    cfg = PagedConfig(max_slots=8, page_size=8, pages_per_slot=2, num_pages=4)
+    pool = KVPagePool(cfg, n_layers=1, n_heads=2, head_dim=4)
+    pool.admit(16)  # 2 pages
+    pool.admit(8)  # 1 page -> 0 free
+    before = pool.block_tables.copy()
+    with pytest.raises(PoolExhausted):
+        pool.admit(16)
+    # Failed admission left nothing bound.
+    np.testing.assert_array_equal(pool.block_tables, before)
+    pool.check_invariants()
+
+
+def test_pool_share_into_is_copy_on_write():
+    cfg = PagedConfig(max_slots=4, page_size=8, pages_per_slot=2)
+    pool = KVPagePool(cfg, n_layers=1, n_heads=2, head_dim=4)
+    src = pool.admit(16)
+    dst = pool.share_into(src, 8)  # shared view of the first page's tokens
+    np.testing.assert_array_equal(pool.block_tables[src], pool.block_tables[dst])
+    pool.check_invariants()  # aliasing is ref-backed, not a leak
+    pool.evict(src)  # pages survive: dst still holds a ref
+    assert pool.allocator.pages_in_use == 2
+    pool.evict(dst)
+    assert pool.allocator.pages_in_use == 0
+    pool.check_invariants()
+
+
+# ---- the churn property test ------------------------------------------------
+
+
+def test_allocator_random_churn_never_leaks_or_aliases(rng):
+    """Random admit/evict/share sequences: after EVERY op the pool must
+    account for all pages (free + live == capacity), hold no page in two
+    live slots without a matching ref, and reject over-budget admits
+    without corrupting state."""
+    cfg = PagedConfig(max_slots=6, page_size=8, pages_per_slot=3, num_pages=12)
+    pool = KVPagePool(cfg, n_layers=1, n_heads=2, head_dim=4)
+    live: list[int] = []
+    admitted = evicted = deferred = shared = 0
+    for _ in range(600):
+        op = rng.random()
+        try:
+            if op < 0.45:
+                live.append(pool.admit(int(rng.integers(0, cfg.max_kv_tokens + 1))))
+                admitted += 1
+            elif op < 0.55 and live:
+                src = live[int(rng.integers(len(live)))]
+                live.append(pool.share_into(src, int(pool.seq_lens[src])))
+                shared += 1
+            elif live:
+                slot = live.pop(int(rng.integers(len(live))))
+                pool.evict(slot)
+                evicted += 1
+        except PoolExhausted:
+            deferred += 1
+        pool.check_invariants()
+        assert pool.active_slot_count == len(live)
+    # The sequence genuinely exercised all paths.
+    assert admitted > 100 and evicted > 100 and deferred > 10 and shared > 5
+    for slot in list(live):
+        pool.evict(slot)
+    pool.check_invariants()
+    assert pool.allocator.pages_in_use == 0
+    assert pool.allocator.pages_free == cfg.num_pages - 1
+
+
+# ---- paged-attention kernel vs fallback parity ------------------------------
+
+
+def test_paged_attention_kernel_matches_fallback(rng):
+    """Pallas kernel (interpret mode on CPU) == pure-JAX gather fallback
+    <= 1e-5, including a fully-masked slot and null-page padding — the
+    same pin discipline as test_hstu_kernel."""
+    import jax.numpy as jnp
+
+    from genrec_tpu.kernels.paged_attention import paged_attention_stats_pallas
+    from genrec_tpu.ops.paged import paged_attention_stats
+
+    S, K, H, hd, page, P = 4, 5, 3, 8, 8, 12
+    q = jnp.asarray(rng.normal(size=(S, K, H, hd)), jnp.float32)
+    kp = jnp.asarray(rng.normal(size=(P, page, H, hd)), jnp.float32)
+    vp = jnp.asarray(rng.normal(size=(P, page, H, hd)), jnp.float32)
+    bt = jnp.asarray([[1, 2, 3], [4, 0, 0], [5, 6, 0], [7, 8, 9]], jnp.int32)
+    sl = jnp.asarray([24, 3, 0, 17], jnp.int32)  # incl. a fully-masked slot
+
+    ref = paged_attention_stats(q, kp, vp, bt, sl, use_kernel=False)
+    out = paged_attention_stats_pallas(q, kp, vp, bt, sl)
+    for a, b, name in zip(ref, out, ("acc", "m", "l")):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5, err_msg=name
+        )
+
+
+def test_paged_attention_matches_dense_softmax(rng):
+    """The normalized paged output equals plain masked softmax attention
+    over the gathered keys — the bridge to the dense decode paths."""
+    import jax.numpy as jnp
+
+    from genrec_tpu.ops.paged import gather_pages, paged_attention
+
+    S, K, H, hd, page, P, Pm = 2, 3, 2, 8, 8, 8, 2
+    q = jnp.asarray(rng.normal(size=(S, K, H, hd)), jnp.float32)
+    kp = jnp.asarray(rng.normal(size=(P, page, H, hd)), jnp.float32)
+    vp = jnp.asarray(rng.normal(size=(P, page, H, hd)), jnp.float32)
+    bt = jnp.asarray([[1, 2], [3, 0]], jnp.int32)
+    sl = jnp.asarray([11, 8], jnp.int32)
+
+    out = np.asarray(paged_attention(q, kp, vp, bt, sl, use_kernel=False))
+    k = np.asarray(gather_pages(kp, bt))
+    v = np.asarray(gather_pages(vp, bt))
+    s = np.einsum("skhd,smhd->skhm", np.asarray(q), k) * hd**-0.5
+    tok = np.arange(Pm * page)
+    s = np.where(tok[None, None, None, :] >= np.asarray(sl)[:, None, None, None],
+                 -1e9, s)
+    attn = np.exp(s - s.max(-1, keepdims=True))
+    attn /= attn.sum(-1, keepdims=True)
+    ref = np.einsum("skhm,smhd->skhd", attn, v)
+    np.testing.assert_allclose(out, ref, atol=1e-5)

@@ -29,6 +29,7 @@ from genrec_tpu.serving import (
     CobraGenerativeHead,
     DrainingError,
     LatencyHistogram,
+    PagedConfig,
     Request,
     RetrievalHead,
     ServingEngine,
@@ -94,11 +95,17 @@ def test_log_serving_stats_smoke(tmp_path):
         "qps": 12.5, "completed": 10, "rejected": 0, "recompilations": 0,
         "params_step": 3, "total_ms": {"p50": 5.0, "p95": 9.0, "p99": 12.0},
         "bucket_hits": {"tiger/B1/L8": 10},
+        "admits": 10, "evictions": 10, "oom_deferred_admits": 1,
+        "kv_pool": {"tiger": {"pages_in_use": 3, "pages_free": 5,
+                              "slots_active": 2, "slots_total": 8,
+                              "kv_tokens_resident": 40}},
     }
     log_serving_stats(logger, tracker, stats)
     tracker.finish()
     text = (tmp_path / "metrics.jsonl").read_text()
     assert "serve/qps" in text and "serve/total_ms/p95" in text
+    # Pool gauges flatten into the tracker namespace too.
+    assert "serve/kv_pool/tiger/pages_in_use" in text
 
 
 # ---- tiny model zoo ---------------------------------------------------------
@@ -251,6 +258,105 @@ def test_drain_chaos_sigterm_midload(sasrec_setup, rng):
         assert signal.getsignal(signal.SIGTERM) == prev_term
         assert signal.getsignal(signal.SIGINT) == prev_int
         assert eng._guard._prev == {}
+    finally:
+        eng.stop()
+
+
+# ---- paged decode: slot-level continuous batching ---------------------------
+
+
+@pytest.mark.serving_smoke
+def test_paged_continuous_batching_churn_under_pool_pressure(zoo, corpus, rng):
+    """TIGER through the paged decode path with a pool SMALLER than the
+    offered load: requests churn through slots (admit-on-free,
+    evict-on-finish), over-budget admissions defer (never drop, never
+    over-allocate), every answer is a real corpus item matching the
+    dense path bit-for-bit, and the steady state never recompiles."""
+    models, params = zoo
+    valid, _ = corpus
+    head = TigerGenerativeHead(models["tiger"], valid, top_k=4, name="tiger")
+    # 4 slots / 9 pages: at most 2 max-history requests resident at once.
+    cfg = PagedConfig(max_slots=4, page_size=8, pages_per_slot=4, num_pages=9)
+    eng = ServingEngine(
+        [head], params["tiger"], ladder=BucketLadder((1, 2), (4, 8)),
+        max_batch=2, max_wait_ms=1.0, handle_signals=False, paged_config=cfg,
+    ).start()
+    try:
+        futs = [
+            eng.submit(_req("tiger", rng, int(rng.integers(1, 9)), len(valid)))
+            for _ in range(12)
+        ]
+        resps = [f.result(120) for f in futs]
+        for r in resps:
+            assert (r.items >= 0).all() and (r.items < len(valid)).all()
+            assert r.sem_ids.shape == (4, 3)
+        st = eng.stats()
+        assert st["completed"] == 12
+        assert st["recompilations"] == 0
+        assert st["admits"] == 12 and st["evictions"] == 12
+        # The pool genuinely ran under pressure and deferred admissions.
+        assert st["oom_deferred_admits"] > 0
+        # Decode really interleaved generations: strictly fewer decode
+        # steps than 12 sequential 3-step generations would need.
+        assert 3 <= st["decode_steps"] < 36
+        pool = st["kv_pool"]["tiger"]
+        assert pool["pages_in_use"] == 0 and pool["slots_active"] == 0
+
+        # Paged engine answers == the dense whole-batch path, bit-for-bit.
+        fixed = Request(head="tiger", history=np.arange(5) % len(valid))
+        r = eng.serve(fixed, timeout=60)
+        dense = ServingEngine(
+            [TigerGenerativeHead(models["tiger"], valid, top_k=4, name="tiger")],
+            params["tiger"], ladder=BucketLadder((1, 2), (4, 8)),
+            max_batch=2, max_wait_ms=1.0, handle_signals=False, paged=False,
+        ).start()
+        try:
+            r_dense = dense.serve(fixed, timeout=60)
+        finally:
+            dense.stop()
+        np.testing.assert_array_equal(r.sem_ids, r_dense.sem_ids)
+        np.testing.assert_allclose(r.scores, r_dense.scores, atol=1e-5)
+    finally:
+        eng.stop()
+
+
+@pytest.mark.serving_smoke
+def test_paged_drain_chaos_sigterm_midchurn(zoo, corpus, rng):
+    """SIGTERM lands mid decode-churn (chaos fires after the 2nd decode
+    step): every accepted request still completes through the continuous
+    loop, late submissions get the typed error ATTRIBUTED PER HEAD in the
+    drain stats, and the one-shot guard restores the previous handlers —
+    the second-signal escalation contract, now pinned for the paged loop."""
+    models, params = zoo
+    valid, _ = corpus
+    prev_term = signal.getsignal(signal.SIGTERM)
+    prev_int = signal.getsignal(signal.SIGINT)
+    head = TigerGenerativeHead(models["tiger"], valid, top_k=4, name="tiger")
+    eng = ServingEngine(
+        [head], params["tiger"], ladder=BucketLadder((1, 2), (8,)),
+        max_batch=2, max_wait_ms=1.0,
+    )
+    try:
+        with chaos.inject(chaos.ChaosPlan(kill_at_step=2)):
+            futs = [
+                eng.submit(_req("tiger", rng, int(rng.integers(1, 9)), len(valid)))
+                for _ in range(8)
+            ]
+            eng.start()
+            resps = [f.result(120) for f in futs]
+        assert len(resps) == 8  # nothing dropped mid-churn
+        assert eng.join(60), "paged engine did not finish draining"
+        assert eng.draining
+        with pytest.raises(DrainingError):
+            eng.submit(_req("tiger", rng, 3, len(valid)))
+        st = eng.stats()
+        assert st["rejected"] == 1
+        assert st["rejected_by_head"] == {"tiger": 1}
+        pool = st["kv_pool"]["tiger"]
+        assert pool["slots_active"] == 0 and pool["pages_in_use"] == 0
+        # One-shot escalation: previous handlers restored on first signal.
+        assert signal.getsignal(signal.SIGTERM) == prev_term
+        assert signal.getsignal(signal.SIGINT) == prev_int
     finally:
         eng.stop()
 
