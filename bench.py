@@ -515,24 +515,27 @@ def _serve_bench(model, params, trie, valid_ids, rng, batch: int = SERVE_BATCH,
     retr_ratio = (batch / t_retr_b) / (1.0 / t_retr_1)
 
     # Closed-loop QPS on the TIGER head: 2*batch concurrent submitters.
-    stop = threading.Event()
-    counts = [0] * (2 * batch)
+    def closed_loop(win: float) -> float:
+        stop = threading.Event()
+        counts = [0] * (2 * batch)
 
-    def worker(i: int) -> None:
-        while not stop.is_set():
-            engine.serve(mkreq(), timeout=300)
-            counts[i] += 1
+        def worker(i: int) -> None:
+            while not stop.is_set():
+                engine.serve(mkreq(), timeout=300)
+                counts[i] += 1
 
-    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
-               for i in range(len(counts))]
-    t0 = time.perf_counter()
-    for t in threads:
-        t.start()
-    time.sleep(window_s)
-    stop.set()
-    for t in threads:
-        t.join(300)
-    closed_qps = sum(counts) / (time.perf_counter() - t0)
+        threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+                   for i in range(len(counts))]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        time.sleep(win)
+        stop.set()
+        for t in threads:
+            t.join(300)
+        return sum(counts) / (time.perf_counter() - t0)
+
+    closed_qps = closed_loop(window_s)
 
     # Open-loop: Poisson arrivals at 60% of the closed-loop rate (an
     # underloaded-but-busy operating point), per-request TOTAL latency.
@@ -545,6 +548,23 @@ def _serve_bench(model, params, trie, valid_ids, rng, batch: int = SERVE_BATCH,
         time.sleep(rnd.expovariate(rate))
     lat = sorted(f.result(300).total_s for f in futs)
     pct = lambda q: round(lat[min(len(lat) - 1, int(q * len(lat)))] * 1e3, 2)
+
+    # Obs overhead on the SAME warmed engine, back-to-back half-windows:
+    # tracing-off closed loop vs tracing-on (set_tracer live swap).
+    # Tracing-off is the production default — its <2% instrumentation
+    # budget is asserted deterministically by scripts/check_obs.py; this
+    # measures what turning span tracing ON costs end to end.
+    from genrec_tpu.obs import SpanTracer
+
+    qps_off = closed_loop(window_s / 2)
+    engine.set_tracer(SpanTracer(capacity=16384))
+    qps_on = closed_loop(window_s / 2)
+    engine.set_tracer(None)
+    obs = dict(
+        closed_qps_tracing_off=round(qps_off, 2),
+        closed_qps_tracing_on=round(qps_on, 2),
+        tracing_on_overhead_pct=round(100.0 * (1.0 - qps_on / max(qps_off, 1e-9)), 2),
+    )
 
     stats = engine.stop()
     out = dict(
@@ -565,6 +585,7 @@ def _serve_bench(model, params, trie, valid_ids, rng, batch: int = SERVE_BATCH,
         p95_ms=pct(0.95),
         p99_ms=pct(0.99),
         recompilations_steady=stats["recompilations"],
+        obs=obs,
     )
     # Paged decode vs the dense bucket ladder: concurrent streams at
     # fixed p99 — the headline lever of the ragged paged KV cache.

@@ -90,9 +90,17 @@ def maybe_kill(step: int | None = None, epoch: int | None = None) -> None:
     plan = _ACTIVE
     if plan is None or not _this_process_targeted(plan):
         return
-    if step is not None and plan.kill_at_step == step:
-        os.kill(os.getpid(), plan.kill_signal)
-    if epoch is not None and plan.kill_at_epoch == epoch:
+    fire = (step is not None and plan.kill_at_step == step) or (
+        epoch is not None and plan.kill_at_epoch == epoch
+    )
+    if fire:
+        # Flight-record BEFORE delivery: the handler (or default action)
+        # may end the process, and a chaos post-mortem should show the
+        # injection as its own event ahead of the signal receipt.
+        _flight_record_and_dump(
+            "chaos_kill", reason="chaos_kill",
+            step=step, epoch=epoch, signum=int(plan.kill_signal),
+        )
         os.kill(os.getpid(), plan.kill_signal)
 
 
@@ -105,7 +113,22 @@ def maybe_die_in_save(step: int) -> None:
     if plan is None or not _this_process_targeted(plan):
         return
     if plan.die_in_save_at_step == step:
+        # SIGKILL runs no handlers: this dump is the ONLY post-mortem.
+        _flight_record_and_dump(
+            "chaos_die_in_save", reason="chaos_die_in_save", step=step,
+        )
         os.kill(os.getpid(), signal.SIGKILL)
+
+
+def _flight_record_and_dump(kind: str, reason: str, **fields) -> None:
+    try:
+        from genrec_tpu.obs.flight_recorder import get_flight_recorder
+
+        rec = get_flight_recorder()
+        rec.record(kind, **fields)
+        rec.dump(reason=reason)
+    except Exception:
+        pass  # chaos injection must fire even if the recorder cannot
 
 
 def poison_batches(iterator: Iterable, start_step: int) -> Iterator:

@@ -26,6 +26,15 @@ from genrec_tpu.core import chaos
 logger = logging.getLogger("genrec_tpu")
 
 
+def _flight():
+    """Process flight recorder (obs layer): checkpoint saves, ladder
+    verdicts and quarantines are exactly the events a post-mortem needs
+    in order."""
+    from genrec_tpu.obs.flight_recorder import get_flight_recorder
+
+    return get_flight_recorder()
+
+
 def _per_host_type_handler_registry():
     """Type-handler registry for `CheckpointManager(per_host=True)`:
     the stock numpy/scalar handlers minus their hard-coded
@@ -411,6 +420,8 @@ class CheckpointManager:
         return ocp.args.StandardSave(tree)
 
     def save(self, step: int, state: Any) -> None:
+        _flight().record("checkpoint_save", step=step,
+                         directory=self.directory)
         saved = self._mgr.save(step, args=self._save_args(to_savable(state)))
         # Chaos hook: a host lost MID-SAVE (SIGKILL with the directory
         # write still in flight on the background thread). The
@@ -527,6 +538,8 @@ class CheckpointManager:
         post-mortem artifacts. The losing host of a move race finds the
         source already gone — which is fine, the step is out of
         discovery either way."""
+        _flight().record("checkpoint_quarantine", step=step,
+                         directory=self.directory)
         src = os.path.join(self.directory, str(step))
         qdir = os.path.join(
             self.directory, "quarantine", f"p{jax.process_index()}"
@@ -564,18 +577,25 @@ class CheckpointManager:
                 restored = self.validate_and_restore(state_like, step)
                 if extra_validate is not None:
                     extra_validate(restored, step)
+                _flight().record("integrity_ladder", step=step,
+                                 verdict="valid")
                 return restored, step
             except CheckpointCorruptError as e:
                 logger.warning(
                     f"checkpoint integrity: {e} — quarantining and falling "
                     "back to the previous retained step"
                 )
+                _flight().record("integrity_ladder", step=step,
+                                 verdict="corrupt", error=str(e)[:500])
                 self.quarantine(step)
             except CheckpointMismatchError as e:
                 logger.warning(
                     f"checkpoint integrity: {e} — leaving it on disk and "
                     "falling back to the previous retained step"
                 )
+                _flight().record("integrity_ladder", step=step,
+                                 verdict="mismatch", error=str(e)[:500])
+        _flight().record("integrity_ladder", step=None, verdict="nothing_valid")
         return None, None
 
     def restore_latest_valid_consensus(

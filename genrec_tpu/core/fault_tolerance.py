@@ -289,6 +289,10 @@ class NonFiniteMonitor:
         self.max_consecutive = max_consecutive
         self.logger = logger
         self.dumped: list[str] = []
+        # Skipped-step tally for goodput accounting (obs/goodput.py):
+        # flags are read one step late, so the LOOP cannot count them
+        # without stalling dispatch — the monitor is where they surface.
+        self.skipped_steps = 0
         self._pending: tuple[int, int, dict, Any] | None = None
 
     @classmethod
@@ -314,8 +318,16 @@ class NonFiniteMonitor:
     def _check(self, global_step: int, epoch: int, metrics: dict, batch) -> None:
         if "nonfinite" not in metrics or not float(metrics["nonfinite"]):
             return
+        from genrec_tpu.obs.flight_recorder import get_flight_recorder
+
         streak = int(float(metrics.get("nonfinite_count", 1.0)))
+        self.skipped_steps += 1
         path = self._dump(global_step, epoch, metrics, batch)
+        recorder = get_flight_recorder()
+        recorder.record(
+            "nonfinite_step", step=global_step, epoch=epoch, streak=streak,
+            loss=float(metrics["loss"]), dump=path,
+        )
         if self.logger is not None:
             self.logger.warning(
                 f"non-finite loss/grad at step {global_step} (epoch {epoch}): "
@@ -324,6 +336,11 @@ class NonFiniteMonitor:
                 + (f", batch dumped to {path}" if path else "")
             )
         if streak >= self.max_consecutive:
+            recorder.record(
+                "nonfinite_abort", step=global_step, epoch=epoch,
+                streak=streak, max_consecutive=self.max_consecutive,
+            )
+            recorder.dump(reason="nonfinite_abort")
             raise NonFiniteLossError(
                 f"{streak} consecutive non-finite steps (last: step "
                 f"{global_step}, epoch {epoch})"

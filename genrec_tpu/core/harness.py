@@ -69,8 +69,13 @@ def make_train_step(
         # threefry so checkpoints are backend-portable (see fast_step_rng).
         step_rng = fast_step_rng(step_rng)
 
+        # named_scope: phase labels survive into the compiled HLO/XLA
+        # profile, so a device trace (core.profiling.trace / ProfileWindow)
+        # attributes kernel time to grads vs clip vs optimizer — the
+        # device-side half of the obs layer's host spans.
         if accum_steps == 1:
-            loss, aux, grads = grads_of(state.params, batch, step_rng)
+            with jax.named_scope("grads"):
+                loss, aux, grads = grads_of(state.params, batch, step_rng)
         else:
             def split_micro(x):
                 return x.reshape((accum_steps, x.shape[0] // accum_steps) + x.shape[1:])
@@ -88,22 +93,25 @@ def make_train_step(
             zero_grads = jax.tree_util.tree_map(
                 lambda p: jnp.zeros_like(p, dtype=jnp.float32), state.params
             )
-            (loss_sum, grad_sum), auxes = jax.lax.scan(
-                body, (jnp.zeros((), jnp.float32), zero_grads), (micro, keys)
-            )
+            with jax.named_scope("grads"):
+                (loss_sum, grad_sum), auxes = jax.lax.scan(
+                    body, (jnp.zeros((), jnp.float32), zero_grads), (micro, keys)
+                )
             loss = loss_sum / accum_steps
             grads = jax.tree_util.tree_map(lambda g: g / accum_steps, grad_sum)
             aux = jax.tree_util.tree_map(lambda a: a.mean(axis=0), auxes)
 
-        if clip_norm is not None:
-            gnorm = optax.global_norm(grads)
-            scale = jnp.minimum(1.0, clip_norm / jnp.maximum(gnorm, 1e-6))
-            grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
-        else:
-            gnorm = optax.global_norm(grads)
+        with jax.named_scope("grad_clip"):
+            if clip_norm is not None:
+                gnorm = optax.global_norm(grads)
+                scale = jnp.minimum(1.0, clip_norm / jnp.maximum(gnorm, 1e-6))
+                grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
+            else:
+                gnorm = optax.global_norm(grads)
 
-        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
-        params = optax.apply_updates(state.params, updates)
+        with jax.named_scope("optimizer_update"):
+            updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+            params = optax.apply_updates(state.params, updates)
         metrics = {"loss": loss, "grad_norm": gnorm, **aux}
         if skip_nonfinite:
             # NaN/Inf batch: keep the old params/opt_state/step (the NaN
@@ -111,18 +119,19 @@ def make_train_step(
             # consecutive-skip streak. `where` with a scalar predicate
             # selects whole buffers — on the finite path this is the
             # identity, bit-for-bit.
-            ok = jnp.isfinite(loss) & jnp.isfinite(gnorm)
-            keep = lambda new, old: jax.tree_util.tree_map(
-                lambda n, o: jnp.where(ok, n, o), new, old
-            )
-            params = keep(params, state.params)
-            opt_state = keep(opt_state, state.opt_state)
-            step = state.step + jnp.where(ok, 1, 0).astype(state.step.dtype)
-            nonfinite_count = jnp.where(ok, 0, state.nonfinite_count + 1).astype(
-                state.nonfinite_count.dtype
-            )
-            metrics["nonfinite"] = (~ok).astype(jnp.float32)
-            metrics["nonfinite_count"] = nonfinite_count.astype(jnp.float32)
+            with jax.named_scope("nonfinite_guard"):
+                ok = jnp.isfinite(loss) & jnp.isfinite(gnorm)
+                keep = lambda new, old: jax.tree_util.tree_map(
+                    lambda n, o: jnp.where(ok, n, o), new, old
+                )
+                params = keep(params, state.params)
+                opt_state = keep(opt_state, state.opt_state)
+                step = state.step + jnp.where(ok, 1, 0).astype(state.step.dtype)
+                nonfinite_count = jnp.where(ok, 0, state.nonfinite_count + 1).astype(
+                    state.nonfinite_count.dtype
+                )
+                metrics["nonfinite"] = (~ok).astype(jnp.float32)
+                metrics["nonfinite_count"] = nonfinite_count.astype(jnp.float32)
         else:
             step = state.step + 1
             nonfinite_count = state.nonfinite_count

@@ -15,6 +15,8 @@ import sys
 import time
 from typing import Any, Mapping
 
+from genrec_tpu.obs.flight_recorder import json_safe
+
 
 def setup_logger(save_dir: str | None = None, name: str = "genrec_tpu") -> logging.Logger:
     """Process-wide logger; safe to call once per trainer stage.
@@ -85,6 +87,17 @@ def log_serving_stats(logger, tracker, stats: Mapping[str, Any]) -> None:
         f"recompilations={stats.get('recompilations', 0)} "
         f"step={stats.get('params_step')}"
     )
+    # Admit/evict/OOM-deferral counters are ENGINE totals (the metrics
+    # layer does not attribute them per head): one engine-level line, so
+    # they can never read as belonging to whichever head's pool line they
+    # used to be printed inside.
+    if stats.get("kv_pool"):
+        logger.info(
+            f"serving paged engine totals: admits={stats.get('admits', 0)} "
+            f"evictions={stats.get('evictions', 0)} "
+            f"oom_deferred={stats.get('oom_deferred_admits', 0)} "
+            f"decode_steps={stats.get('decode_steps', 0)}"
+        )
     # Paged decode heads: one pool-pressure line per head (pages + slot
     # occupancy + churn), so an operator sees "pool-bound" vs "idle" at a
     # glance — the day-one gauges the paged KV cache ships with.
@@ -93,9 +106,7 @@ def log_serving_stats(logger, tracker, stats: Mapping[str, Any]) -> None:
             f"serving kv-pool[{head}]: pages {g.get('pages_in_use', 0)}/"
             f"{g.get('pages_in_use', 0) + g.get('pages_free', 0)} in use, "
             f"slots {g.get('slots_active', 0)}/{g.get('slots_total', 0)}, "
-            f"kv_tokens={g.get('kv_tokens_resident', 0)} "
-            f"admits={stats.get('admits', 0)} evictions={stats.get('evictions', 0)} "
-            f"oom_deferred={stats.get('oom_deferred_admits', 0)}"
+            f"kv_tokens={g.get('kv_tokens_resident', 0)}"
         )
 
     def _flatten(prefix: str, tree: Mapping, out: dict) -> None:
@@ -108,6 +119,34 @@ def log_serving_stats(logger, tracker, stats: Mapping[str, Any]) -> None:
     flat: dict[str, Any] = {}
     _flatten("serve/", stats, flat)
     tracker.log(flat)
+
+
+def log_goodput(logger, tracker, epoch: int, report: Mapping[str, Any],
+                fleet: bool = False) -> None:
+    """Per-epoch goodput line + tracker forwarding (obs/goodput.py).
+
+    One operator-readable line (goodput % + the top overhead buckets) and
+    the full bucket breakdown under the ``goodput/`` tracker namespace
+    (``goodput/fleet/`` for the all-host aggregate)."""
+    buckets = report.get("buckets", {})
+    wall = max(float(report.get("wall_s", 0.0)), 1e-9)
+    overheads = sorted(
+        ((k, v) for k, v in buckets.items() if k != "compute" and v > 0),
+        key=lambda kv: -kv[1],
+    )[:3]
+    detail = ", ".join(f"{k} {100 * v / wall:.1f}%" for k, v in overheads)
+    scope = "fleet goodput" if fleet else "goodput"
+    logger.info(
+        f"epoch {epoch} {scope} {report.get('goodput_pct', 0.0):.1f}% "
+        f"of {wall:.1f}s wall" + (f" [{detail}]" if detail else "")
+    )
+    ns = "goodput/fleet" if fleet else "goodput"
+    tracker.log({
+        "epoch": epoch,
+        f"{ns}/pct": float(report.get("goodput_pct", 0.0)),
+        f"{ns}/wall_s": wall,
+        **{f"{ns}/{k}_s": float(v) for k, v in buckets.items()},
+    })
 
 
 class Tracker:
@@ -144,7 +183,15 @@ class Tracker:
     def log(self, metrics: Mapping[str, Any]) -> None:
         payload = {k: (float(v) if hasattr(v, "__float__") else v) for k, v in metrics.items()}
         if self._file:
-            self._file.write(json.dumps({"t": time.time(), **payload}) + "\n")
+            # json.dumps writes bare NaN/Infinity tokens for non-finite
+            # floats — NOT valid JSON, so one diverging loss would make
+            # metrics.jsonl unreadable to any strict parser. Serialize
+            # them as null (json_safe, shared with the flight recorder;
+            # fallback_repr=False keeps dumps raising on genuinely
+            # unserializable values); allow_nan=False is the backstop
+            # that keeps this a hard guarantee rather than a best effort.
+            line = json_safe({"t": time.time(), **payload}, fallback_repr=False)
+            self._file.write(json.dumps(line, allow_nan=False) + "\n")
             self._file.flush()
         if self._wandb:
             self._wandb.log(payload)

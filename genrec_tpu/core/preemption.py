@@ -67,6 +67,19 @@ class PreemptionGuard:
             )
         self._fired.set()
         self.close()  # one-shot: next signal falls through
+        # Flight recorder: the signal is the event every post-mortem
+        # starts from, so record + dump NOW — the grace window may not
+        # reach another dump point. Python runs handlers in the main
+        # bytecode loop, so the file write here is ordinary code.
+        try:
+            from genrec_tpu.obs.flight_recorder import get_flight_recorder
+
+            rec = get_flight_recorder()
+            name = signal.Signals(signum).name
+            rec.record("signal", signum=int(signum), name=name)
+            rec.dump(reason=f"signal:{name}")
+        except Exception:
+            pass  # the latch must survive any recorder failure
 
     @property
     def fired(self) -> bool:

@@ -5,6 +5,12 @@ bars only). Here profiling is a first-class utility: `trace()` wraps
 jax.profiler (TensorBoard-viewable XLA traces incl. per-kernel timing),
 `StepTimer` gives steps/sec + seq/sec with compile-step exclusion, and
 `annotate` names regions inside traces.
+
+Host-side span tracing, goodput accounting, and the crash flight
+recorder live in `genrec_tpu/obs` (docs/OBSERVABILITY.md); a device
+profile captured here lines up with those host spans via
+`SpanTracer(bridge_jax=True)` and the named_scope phase labels in
+core/harness.py and ops/trie.py.
 """
 
 from __future__ import annotations

@@ -1,0 +1,45 @@
+"""Unified observability layer: spans, goodput, flight recorder, export.
+
+The substrate the fleet-scale roadmap items (disaggregated multi-host
+serving, streaming-training -> hot-serving) sit on:
+
+- `spans`           — request/step-scoped tracer, Chrome-trace export,
+                      jax.profiler bridging
+- `goodput`         — training wall-time classified into buckets,
+                      fleet-wide aggregation, XLA compile-event tap
+- `flight_recorder` — bounded structured-event ring dumped atomically on
+                      SIGTERM / crash / chaos kill points
+- `export`          — Prometheus-style text exposition of any snapshot
+
+Layering: `obs` imports nothing from core/trainers/serving (jax only,
+lazily), so every layer above may use it freely.
+"""
+
+from genrec_tpu.obs.export import prometheus_text, write_prometheus
+from genrec_tpu.obs.flight_recorder import (
+    FlightRecorder,
+    get_flight_recorder,
+    json_safe,
+)
+from genrec_tpu.obs.goodput import (
+    BUCKETS,
+    CompileEvents,
+    GoodputMeter,
+    fleet_goodput,
+)
+from genrec_tpu.obs.spans import NULL_TRACER, Span, SpanTracer
+
+__all__ = [
+    "BUCKETS",
+    "CompileEvents",
+    "FlightRecorder",
+    "GoodputMeter",
+    "NULL_TRACER",
+    "Span",
+    "SpanTracer",
+    "fleet_goodput",
+    "get_flight_recorder",
+    "json_safe",
+    "prometheus_text",
+    "write_prometheus",
+]

@@ -1,0 +1,81 @@
+"""Prometheus-style text exposition of a metrics snapshot.
+
+The engine's `stats()` and the goodput reports are nested dicts; wandb /
+metrics.jsonl consumers flatten them already (`core.logging`), but a
+fleet scrape wants the OpenMetrics text format. `prometheus_text` turns
+any nested numeric mapping into exposition lines:
+
+    serve/total_ms/p99 -> genrec_serve_total_ms_p99
+
+Counters (monotonic lifetime totals — the engine's request/admit/compile
+counts) get ``# TYPE ... counter``; everything else is a gauge. No
+client library, no HTTP server: serving a scrape endpoint is one
+`write_prometheus` per stats interval plus any static file server, which
+is exactly what a sidecar-less TPU host can afford.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import re
+from typing import Any, Mapping
+
+#: Leaf names that are monotonic lifetime totals in the engine /
+#: goodput snapshots. Matched against the FINAL path component.
+_COUNTER_LEAVES = frozenset({
+    "submitted", "completed", "rejected", "failed", "batches",
+    "warmup_compiles", "recompilations", "params_swaps", "admits",
+    "evictions", "oom_deferred_admits", "decode_steps", "count", "steps",
+})
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _flatten(prefix: str, tree: Mapping, out: dict) -> None:
+    for k, v in tree.items():
+        key = f"{prefix}/{k}" if prefix else str(k)
+        if isinstance(v, Mapping):
+            _flatten(key, v, out)
+        elif isinstance(v, bool):
+            out[key] = 1.0 if v else 0.0
+        elif isinstance(v, (int, float)):
+            out[key] = float(v)
+
+
+def _metric_name(path: str, namespace: str) -> str:
+    name = _NAME_RE.sub("_", f"{namespace}_{path.replace('/', '_')}")
+    if name and name[0].isdigit():
+        name = f"_{name}"
+    return name
+
+
+def prometheus_text(snapshot: Mapping[str, Any], namespace: str = "genrec") -> str:
+    """Exposition text for a nested numeric snapshot. Non-numeric leaves
+    are skipped; non-finite values are skipped (Prometheus accepts NaN
+    but a scraped NaN gauge only poisons dashboards)."""
+    flat: dict[str, float] = {}
+    _flatten("", snapshot, flat)
+    lines: list[str] = []
+    for path in sorted(flat):
+        value = flat[path]
+        if not math.isfinite(value):
+            continue
+        name = _metric_name(path, namespace)
+        kind = "counter" if path.rsplit("/", 1)[-1] in _COUNTER_LEAVES else "gauge"
+        lines.append(f"# TYPE {name} {kind}")
+        text = repr(int(value)) if value == int(value) else repr(value)
+        lines.append(f"{name} {text}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def write_prometheus(path: str, snapshot: Mapping[str, Any],
+                     namespace: str = "genrec") -> str:
+    """Atomic write of the exposition text (a static-file scrape target)."""
+    text = prometheus_text(snapshot, namespace)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(tmp, "w") as fh:
+        fh.write(text)
+    os.replace(tmp, path)
+    return path

@@ -53,7 +53,8 @@ class DenseTrie:
 
     def legal_mask(self, prefix_idx: jax.Array, step: int) -> jax.Array:
         """prefix_idx: (...,) packed base-K prefixes -> (..., K) bool."""
-        return self.tables[step][prefix_idx]
+        with jax.named_scope("trie_legal_mask"):
+            return self.tables[step][prefix_idx]
 
     def advance(self, prefix_idx: jax.Array, token: jax.Array, step: int) -> jax.Array:
         """Prefix id after consuming ``token`` at ``step`` (base-K packing;
@@ -97,11 +98,12 @@ class PackedTrie:
         return cls(keys, K)
 
     def legal_mask(self, prefix_idx: jax.Array, step: int) -> jax.Array:
-        K = self.codebook_size
-        cand = prefix_idx[..., None] * K + jnp.arange(K)  # (..., K)
-        keys = self.step_keys[step]
-        pos = jnp.clip(jnp.searchsorted(keys, cand), 0, keys.shape[0] - 1)
-        return keys[pos] == cand
+        with jax.named_scope("trie_legal_mask"):
+            K = self.codebook_size
+            cand = prefix_idx[..., None] * K + jnp.arange(K)  # (..., K)
+            keys = self.step_keys[step]
+            pos = jnp.clip(jnp.searchsorted(keys, cand), 0, keys.shape[0] - 1)
+            return keys[pos] == cand
 
     def advance(self, prefix_idx: jax.Array, token: jax.Array, step: int) -> jax.Array:
         """Rank of the extended prefix among step ``step``'s valid prefixes;
@@ -133,27 +135,32 @@ def legal_mask_ragged(trie, prefix_idx: jax.Array, steps: jax.Array) -> jax.Arra
     table (jax gathers clamp out-of-range indices) — garbage, but never
     selected.
     """
-    sel_shape = steps.shape + (1,) * prefix_idx.ndim  # broadcast over rows
-    out = None
-    for t in range(trie.depth):
-        mask_t = trie.legal_mask(_clip_prefix(trie, prefix_idx, t), t)
-        out = mask_t if out is None else jnp.where(
-            (steps == t).reshape(sel_shape), mask_t, out
-        )
-    return out
+    # named_scope: trie-masking ops group under one label in XLA profiler
+    # traces, so host-side decode spans (obs/spans.py) line up with the
+    # kernel time the constraint actually costs.
+    with jax.named_scope("trie_legal_mask_ragged"):
+        sel_shape = steps.shape + (1,) * prefix_idx.ndim  # broadcast over rows
+        out = None
+        for t in range(trie.depth):
+            mask_t = trie.legal_mask(_clip_prefix(trie, prefix_idx, t), t)
+            out = mask_t if out is None else jnp.where(
+                (steps == t).reshape(sel_shape), mask_t, out
+            )
+        return out
 
 
 def advance_ragged(trie, prefix_idx: jax.Array, token: jax.Array,
                    steps: jax.Array) -> jax.Array:
     """`trie.advance` with a per-row step operand (see legal_mask_ragged)."""
-    sel_shape = steps.shape + (1,) * (prefix_idx.ndim - 1)
-    out = None
-    for t in range(trie.depth):
-        adv_t = trie.advance(_clip_prefix(trie, prefix_idx, t), token, t)
-        out = adv_t if out is None else jnp.where(
-            (steps == t).reshape(sel_shape), adv_t, out
-        )
-    return out
+    with jax.named_scope("trie_advance_ragged"):
+        sel_shape = steps.shape + (1,) * (prefix_idx.ndim - 1)
+        out = None
+        for t in range(trie.depth):
+            adv_t = trie.advance(_clip_prefix(trie, prefix_idx, t), token, t)
+            out = adv_t if out is None else jnp.where(
+                (steps == t).reshape(sel_shape), adv_t, out
+            )
+        return out
 
 
 def _clip_prefix(trie, prefix_idx, step: int):

@@ -43,6 +43,15 @@ Compiled executables are AOT (`jax.jit(fn).lower(...).compile()`), so a
 shape drifting out of the bucket grid raises loudly instead of silently
 recompiling; the params swap keeps avals identical (same tree, same
 shapes/dtypes), which `_check_like` verifies before staging.
+
+Observability (genrec_tpu/obs, docs/OBSERVABILITY.md): with a tracer
+attached (``tracer=`` or ``set_tracer`` live) every request carries a
+span tree — request -> queue_wait -> admission/prefill/per-decode_step
+(paged) or compute (dense) -> finalize — keyed by the request ID minted
+at submit() (`Response.request_id`), with p99-outlier exemplars
+persisted past ring eviction. Tracing is off by default (one attribute
+check per site; budget pinned <2% by scripts/check_obs.py). The flight
+recorder gets lifecycle/drain/hot-reload/OOM-deferral events regardless.
 """
 
 from __future__ import annotations
@@ -59,6 +68,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from genrec_tpu.core import chaos
+from genrec_tpu.obs.flight_recorder import get_flight_recorder
+from genrec_tpu.obs.spans import NULL_TRACER, SpanTracer
 from genrec_tpu.serving.buckets import BucketLadder, default_ladder
 from genrec_tpu.serving.kv_pool import KVPagePool, PagedConfig, PoolExhausted
 from genrec_tpu.serving.metrics import ServingMetrics
@@ -114,7 +125,9 @@ class _PagedRunner:
         self.state = head.paged_state_zeros(cfg.max_slots)
         self.steps = np.zeros(cfg.max_slots, np.int32)
         self.active = np.zeros(cfg.max_slots, bool)
-        self.entries: list = [None] * cfg.max_slots  # (req, fut, t_enq, t_admit)
+        # (req, fut, t_enq, trace_ctx, t_admit); trace_ctx is the
+        # (trace_id, root_span_id) minted at submit(), or None (tracing off).
+        self.entries: list = [None] * cfg.max_slots
         self.buckets: list = [None] * cfg.max_slots  # prefill (B, L) per slot
         # The collapsed decode-side ladder: a handful of slot-count
         # shapes (max_slots halving down to max_batch). Slots fill
@@ -237,15 +250,19 @@ class _PagedRunner:
                 if fresh:  # count each request's deferral ONCE, not per retry
                     self._oom_counted.update(id(e[1]) for e in fresh)
                     eng.metrics.record_oom_admit(len(fresh))
+                    eng._flight.record(
+                        "pool_oom_deferred", head=self.head.name,
+                        n=len(fresh), pages_free=self.pool.stats().get("pages_free"),
+                    )
             if admitted:
                 self._oom_counted.difference_update(id(e[1]) for e in admitted)
                 try:
-                    self._run_prefill(admitted, slots, L)
+                    self._run_prefill(admitted, slots, L, t_pop=now)
                 except Exception as e:  # noqa: BLE001 — fail THESE futures only
                     eng._log.exception(
                         f"serving: paged prefill on head {self.head.name} failed"
                     )
-                    for slot, (_req, fut, _t) in zip(slots, admitted):
+                    for slot, (_req, fut, _t, _tr) in zip(slots, admitted):
                         self.pool.evict(slot)
                         # Undo any slot bookkeeping a partial prefill set,
                         # or step() would decode an entry-less slot.
@@ -259,7 +276,8 @@ class _PagedRunner:
             if leftover:
                 return progressed
 
-    def _run_prefill(self, entries, slots, L: int) -> None:
+    def _run_prefill(self, entries, slots, L: int,
+                     t_pop: float | None = None) -> None:
         eng = self.engine
         head = self.head
         t_admit = time.monotonic()
@@ -281,11 +299,24 @@ class _PagedRunner:
             self.state[key][slots] = 0
         for key, val in init.items():
             self.state[key][slots] = np.asarray(val)[:n]
+        t_prefilled = time.monotonic()
         self.steps[slots] = head.paged_init_step
         self.active[slots] = True
         for e, slot in zip(entries, slots):
             self.entries[slot] = (*e, t_admit)
             self.buckets[slot] = (B, L)
+            tr = e[3]
+            if tr is not None:
+                # queue_wait: submit -> popped; admission: slot+page
+                # grab; prefill: the compiled bucket call + state write.
+                tid, root = tr
+                tracer = eng._tracer
+                t0 = t_pop if t_pop is not None else t_admit
+                tracer.record_span("queue_wait", tid, e[2], t0, parent_id=root)
+                tracer.record_span("admission", tid, t0, t_admit,
+                                   parent_id=root, slot=int(slot))
+                tracer.record_span("prefill", tid, t_admit, t_prefilled,
+                                   parent_id=root, bucket_b=B, bucket_l=L)
         eng.metrics.record_admit(n)
         eng.metrics.record_batch(head.name, (B, L))
         self._sweep_finished()  # heads whose init step == total finish here
@@ -304,6 +335,7 @@ class _PagedRunner:
         # (slots fill lowest-first, so this tracks the active count).
         hi = int(np.nonzero(self.active)[0][-1]) + 1
         S = next(s for s in self.slot_shapes if s >= hi)
+        t0 = time.monotonic()
         out = self._decode[S](
             eng._select(self.head, eng._params),
             {k: jnp.asarray(v[:S]) for k, v in self.state.items()},
@@ -315,6 +347,18 @@ class _PagedRunner:
         )
         for k, v in out.items():  # write back into the host rows
             self.state[k][:S] = np.asarray(v)
+        if eng._tracer.enabled:
+            # One fixed-shape step advances EVERY active slot: each
+            # resident request gets the same decode_step interval, tagged
+            # with its own position so the span tree reads per-request.
+            t1 = time.monotonic()
+            for slot in np.nonzero(self.active)[0]:
+                tr = self.entries[slot][3]
+                if tr is not None:
+                    eng._tracer.record_span(
+                        "decode_step", tr[0], t0, t1, parent_id=tr[1],
+                        step=int(self.steps[slot]), slots=S,
+                    )
         self.steps[self.active] += 1
         eng.metrics.record_decode_step()
         self._sweep_finished()
@@ -330,12 +374,13 @@ class _PagedRunner:
         done = np.nonzero(self.active & (self.steps >= total))[0]
         step_id = eng._step
         for slot in done:
-            req, fut, t_enq, t_admit = self.entries[slot]
-            now = time.monotonic()
+            req, fut, t_enq, tr, t_admit = self.entries[slot]
+            t_done = time.monotonic()
             try:
                 payload = head.paged_finalize(
                     {k: v[slot] for k, v in self.state.items()}, req
                 )
+                now = time.monotonic()
                 resp = Response(
                     head=head.name,
                     items=payload["items"],
@@ -346,6 +391,7 @@ class _PagedRunner:
                     queue_wait_s=t_admit - t_enq,
                     compute_s=now - t_admit,
                     total_s=now - t_enq,
+                    request_id=tr[0] if tr is not None else None,
                 )
             except Exception as e:  # noqa: BLE001 — one bad slot, not the loop
                 eng._log.exception(
@@ -358,6 +404,17 @@ class _PagedRunner:
                 eng.metrics.record_response(
                     resp.queue_wait_s, resp.compute_s, resp.total_s
                 )
+                if tr is not None:
+                    tid, root = tr
+                    eng._tracer.record_span(
+                        "finalize", tid, t_done, now, parent_id=root
+                    )
+                    eng._tracer.record_span(
+                        "request", tid, t_enq, now, span_id=root,
+                        head=head.name, slot=int(slot),
+                        params_step=step_id,
+                    )
+                    eng._maybe_exemplar(tid, resp)
                 if not fut.done():
                     fut.set_result(resp)
             self.pool.evict(int(slot))
@@ -386,6 +443,7 @@ class ServingEngine:
         logger: Optional[logging.Logger] = None,
         paged: bool = True,
         paged_config: Optional[PagedConfig] = None,
+        tracer: Optional[SpanTracer] = None,
     ):
         self._heads = {h.name: h for h in heads}
         if len(self._heads) != len(heads):
@@ -422,6 +480,11 @@ class ServingEngine:
         self._handle_signals = handle_signals
         self._guard = guard
         self._log = logger or logging.getLogger("genrec_tpu")
+        # Request tracing is opt-in (pass an enabled SpanTracer); the
+        # default NULL_TRACER keeps every hot-path check to one attribute
+        # read. The flight recorder is always on (bounded ring).
+        self._tracer = tracer if tracer is not None else NULL_TRACER
+        self._flight = get_flight_recorder()
 
         self.metrics = ServingMetrics()
         self._exec: dict[tuple[str, int, int], object] = {}
@@ -470,6 +533,11 @@ class ServingEngine:
             target=self._batch_loop, name="serving-batcher", daemon=True
         )
         self._started = True
+        self._flight.record(
+            "serving_started", heads=sorted(self._heads),
+            paged_heads=sorted(self._runners),
+            warmup_compiles=self.metrics.warmup_compiles,
+        )
         self._batcher.start()
         return self
 
@@ -515,6 +583,7 @@ class ServingEngine:
         with self._lock:
             self._draining = True
             self._work.notify_all()
+        self._flight.record("serving_stop", completed=self.metrics.completed)
         self._stop_watch.set()
         if self._batcher is not None:
             self._batcher.join(timeout)
@@ -540,6 +609,29 @@ class ServingEngine:
     def params_step(self) -> Optional[int]:
         return self._step
 
+    @property
+    def tracer(self) -> SpanTracer:
+        return self._tracer
+
+    def set_tracer(self, tracer: Optional[SpanTracer]) -> None:
+        """Swap the tracer LIVE (turn tracing on/off against a running
+        engine — no recompile, no restart). Requests submitted before the
+        swap keep the trace context minted at their submit; every record
+        site guards on that per-entry context, so mixing is safe."""
+        self._tracer = tracer if tracer is not None else NULL_TRACER
+
+    def _maybe_exemplar(self, trace_id: str, resp: Response) -> None:
+        """Slow-request exemplars: a p99-outlier request persists its full
+        span tree past ring eviction, so the trace export always holds a
+        worked example of 'why was the tail slow'."""
+        thr = self.metrics.slow_threshold_s()
+        if thr is not None and resp.total_s >= thr:
+            self._tracer.mark_exemplar(
+                trace_id,
+                reason=f"p99 outlier: total {resp.total_s * 1e3:.1f}ms "
+                       f">= {thr * 1e3:.1f}ms ({resp.head})",
+            )
+
     def stats(self) -> dict:
         snap = self.metrics.snapshot()
         snap["params_step"] = self._step
@@ -564,7 +656,14 @@ class ServingEngine:
                     "engine is draining (shutdown signal received); "
                     "request rejected — fail over to another replica"
                 )
-            entry = (req, Future(), time.monotonic())
+            # Trace context minted AT submit: (request/trace id, pre-
+            # allocated root span id) so spans recorded before the root
+            # completes can already parent onto it.
+            tr = (
+                (self._tracer.new_trace(), self._tracer.allocate_span_id())
+                if self._tracer.enabled else None
+            )
+            entry = (req, Future(), time.monotonic(), tr)
             self._queues[req.head].append(entry)
             self._work.notify()
         self.metrics.record_submit()
@@ -587,6 +686,8 @@ class ServingEngine:
                     ):
                         with self._lock:
                             self._draining = True
+                        self._flight.record("serving_drain_started",
+                                            cause="signal")
                         self._log.warning(
                             "serving: shutdown signal latched — draining "
                             "in-flight requests, rejecting new submissions"
@@ -667,9 +768,10 @@ class ServingEngine:
             out = jax.tree_util.tree_map(np.asarray, out)  # host sync
             t_done = time.monotonic()
             payloads = head.finalize(out, reqs)
+            t_final = time.monotonic()
         except Exception as e:  # noqa: BLE001 — a bad batch must not kill the loop
             self._log.exception(f"serving: micro-batch on head {head.name} failed")
-            for _, fut, _t in entries:
+            for _, fut, _t, _tr in entries:
                 if not fut.done():
                     fut.set_exception(e)
             self.metrics.record_failure(len(entries))
@@ -680,7 +782,7 @@ class ServingEngine:
         # fires SIGTERM mid-load exactly like a preemption would.
         chaos.maybe_kill(step=self.metrics.batches)
         step = self._step
-        for (req, fut, t_enq), payload in zip(entries, payloads):
+        for (req, fut, t_enq, tr), payload in zip(entries, payloads):
             now = time.monotonic()
             resp = Response(
                 head=head.name,
@@ -692,10 +794,26 @@ class ServingEngine:
                 queue_wait_s=t_start - t_enq,
                 compute_s=t_done - t_start,
                 total_s=now - t_enq,
+                request_id=tr[0] if tr is not None else None,
             )
             self.metrics.record_response(
                 resp.queue_wait_s, resp.compute_s, resp.total_s
             )
+            if tr is not None:
+                # Dense whole-batch span tree: queue -> compute (the
+                # shared executable call, host sync included) -> finalize.
+                tid, root = tr
+                self._tracer.record_span("queue_wait", tid, t_enq, t_start,
+                                         parent_id=root)
+                self._tracer.record_span("compute", tid, t_start, t_done,
+                                         parent_id=root, bucket_b=B, bucket_l=L)
+                self._tracer.record_span("finalize", tid, t_done, t_final,
+                                         parent_id=root)
+                self._tracer.record_span(
+                    "request", tid, t_enq, now, span_id=root,
+                    head=head.name, params_step=step,
+                )
+                self._maybe_exemplar(tid, resp)
             if not fut.done():  # a cancelled Future must not kill the loop
                 fut.set_result(resp)
 
@@ -745,6 +863,7 @@ class ServingEngine:
         self._check_like(restored)
         with self._lock:
             self._pending_params = (restored, step)
+        self._flight.record("hot_reload_staged", step=step)
         self._log.info(f"serving: staged hot reload to checkpoint step {step}")
 
     def _check_like(self, restored) -> None:
@@ -782,6 +901,7 @@ class ServingEngine:
         self._params = restored
         self._step = step
         self.metrics.record_swap()
+        self._flight.record("hot_reload_swapped", step=step)
         for head in self._heads.values():
             head.on_params(self._select(head, restored))
         self._log.info(f"serving: now serving checkpoint step {step}")

@@ -164,6 +164,16 @@ class ServingMetrics:
             self.completed += 1
             self._recent.append(now)
 
+    def slow_threshold_s(self, q: float = 0.99, min_count: int = 64) -> float | None:
+        """Latency above which a request counts as a slow outlier (the
+        total-latency q-quantile), or None until ``min_count`` responses
+        have been recorded — an empty histogram's p99 is 0, which would
+        flag EVERY early request as an exemplar."""
+        with self._lock:
+            if self.total.count < min_count:
+                return None
+            return self.total.percentile(q)
+
     def qps(self) -> float:
         """Lifetime QPS since warmup finished."""
         with self._lock:

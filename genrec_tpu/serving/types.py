@@ -57,3 +57,7 @@ class Response:
     queue_wait_s: float
     compute_s: float
     total_s: float
+    # Request/trace ID minted at submit() when the engine has a tracer:
+    # the key into the span tree (obs/spans.py) for this request. None
+    # when tracing is off (the default).
+    request_id: Optional[str] = None

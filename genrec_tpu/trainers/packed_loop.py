@@ -40,11 +40,23 @@ The epoch-granularity trainers plug in through three knobs:
 ``step_log`` (trainer-specific wandb metric dicts), and ``step_hook`` +
 ``run_epoch(max_steps=...)`` (rqvae's iteration-gated eval/save cadence
 and iteration-count stop).
+
+Observability (genrec_tpu/obs, landing here once for all seven
+trainers): every epoch's wall time is classified into goodput buckets
+(compute / compile / checkpoint-save / restore / data-wait /
+nonfinite-skipped / preemption-drain / other) and reported per epoch —
+fleet-aggregated on multi-host; XLA compile events are tapped during
+step dispatch so an unexpected mid-run recompile is counted and logged
+the step it happens; and the crash flight recorder is pointed at
+``<save_dir_root>/flight_recorder.json`` so a SIGTERM'd or crashed run
+leaves a structured post-mortem. See docs/OBSERVABILITY.md.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import os
+import time
 from typing import Any, Callable
 
 import jax
@@ -56,9 +68,12 @@ from genrec_tpu.core.fault_tolerance import (
     resume_exact,
     save_resume_point,
 )
-from genrec_tpu.core.logging import log_occupancy
+from genrec_tpu.core.logging import log_goodput, log_occupancy
 from genrec_tpu.core.profiling import StepTimer, log_epoch_perf
 from genrec_tpu.data.batching import batch_iterator, prefetch_to_device
+from genrec_tpu.obs.flight_recorder import get_flight_recorder
+from genrec_tpu.obs.goodput import CompileEvents, GoodputMeter, fleet_goodput
+from genrec_tpu.obs.spans import NULL_TRACER
 
 
 @dataclasses.dataclass
@@ -109,6 +124,7 @@ class PackedTrainLoop:
         step_log: Callable[[dict, int], dict] | None = None,
         step_hook: Callable[[Any, int, int, int], None] | None = None,
         preempt_poll_interval: int = 8,
+        tracer=None,
     ):
         if pack_sequences and repack is None:
             raise ValueError("pack_sequences=True needs a repack closure")
@@ -134,6 +150,22 @@ class PackedTrainLoop:
         self.monitor = NonFiniteMonitor.for_run(
             save_dir_root, logger, max_consecutive_nonfinite
         )
+        # Observability (genrec_tpu/obs): goodput buckets per epoch, the
+        # process-wide XLA compile tap (unexpected mid-run recompiles are
+        # counted + logged the step they happen), optional span tracing,
+        # and the crash flight recorder pointed at the run directory.
+        self.goodput = GoodputMeter()
+        self.tracer = tracer if tracer is not None else NULL_TRACER
+        self.recompiles = 0
+        self._compile_events = CompileEvents.ensure()
+        self._steps_run = 0
+        self._in_preempt = False
+        self._flight = get_flight_recorder()
+        if save_dir_root:
+            self._flight.configure(
+                os.path.join(save_dir_root, "flight_recorder.json"),
+                run_dir=save_dir_root,
+            )
         self._ran_epoch = False
         self._arrays = train_arrays
         self._arrays_epoch: int | None = None
@@ -195,27 +227,60 @@ class PackedTrainLoop:
 
         return any_across_processes(self.guard.fired)
 
+    def _note_compile(self, n: int, seconds: float, global_step: int) -> None:
+        """Compile events observed during step dispatch. The run's FIRST
+        step compiles by design; any later one is an unexpected mid-run
+        recompile (shape drift, donation mismatch, cache eviction) —
+        counted, logged at warning, and flight-recorded, the same
+        discipline serving gets from check_serving_hlo."""
+        if self._steps_run == 0:
+            self.logger.info(
+                f"step {global_step}: compiled train step "
+                f"({n} XLA compile(s), {seconds:.1f}s)"
+            )
+            return
+        self.recompiles += n
+        self.logger.warning(
+            f"step {global_step}: UNEXPECTED mid-run XLA recompile "
+            f"({n} compile(s), {seconds:.2f}s; {self.recompiles} total this "
+            "run) — a static shape or donation contract broke"
+        )
+        self._flight.record("recompile", step=global_step, n=n,
+                            seconds=seconds)
+        self.tracker.log({
+            "global_step": global_step, "perf/recompiles": self.recompiles,
+        })
+
     # -- resume + checkpoint -----------------------------------------------
 
     def resume(self, state_like, place_fn=None) -> tuple[Any, int, int, int]:
         """(state, start_epoch, start_batch, global_step) — exact cursor
         via the integrity ladder, or fresh-start values."""
-        point = resume_exact(
-            self.ckpt, state_like, place_fn,
-            data_seed=self.seed, logger=self.logger,
-        )
+        with self.goodput.measure("restore"):
+            point = resume_exact(
+                self.ckpt, state_like, place_fn,
+                data_seed=self.seed, logger=self.logger,
+            )
         if point is None:
             return state_like, 0, 0, 0
+        self._flight.record(
+            "resume", epoch=point.epoch, next_batch=point.next_batch,
+            global_step=point.global_step,
+        )
         return point.state, point.epoch, point.next_batch, point.global_step
 
     def save(self, state, *, epoch: int, next_batch: int, global_step: int,
              wait: bool = False) -> None:
         """Write a resume point (no-op without a checkpoint manager)."""
         if self.ckpt is not None:
-            save_resume_point(
-                self.ckpt, state, epoch=epoch, next_batch=next_batch,
-                global_step=global_step, data_seed=self.seed, wait=wait,
-            )
+            # Goodput: a preemption save is drain work, not the periodic
+            # checkpoint cadence — classify by WHY it is being written.
+            bucket = "preemption_drain" if self._in_preempt else "checkpoint_save"
+            with self.goodput.measure(bucket):
+                save_resume_point(
+                    self.ckpt, state, epoch=epoch, next_batch=next_batch,
+                    global_step=global_step, data_seed=self.seed, wait=wait,
+                )
 
     def shutdown(self, preempted_epoch: int | None = None) -> None:
         """Close everything the loop owns (ckpt manager joins in-flight
@@ -227,7 +292,17 @@ class PackedTrainLoop:
         if self.guard is not None:
             self.guard.close()
         self.prof.close()
+        run = self.goodput.run_report()
+        if run["wall_s"] > 0 and self._steps_run:
+            self.logger.info(
+                f"run goodput {run['goodput_pct']:.1f}% over "
+                f"{run['wall_s']:.1f}s wall (see goodput/* metrics)"
+            )
         self.tracker.finish()
+        self._flight.record(
+            "run_shutdown", preempted_epoch=preempted_epoch,
+            steps_run=self._steps_run, recompiles=self.recompiles,
+        )
         if preempted_epoch is not None:
             self.logger.info(
                 f"preempted: exiting during epoch {preempted_epoch}"
@@ -239,13 +314,24 @@ class PackedTrainLoop:
         # non-finite streak must still leave a resume point — the streak
         # itself is inside the saved state (nonfinite_count), so the
         # resumed run keeps counting toward the threshold.
-        self.save(state, epoch=epoch, next_batch=next_batch,
-                  global_step=global_step, wait=True)
-        self.logger.info(
-            f"preempted: resume point at epoch {epoch} batch {next_batch} "
-            f"(global step {global_step})"
-        )
-        self.monitor.flush()
+        self._flight.record("preempt", epoch=epoch, next_batch=next_batch,
+                            global_step=global_step)
+        self._in_preempt = True
+        try:
+            self.save(state, epoch=epoch, next_batch=next_batch,
+                      global_step=global_step, wait=True)
+            self.logger.info(
+                f"preempted: resume point at epoch {epoch} batch {next_batch} "
+                f"(global step {global_step})"
+            )
+            with self.goodput.measure("preemption_drain"):
+                self.monitor.flush()
+        finally:
+            self._in_preempt = False
+            # The dump the PreemptionGuard wrote at signal receipt
+            # predates the resume point; re-dump so the post-mortem's
+            # last events show the drain completing.
+            self._flight.dump(reason="preemption_drain")
 
     # -- the epoch ---------------------------------------------------------
 
@@ -268,9 +354,12 @@ class PackedTrainLoop:
             skip_first=0 if self._ran_epoch else 1,
         )
         self._ran_epoch = True
+        self._flight.record("epoch_start", epoch=epoch,
+                            global_step=global_step, start_batch=start_batch)
+        skipped_before = self.monitor.skipped_steps
         epoch_loss, epoch_tokens, n_batches = None, None, 0
         consumed = start_batch
-        for sharded, _ in prefetch_to_device(
+        batches = iter(prefetch_to_device(
             chaos.poison_batches(
                 batch_iterator(
                     arrays, self.rows_per_step, shuffle=True, seed=self.seed,
@@ -279,10 +368,23 @@ class PackedTrainLoop:
                 start_step=global_step,
             ),
             self.mesh,
-        ):
+        ))
+        while True:
+            # Goodput: time blocked on the input pipeline (data_wait) is
+            # measured apart from the step section, whose residual after
+            # compile/skipped attribution is the compute bucket.
+            t_wait = time.perf_counter()
+            try:
+                sharded, _ = next(batches)
+            except StopIteration:
+                break
+            self.goodput.add("data_wait", time.perf_counter() - t_wait)
             if max_steps is not None and global_step >= max_steps:
                 break
+            t_step = time.perf_counter()
+            c_n0, c_s0 = self._compile_events.snapshot()
             state, m = step_fn(state, sharded)
+            c_n1, c_s1 = self._compile_events.snapshot()
             # Guard-skipped steps contribute 0 to the epoch mean — one
             # NaN batch must not turn the whole epoch summary NaN (NaN*0
             # is still NaN, so select, don't scale; the per-step wandb
@@ -299,6 +401,8 @@ class PackedTrainLoop:
             consumed += 1
             global_step += 1
             self.prof.tick(global_step)
+            if c_n1 > c_n0:
+                self._note_compile(c_n1 - c_n0, c_s1 - c_s0, global_step)
             if global_step % self.wandb_log_interval == 0:
                 self.tracker.log(
                     self.step_log(m, global_step)
@@ -308,6 +412,20 @@ class PackedTrainLoop:
                 )
             # Deferred non-finite policy: checks the PREVIOUS step's flag.
             self.monitor.observe(global_step, epoch, m, sharded)
+            # Step section closes here: observe() synced on the previous
+            # step's device scalar, so this interval really holds device
+            # compute. step_hook (rqvae's iteration-gated eval/save) and
+            # the preemption poll land in `other`.
+            t_done = time.perf_counter()
+            self.goodput.note_step(t_done - t_step,
+                                   compile_seconds=c_s1 - c_s0)
+            self._steps_run += 1
+            self._flight.record("step", step=global_step, epoch=epoch)
+            if self.tracer.enabled:
+                self.tracer.record_span(
+                    "train_step", f"train-e{epoch}", t_step, t_done,
+                    step=global_step,
+                )
             if self.step_hook is not None:
                 self.step_hook(state, epoch, consumed, global_step)
             chaos.maybe_kill(step=global_step)
@@ -336,4 +454,17 @@ class PackedTrainLoop:
                     self.logger, self.tracker, epoch, float(epoch_tokens),
                     n_batches * self.rows_per_step * self.row_len,
                 )
+            # Goodput: classify this epoch window's wall time and report
+            # it; on a fleet, also the all-host aggregate (collective —
+            # epochs end in lockstep, so every host reaches this line).
+            self.goodput.note_skipped(
+                self.monitor.skipped_steps - skipped_before
+            )
+            report = self.goodput.end_epoch()
+            log_goodput(self.logger, self.tracker, epoch, report)
+            if jax.process_count() > 1:
+                log_goodput(self.logger, self.tracker, epoch,
+                            fleet_goodput(report), fleet=True)
+        self._flight.record("epoch_end", epoch=epoch, global_step=global_step,
+                            n_batches=n_batches)
         return EpochResult(state, global_step, False, n_batches)

@@ -8,6 +8,12 @@
 #   check_serving_hlo.py   — serving engine: zero steady-state XLA
 #                            recompilations across mixed-shape traffic,
 #                            incl. paged-decode admit/evict churn
+#   check_obs.py           — obs smoke: a traced serve loop yields a
+#                            complete per-request span tree + valid
+#                            Chrome-trace JSON, a traced train loop's
+#                            goodput buckets sum to wall time with
+#                            strict-JSON metrics.jsonl, and tracing-off
+#                            overhead stays under the 2% budget
 #   kv_pool / paged parity — page-allocator churn property tests + paged
 #                            decode == dense-cache parity (TIGER, COBRA)
 #   serving smoke          — CPU in-process engine: all four heads answer,
@@ -77,6 +83,13 @@ if [ "$MODE" = "--smoke" ]; then
     run python scripts/check_fused_ce_hlo.py --small --platform cpu
     run python scripts/check_packed_hlo.py --small --platform cpu
     run python scripts/check_serving_hlo.py --small --platform cpu
+    # Obs smoke (traced serve span tree + goodput schema + overhead
+    # budget). GENREC_CI_SKIP_OBS=1 skips it for callers whose pytest
+    # pass already runs tests/test_obs.py directly (same contract as
+    # GENREC_CI_SKIP_CHAOS below).
+    if [ -z "${GENREC_CI_SKIP_OBS:-}" ]; then
+        run python scripts/check_obs.py --small --platform cpu
+    fi
     # Chaos-unit subset (checkpoint corruption, non-finite guard, signal
     # latching; no trainer runs) — pytest output goes to stderr so the
     # entrypoint's stdout stays one verdict JSON per HLO check.
@@ -110,6 +123,7 @@ else
     run python scripts/check_fused_ce_hlo.py --write-note
     run python scripts/check_packed_hlo.py --write-note
     run python scripts/check_serving_hlo.py --write-note
+    run python scripts/check_obs.py
     # Full serving suite (incl. the slow all-four-heads drain test, the
     # slow COBRA trie-constraint pins, and the full paged-parity matrix).
     run_strict env JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py \

@@ -1,0 +1,124 @@
+#!/usr/bin/env python
+"""Summarize a Chrome-trace JSON file written by the obs span tracer.
+
+Usage:
+    python scripts/trace_report.py out/serve/trace.json
+    python scripts/trace_report.py trace.json --json      # machine-readable
+    python scripts/trace_report.py trace.json --phase decode_step
+
+Per-phase (span-name) latency summary — count, total, p50/p95/p99/max —
+plus the number of distinct traces (requests / epochs), the slow-request
+exemplars the tracer persisted, and, when the file's ``otherData``
+carries a goodput section (scripts/check_obs.py and the packed loop's
+dumps embed one), the goodput breakdown. The same file opens in Perfetto
+(https://ui.perfetto.dev) or chrome://tracing for the visual view; this
+CLI is the grep-speed alternative.
+
+Exit codes: 0 ok, 1 unreadable/invalid trace file.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import defaultdict
+
+
+def load_trace(path: str) -> dict:
+    with open(path) as fh:
+        data = json.load(fh)
+    if not isinstance(data, dict) or "traceEvents" not in data:
+        raise ValueError(f"{path}: not a Chrome-trace JSON object "
+                         "(missing 'traceEvents')")
+    return data
+
+
+def percentile(sorted_vals: list[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(int(q * len(sorted_vals)), len(sorted_vals) - 1)
+    return sorted_vals[idx]
+
+
+def summarize(data: dict, phase: str | None = None) -> dict:
+    by_name: dict[str, list[float]] = defaultdict(list)
+    traces = set()
+    for ev in data["traceEvents"]:
+        if ev.get("ph") != "X":
+            continue
+        name = ev.get("name", "?")
+        if phase is not None and name != phase:
+            continue
+        by_name[name].append(float(ev.get("dur", 0.0)) / 1e3)  # us -> ms
+        tid = (ev.get("args") or {}).get("trace_id")
+        if tid is not None:
+            traces.add(tid)
+    phases = {}
+    for name, durs in sorted(by_name.items()):
+        durs.sort()
+        phases[name] = {
+            "count": len(durs),
+            "total_ms": round(sum(durs), 3),
+            "p50_ms": round(percentile(durs, 0.50), 3),
+            "p95_ms": round(percentile(durs, 0.95), 3),
+            "p99_ms": round(percentile(durs, 0.99), 3),
+            "max_ms": round(durs[-1], 3),
+        }
+    other = data.get("otherData") or {}
+    return {
+        "n_traces": len(traces),
+        "phases": phases,
+        "exemplars": other.get("exemplars") or {},
+        "goodput": other.get("goodput"),
+    }
+
+
+def print_report(report: dict) -> None:
+    print(f"traces: {report['n_traces']}")
+    if report["phases"]:
+        w = max(len(n) for n in report["phases"])
+        print(f"{'phase':<{w}}  {'count':>7} {'total':>10} {'p50':>8} "
+              f"{'p95':>8} {'p99':>8} {'max':>8}  (ms)")
+        for name, s in report["phases"].items():
+            print(f"{name:<{w}}  {s['count']:>7} {s['total_ms']:>10.1f} "
+                  f"{s['p50_ms']:>8.2f} {s['p95_ms']:>8.2f} "
+                  f"{s['p99_ms']:>8.2f} {s['max_ms']:>8.2f}")
+    else:
+        print("no complete ('X') events found")
+    if report["exemplars"]:
+        print("slow-request exemplars:")
+        for tid, reason in report["exemplars"].items():
+            print(f"  {tid}: {reason}")
+    g = report.get("goodput")
+    if g:
+        wall = max(float(g.get("wall_s", 0.0)), 1e-9)
+        print(f"goodput: {g.get('goodput_pct', 0.0):.1f}% of {wall:.1f}s wall")
+        for k, v in (g.get("buckets") or {}).items():
+            if v > 0:
+                print(f"  {k:<18} {v:>9.3f}s  {100 * v / wall:>5.1f}%")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("trace", help="Chrome-trace JSON file (obs span dump)")
+    ap.add_argument("--json", action="store_true", help="print JSON report")
+    ap.add_argument("--phase", default=None,
+                    help="restrict the summary to one span name")
+    args = ap.parse_args(argv)
+    try:
+        data = load_trace(args.trace)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"trace_report: {e}", file=sys.stderr)
+        return 1
+    report = summarize(data, phase=args.phase)
+    if args.json:
+        json.dump(report, sys.stdout, indent=2)
+        print()
+    else:
+        print_report(report)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

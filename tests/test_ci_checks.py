@@ -49,13 +49,15 @@ def test_fused_ce_hlo_check_small_is_inconclusive_not_failed(capsys):
 def test_ci_checks_smoke_entrypoint():
     """The consolidated entrypoint runs every smoke check and exits 0
     (rc=2 inconclusives tolerated, real failures propagated)."""
-    # The chaos-unit subset is skipped here: this test runs INSIDE the
-    # suite that already executes tests/test_fault_tolerance.py directly,
-    # and nesting it would double-pay ~30s of cold-start for no coverage.
+    # The chaos-unit and obs subsets are skipped here: this test runs
+    # INSIDE the suite that already executes tests/test_fault_tolerance.py
+    # and tests/test_obs.py directly, and nesting them would double-pay
+    # their cold-start (~30s each) for no coverage.
     proc = subprocess.run(
         ["bash", os.path.join(REPO, "scripts", "ci_checks.sh"), "--smoke"],
         capture_output=True, text=True, timeout=600,
-        env={**os.environ, "JAX_PLATFORMS": "cpu", "GENREC_CI_SKIP_CHAOS": "1"},
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "GENREC_CI_SKIP_CHAOS": "1", "GENREC_CI_SKIP_OBS": "1"},
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     # One verdict JSON per check on stdout (decode, fused-ce, packed,

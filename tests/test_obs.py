@@ -1,0 +1,565 @@
+"""Observability layer: spans, goodput, flight recorder, export, wiring.
+
+Covers the ISSUE-7 satellites explicitly: span-tracer concurrency
+(parallel submitters -> well-nested, non-interleaved spans per trace
+ID), flight-recorder dump-on-SIGTERM through the REAL chaos hooks, and
+goodput-bucket arithmetic (buckets sum to wall time). Plus the
+regression pins: strict-JSON metrics.jsonl under NaN metrics, the
+engine-totals serving log line, Prometheus exposition, trace_report CLI,
+and an end-to-end served-request span tree.
+"""
+
+import json
+import math
+import os
+import signal
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from genrec_tpu.core import chaos
+from genrec_tpu.core.harness import make_train_step
+from genrec_tpu.core.logging import Tracker, log_serving_stats, setup_logger
+from genrec_tpu.core.preemption import PreemptionGuard
+from genrec_tpu.core.profiling import ProfileWindow
+from genrec_tpu.core.state import TrainState
+from genrec_tpu.obs import (
+    BUCKETS,
+    CompileEvents,
+    FlightRecorder,
+    GoodputMeter,
+    SpanTracer,
+    get_flight_recorder,
+    prometheus_text,
+)
+from genrec_tpu.obs.spans import NULL_TRACER
+from genrec_tpu.parallel import get_mesh, replicate
+from genrec_tpu.trainers.packed_loop import PackedTrainLoop
+
+
+def _strict_loads(line: str):
+    """json.loads that REJECTS the bare NaN/Infinity tokens json.dumps
+    emits by default — the parser a log pipeline actually uses."""
+    def _reject(tok):
+        raise ValueError(f"non-strict JSON constant {tok!r}")
+
+    return json.loads(line, parse_constant=_reject)
+
+
+# ---------------------------------------------------------------------------
+# span tracer
+# ---------------------------------------------------------------------------
+
+
+def test_span_nesting_and_parenting():
+    t = SpanTracer()
+    with t.span("outer", trace_id="req-a", kind="root"):
+        with t.span("mid"):
+            with t.span("inner"):
+                pass
+    spans = {s.name: s for s in t.spans("req-a")}
+    assert set(spans) == {"outer", "mid", "inner"}
+    assert spans["outer"].parent_id is None
+    assert spans["mid"].parent_id == spans["outer"].span_id
+    assert spans["inner"].parent_id == spans["mid"].span_id
+    # Children inherit the explicit trace id; intervals nest.
+    assert spans["inner"].t0 >= spans["mid"].t0
+    assert spans["inner"].t1 <= spans["mid"].t1 <= spans["outer"].t1
+    assert spans["outer"].attrs == {"kind": "root"}
+
+
+def test_span_concurrent_traces_well_nested():
+    """ISSUE satellite: parallel submitters produce well-nested,
+    non-interleaved span trees per trace ID — no cross-trace parenting,
+    every child interval inside its parent's."""
+    t = SpanTracer(capacity=4096)
+    n_threads, depth, reps = 8, 4, 10
+    errs = []
+
+    def worker(i: int) -> None:
+        try:
+            for r in range(reps):
+                tid = f"req-{i}-{r}"
+                with t.span("l0", trace_id=tid):
+                    for d in range(1, depth):
+                        with t.span(f"l{d}"):
+                            time.sleep(0.0002)
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n_threads)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert not errs
+    all_spans = t.spans()
+    assert len(all_spans) == n_threads * reps * depth
+    by_trace = {}
+    for s in all_spans:
+        by_trace.setdefault(s.trace_id, []).append(s)
+    assert len(by_trace) == n_threads * reps
+    for tid, spans in by_trace.items():
+        ids = {s.span_id: s for s in spans}
+        roots = [s for s in spans if s.parent_id is None]
+        assert len(roots) == 1 and roots[0].name == "l0"
+        for s in spans:
+            if s.parent_id is None:
+                continue
+            # Parent is in the SAME trace (no interleaving across
+            # threads) and the child's interval nests inside it.
+            assert s.parent_id in ids, f"{tid}: foreign parent"
+            p = ids[s.parent_id]
+            assert p.t0 <= s.t0 and s.t1 <= p.t1
+
+
+def test_disabled_tracer_records_nothing():
+    t = SpanTracer(enabled=False)
+    with t.span("x") as s:
+        assert s is None
+    assert t.record_span("y", "tr", 0.0, 1.0) is None
+    assert t.spans() == []
+    assert NULL_TRACER.spans() == []
+
+
+def test_record_span_preallocated_root_and_exemplars():
+    t = SpanTracer(max_exemplars=2)
+    root = t.allocate_span_id()
+    t.record_span("child", "req-1", 1.0, 2.0, parent_id=root)
+    t.record_span("request", "req-1", 0.5, 2.5, span_id=root)
+    spans = t.spans("req-1")
+    assert {s.name for s in spans} == {"child", "request"}
+    req = next(s for s in spans if s.name == "request")
+    assert req.span_id == root
+    assert next(s for s in spans if s.name == "child").parent_id == root
+
+    t.mark_exemplar("req-1", reason="p99 outlier")
+    for i in range(2, 5):  # exemplar store is bounded, oldest evicted
+        t.record_span("request", f"req-{i}", 0.0, 1.0)
+        t.mark_exemplar(f"req-{i}", reason="r")
+    ex = t.exemplars()
+    assert len(ex) == 2 and "req-1" not in ex
+    # ring capacity: completed spans are bounded too
+    small = SpanTracer(capacity=4)
+    for i in range(10):
+        small.record_span("s", "tr", i, i + 1)
+    assert len(small.spans()) == 4
+
+
+def test_chrome_trace_export_and_dump(tmp_path):
+    t = SpanTracer()
+    with t.span("phase", trace_id="req-1", step=3):
+        pass
+    t.mark_exemplar("req-1", reason="kept")
+    path = t.dump(str(tmp_path / "trace.json"), metadata={"run": "test"})
+    data = json.load(open(path))
+    assert data["displayTimeUnit"] == "ms"
+    ev = data["traceEvents"][0]
+    for key in ("name", "cat", "ph", "ts", "dur", "pid", "tid", "args"):
+        assert key in ev
+    assert ev["ph"] == "X" and ev["args"]["trace_id"] == "req-1"
+    assert ev["args"]["step"] == 3
+    assert data["otherData"]["exemplars"] == {"req-1": "kept"}
+    assert data["otherData"]["run"] == "test"
+
+
+# ---------------------------------------------------------------------------
+# goodput
+# ---------------------------------------------------------------------------
+
+
+def test_goodput_buckets_sum_to_wall():
+    """ISSUE satellite: bucket arithmetic — measured + derived + residual
+    buckets sum to the epoch wall time."""
+    m = GoodputMeter()
+    with m.measure("data_wait"):
+        time.sleep(0.02)
+    with m.measure("checkpoint_save"):
+        time.sleep(0.01)
+    t0 = time.perf_counter()
+    time.sleep(0.03)
+    m.note_step(time.perf_counter() - t0)
+    time.sleep(0.01)  # unattributed -> other
+    r = m.end_epoch()
+    assert set(r["buckets"]) == set(BUCKETS)
+    total = sum(r["buckets"].values())
+    assert math.isclose(total, r["wall_s"], rel_tol=1e-6, abs_tol=1e-6)
+    assert r["buckets"]["data_wait"] >= 0.015
+    assert r["buckets"]["checkpoint_save"] >= 0.005
+    assert r["buckets"]["compute"] >= 0.02
+    assert r["buckets"]["other"] >= 0.005
+    assert 0.0 < r["goodput_pct"] < 100.0
+    # run totals accumulate across epochs
+    with m.measure("restore"):
+        time.sleep(0.005)
+    m.note_step(0.0)
+    r2 = m.end_epoch()
+    assert math.isclose(sum(r2["buckets"].values()), r2["wall_s"],
+                        rel_tol=1e-6, abs_tol=1e-6)
+    run = m.run_report()
+    assert run["wall_s"] >= r["wall_s"] + r2["wall_s"] - 1e-6
+    assert run["buckets"]["restore"] >= 0.004
+
+
+def test_goodput_compile_and_skipped_attribution():
+    m = GoodputMeter()
+    for _ in range(4):
+        t0 = time.perf_counter()
+        time.sleep(0.01)
+        m.note_step(time.perf_counter() - t0)
+    t0 = time.perf_counter()
+    time.sleep(0.06)
+    # 0.05s of this step's wall was XLA compile (synthetic attribution).
+    m.note_step(time.perf_counter() - t0, compile_seconds=0.05)
+    m.note_skipped(1)  # one of the 5 steps was guard-skipped
+    r = m.end_epoch()
+    b = r["buckets"]
+    assert b["compile"] == pytest.approx(0.05, rel=0.2)
+    # skipped share = post-compile step time / steps (~0.05/5)
+    assert b["nonfinite_skipped"] == pytest.approx(0.01, rel=0.5)
+    assert b["compute"] == pytest.approx(0.04, rel=0.5)
+    assert math.isclose(sum(b.values()), r["wall_s"], rel_tol=1e-6,
+                        abs_tol=1e-6)
+
+
+def test_compile_events_tap_counts_fresh_jits():
+    tap = CompileEvents.ensure()
+    assert tap is CompileEvents.ensure()  # singleton
+    n0, s0 = tap.snapshot()
+    jax.jit(lambda x: x * 2.0 + 1.23456)(jnp.ones(5))  # fresh shape+expr
+    n1, s1 = tap.snapshot()
+    assert n1 > n0 and s1 > s0
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+def test_flight_recorder_ring_bound_and_atomic_dump(tmp_path):
+    fr = FlightRecorder(capacity=8)
+    for i in range(20):
+        fr.record("step", step=i, loss=float("nan") if i == 5 else 1.0)
+    events = fr.events()
+    assert len(events) == 8 and events[-1]["step"] == 19
+    assert events[0]["step"] == 12  # oldest evicted
+    # no destination configured -> no-op, never raises
+    assert fr.dump(reason="nowhere") is None
+    path = fr.configure(str(tmp_path / "fr.json"), install_excepthook=False,
+                        run="test")
+    got = fr.dump(reason="unit")
+    assert got == path
+    payload = _strict_loads(open(path).read())  # NaN field became null
+    assert payload["reason"] == "unit" and payload["meta"]["run"] == "test"
+    assert [e["kind"] for e in payload["events"]] == ["step"] * 8
+    assert payload["events"][-1]["seq"] == 20
+    assert not [f for f in os.listdir(tmp_path) if ".tmp." in f]
+
+
+def test_flight_recorder_dump_on_sigterm_via_chaos(tmp_path):
+    """ISSUE satellite: the REAL chaos hook delivers a real SIGTERM; the
+    PreemptionGuard latches it and the flight recorder leaves a dump
+    whose last events explain the shutdown (chaos_kill -> signal)."""
+    fr = get_flight_recorder()
+    fr.clear()
+    path = fr.configure(str(tmp_path / "flight_recorder.json"),
+                        install_excepthook=False)
+    logger = setup_logger(None)
+    guard = PreemptionGuard(logger)
+    try:
+        fr.record("step", step=1)
+        fr.record("step", step=2)
+        with chaos.inject(chaos.ChaosPlan(kill_at_step=3)):
+            chaos.maybe_kill(step=2)  # not yet
+            assert not guard.fired
+            chaos.maybe_kill(step=3)  # fires SIGTERM at this process
+        assert guard.fired
+        dump = _strict_loads(open(path).read())
+        kinds = [e["kind"] for e in dump["events"]]
+        # Injection recorded before delivery, receipt after — the last
+        # events ARE the post-mortem narrative.
+        assert kinds[-3:] == ["step", "chaos_kill", "signal"] or \
+            kinds[-2:] == ["chaos_kill", "signal"], kinds
+        assert dump["reason"].startswith("signal:SIGTERM")
+        assert dump["events"][-1]["name"] == "SIGTERM"
+    finally:
+        guard.close()
+
+
+def test_flight_recorder_excepthook_chains(tmp_path):
+    import sys
+
+    fr = FlightRecorder()
+    fr.configure(str(tmp_path / "crash.json"), install_excepthook=False)
+    seen = []
+    prev, sys.excepthook = sys.excepthook, lambda *a: seen.append(a)
+    try:
+        fr.install_excepthook()
+        try:
+            raise RuntimeError("boom")
+        except RuntimeError:
+            sys.excepthook(*sys.exc_info())
+        assert len(seen) == 1  # chained to the previous hook
+        dump = json.load(open(tmp_path / "crash.json"))
+        assert dump["reason"] == "crash:RuntimeError"
+        assert dump["events"][-1]["kind"] == "unhandled_exception"
+        assert "boom" in dump["events"][-1]["error"]
+    finally:
+        fr.uninstall_excepthook()
+        sys.excepthook = prev
+
+
+# ---------------------------------------------------------------------------
+# tracker / logging satellites
+# ---------------------------------------------------------------------------
+
+
+def test_tracker_nonfinite_metrics_stay_strict_json(tmp_path):
+    """Satellite regression: a NaN/Inf metric must not poison
+    metrics.jsonl — every line round-trips through a strict parser."""
+    tr = Tracker(save_dir=str(tmp_path))
+    tr.log({"train/loss": float("nan"), "train/gnorm": float("inf"),
+            "train/neg": float("-inf"), "train/ok": 1.5,
+            "nested": {"bad": float("nan")}, "listy": [1.0, float("inf")]})
+    tr.log({"train/loss": 2.0})
+    tr.finish()
+    lines = (tmp_path / "metrics.jsonl").read_text().splitlines()
+    assert len(lines) == 2
+    first = _strict_loads(lines[0])
+    assert first["train/loss"] is None and first["train/gnorm"] is None
+    assert first["train/neg"] is None and first["train/ok"] == 1.5
+    assert first["nested"]["bad"] is None and first["listy"] == [1.0, None]
+    assert _strict_loads(lines[1])["train/loss"] == 2.0
+
+
+def test_log_serving_stats_engine_totals_not_per_head():
+    """Satellite: admit/evict/OOM counters are ENGINE totals — printed
+    once on their own line, never inside a head's kv-pool line."""
+    import logging
+
+    class _Capture(logging.Handler):
+        def __init__(self):
+            super().__init__()
+            self.records = []
+
+        def emit(self, record):
+            self.records.append(record)
+
+    logger = setup_logger(None)  # propagate=False: attach our own handler
+    cap = _Capture()
+    logger.addHandler(cap)
+    stats = {
+        "qps": 1.0, "completed": 2, "total_ms": {"p50": 1.0},
+        "admits": 10, "evictions": 9, "oom_deferred_admits": 3,
+        "decode_steps": 17,
+        "kv_pool": {
+            "tiger": {"pages_in_use": 1, "pages_free": 7,
+                      "slots_active": 1, "slots_total": 4,
+                      "kv_tokens_resident": 16},
+            "cobra": {"pages_in_use": 2, "pages_free": 6,
+                      "slots_active": 2, "slots_total": 4,
+                      "kv_tokens_resident": 32},
+        },
+    }
+    try:
+        log_serving_stats(logger, Tracker(), stats)
+    finally:
+        logger.removeHandler(cap)
+    messages = [r.getMessage() for r in cap.records]
+    totals = [m for m in messages if "engine totals" in m]
+    assert len(totals) == 1
+    assert "admits=10" in totals[0] and "oom_deferred=3" in totals[0]
+    pool_lines = [m for m in messages if "kv-pool[" in m]
+    assert len(pool_lines) == 2
+    for line in pool_lines:
+        assert "admits=" not in line and "oom_deferred" not in line
+
+
+# ---------------------------------------------------------------------------
+# prometheus export + trace report CLI
+# ---------------------------------------------------------------------------
+
+
+def test_prometheus_text_exposition():
+    text = prometheus_text({
+        "completed": 12, "qps": 3.25,
+        "total_ms": {"p99": 8.5, "count": 12},
+        "kv_pool": {"tiger": {"pages_in_use": 3}},
+        "skip_nan": float("nan"),
+        "draining": False,
+    })
+    lines = text.splitlines()
+    assert "# TYPE genrec_completed counter" in lines
+    assert "genrec_completed 12" in lines
+    assert "# TYPE genrec_qps gauge" in lines
+    assert "genrec_qps 3.25" in lines
+    assert "genrec_total_ms_p99 8.5" in lines
+    assert "# TYPE genrec_total_ms_count counter" in lines
+    assert "genrec_kv_pool_tiger_pages_in_use 3" in lines
+    assert "genrec_draining 0" in lines
+    assert not any("nan" in ln.lower() for ln in lines if "genrec_skip" in ln)
+
+
+def test_trace_report_cli_summarizes(tmp_path, capsys):
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+    import trace_report
+
+    t = SpanTracer()
+    for i in range(5):
+        t.record_span("decode_step", f"req-{i}", 0.0, 0.001 * (i + 1), step=i)
+        t.record_span("request", f"req-{i}", 0.0, 0.002 * (i + 1))
+    path = t.dump(str(tmp_path / "trace.json"),
+                  metadata={"goodput": {"goodput_pct": 80.0, "wall_s": 10.0,
+                                        "buckets": {"compute": 8.0,
+                                                    "other": 2.0}}})
+    assert trace_report.main([path]) == 0
+    out = capsys.readouterr().out
+    assert "decode_step" in out and "request" in out
+    assert "traces: 5" in out
+    assert "goodput: 80.0%" in out
+    rep = trace_report.summarize(trace_report.load_trace(path))
+    assert rep["phases"]["decode_step"]["count"] == 5
+    assert rep["phases"]["request"]["max_ms"] == pytest.approx(10.0, rel=0.01)
+    # invalid file -> rc 1
+    bad = tmp_path / "bad.json"
+    bad.write_text("{}")
+    assert trace_report.main([str(bad)]) == 1
+
+
+# ---------------------------------------------------------------------------
+# packed-loop wiring: goodput report + flight events end to end
+# ---------------------------------------------------------------------------
+
+
+def _toy_loop(tmp_path, tracer=None):
+    def loss_fn(params, batch, rng):
+        pred = batch["x"] @ params["w"]
+        return jnp.mean((pred - batch["y"]) ** 2), {}
+
+    params = {"w": jax.random.normal(jax.random.key(0), (4, 2))}
+    opt = optax.adam(1e-2)
+    mesh = get_mesh()
+    state = replicate(mesh, TrainState.create(params, opt, jax.random.key(1)))
+    step_fn = jax.jit(make_train_step(loss_fn, opt, clip_norm=1.0))
+    rng = np.random.default_rng(0)
+    arrays = {"x": rng.standard_normal((64, 4)).astype(np.float32),
+              "y": rng.standard_normal((64, 2)).astype(np.float32)}
+    tracker = Tracker(save_dir=str(tmp_path))
+    loop = PackedTrainLoop(
+        logger=setup_logger(None), tracker=tracker, prof=ProfileWindow("", 0),
+        mesh=mesh, guard=None, ckpt=None, rows_per_step=8, row_len=1, seed=0,
+        pack_sequences=False, train_arrays=arrays, wandb_log_interval=1000,
+        save_dir_root=str(tmp_path), tracer=tracer,
+    )
+    return loop, state, step_fn, tracker
+
+
+def test_packed_loop_reports_goodput_and_flight_events(tmp_path):
+    fr = get_flight_recorder()
+    fr.clear()
+    tracer = SpanTracer()
+    loop, state, step_fn, tracker = _toy_loop(tmp_path, tracer=tracer)
+    res = loop.run_epoch(state, step_fn, epoch=0, global_step=0)
+    assert res.n_batches == 8 and not res.preempted
+    tracker.finish()
+
+    # goodput/* metrics emitted, buckets sum to wall, first-step compile
+    # attributed to the compile bucket.
+    lines = [_strict_loads(ln)
+             for ln in (tmp_path / "metrics.jsonl").read_text().splitlines()]
+    g = next(ln for ln in lines if "goodput/pct" in ln)
+    wall = g["goodput/wall_s"]
+    bucket_sum = sum(v for k, v in g.items()
+                     if k.endswith("_s") and k != "goodput/wall_s")
+    assert bucket_sum == pytest.approx(wall, rel=0.02, abs=1e-3)
+    assert g["goodput/compile_s"] > 0  # the first step's jit compile
+    assert loop.recompiles == 0  # steady state: no mid-run recompiles
+
+    # flight recorder: run directory configured, narrative events present
+    assert fr.path == str(tmp_path / "flight_recorder.json")
+    kinds = [e["kind"] for e in fr.events()]
+    assert kinds[0] == "epoch_start"
+    assert kinds.count("step") == 8
+    assert "epoch_end" in kinds
+
+    # tracer: one train_step span per step under the epoch trace
+    steps = tracer.spans("train-e0")
+    assert len(steps) == 8
+    assert all(s.name == "train_step" for s in steps)
+
+
+def test_packed_loop_goodput_counts_skipped_steps(tmp_path):
+    fr = get_flight_recorder()
+    fr.clear()
+    loop, state, step_fn, tracker = _toy_loop(tmp_path)
+    with chaos.inject(chaos.ChaosPlan(nan_at_steps=frozenset({3}))):
+        res = loop.run_epoch(state, step_fn, epoch=0, global_step=0)
+    assert res.n_batches == 8
+    tracker.finish()
+    lines = [_strict_loads(ln)
+             for ln in (tmp_path / "metrics.jsonl").read_text().splitlines()]
+    g = next(ln for ln in lines if "goodput/pct" in ln)
+    assert g["goodput/nonfinite_skipped_s"] > 0
+    assert any(e["kind"] == "nonfinite_step" for e in fr.events())
+
+
+# ---------------------------------------------------------------------------
+# served request span tree (dense path; the paged tree is pinned by
+# scripts/check_obs.py to keep tier-1 wall time lean)
+# ---------------------------------------------------------------------------
+
+
+def test_served_request_yields_complete_span_tree(rng):
+    from genrec_tpu.models.sasrec import SASRec
+    from genrec_tpu.serving import (
+        BucketLadder, Request, RetrievalHead, ServingEngine,
+    )
+
+    model = SASRec(num_items=30, max_seq_len=8, embed_dim=16, num_heads=2,
+                   num_blocks=1, ffn_dim=32, dropout=0.0)
+    params = model.init(jax.random.key(0), jnp.zeros((2, 8), jnp.int32))["params"]
+    tracer = SpanTracer()
+    eng = ServingEngine(
+        [RetrievalHead("sasrec", model, top_k=5)], params,
+        ladder=BucketLadder((1, 2), (8,)), max_batch=2, max_wait_ms=1.0,
+        handle_signals=False, tracer=tracer,
+    ).start()
+    try:
+        futs = [eng.submit(Request(head="sasrec",
+                                   history=rng.integers(1, 31, 5)))
+                for _ in range(3)]
+        resps = [f.result(60) for f in futs]
+        ids = [r.request_id for r in resps]
+        assert all(ids) and len(set(ids)) == 3  # unique ids, all minted
+        for r in resps:
+            spans = tracer.spans(r.request_id)
+            by_name = {s.name: s for s in spans}
+            assert set(by_name) == {"request", "queue_wait", "compute",
+                                    "finalize"}
+            root = by_name["request"]
+            assert root.parent_id is None
+            assert root.attrs["head"] == "sasrec"
+            for name in ("queue_wait", "compute", "finalize"):
+                child = by_name[name]
+                assert child.parent_id == root.span_id
+                assert child.t0 >= root.t0 - 1e-6
+                assert child.t1 <= root.t1 + 1e-6
+            # span durations agree with the Response's own latency split
+            assert by_name["queue_wait"].duration == pytest.approx(
+                r.queue_wait_s, abs=5e-3)
+            assert by_name["compute"].duration == pytest.approx(
+                r.compute_s, abs=5e-3)
+        # tracing off by default: a fresh engine mints no request ids
+        eng.set_tracer(None)
+        r = eng.serve(Request(head="sasrec", history=rng.integers(1, 31, 4)),
+                      timeout=60)
+        assert r.request_id is None
+    finally:
+        eng.stop()
