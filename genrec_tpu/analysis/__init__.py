@@ -1,0 +1,45 @@
+"""graftlint: two-level static analysis for the repo's hard invariants.
+
+Level 1 (analysis/ir.py) lowers the compile-manifest entry points
+(analysis/manifest.py — populated by trainers and serving heads) and
+runs IR rules over the jaxpr / optimized HLO: constant bake, donation
+audit, f64 discipline, host transfers inside device loops. Level 2
+(analysis/lint.py) is an AST linter: architecture.md-derived layering,
+trace purity, lock-held blocking calls.
+
+Driver: ``python scripts/graftlint.py`` (one JSON verdict line, rc 0/1,
+suppression baseline in analysis/baseline.json). Rule catalog and
+workflows: docs/ANALYSIS.md.
+
+Like obs, this package is a leaf substrate: importable from every
+layer, importing none of them (and no jax at module scope — providers
+register builders, not built entries).
+"""
+
+from genrec_tpu.analysis.findings import (
+    Finding,
+    load_baseline,
+    save_baseline,
+    split_by_baseline,
+    summary_metrics,
+)
+from genrec_tpu.analysis.manifest import (
+    BuiltEntry,
+    EntryPoint,
+    load_default_entries,
+    register_entry,
+    registered_entries,
+)
+
+__all__ = [
+    "Finding",
+    "load_baseline",
+    "save_baseline",
+    "split_by_baseline",
+    "summary_metrics",
+    "BuiltEntry",
+    "EntryPoint",
+    "load_default_entries",
+    "register_entry",
+    "registered_entries",
+]

@@ -1,0 +1,138 @@
+"""Finding type + suppression baseline shared by both graftlint levels.
+
+A `Finding` is one rule violation, with a line-number-free FINGERPRINT
+(`rule::where::key`) so the checked-in suppression baseline
+(`genrec_tpu/analysis/baseline.json`) survives unrelated edits to the
+same file. `where` is an entry-point name (IR level) or a repo-relative
+path (AST level); `key` is the rule's stable discriminator (the imported
+package, the offending call, the constant's dtype+shape, ...).
+
+The baseline contract (docs/ANALYSIS.md):
+
+- findings whose fingerprint IS in the baseline are reported but do not
+  fail CI (pre-existing debt, tracked);
+- findings NOT in the baseline fail CI (new debt is blocked);
+- baseline fingerprints that no longer match any finding are STALE and
+  reported so the baseline shrinks as debt is paid (warn, not fail).
+
+This module imports nothing from genrec_tpu (and no jax): the analysis
+package is a leaf substrate like obs — importable from any layer,
+importing none of them.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Iterable, Mapping, Sequence
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation.
+
+    ``detail`` carries rule-specific context (shapes, byte counts, line
+    numbers) for the human report; it is NOT part of the fingerprint.
+    """
+
+    rule: str
+    where: str
+    key: str
+    message: str
+    detail: Mapping = dataclasses.field(default_factory=dict, compare=False)
+
+    @property
+    def fingerprint(self) -> str:
+        return f"{self.rule}::{self.where}::{self.key}"
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "where": self.where,
+            "key": self.key,
+            "message": self.message,
+            "fingerprint": self.fingerprint,
+            "detail": dict(self.detail),
+        }
+
+
+#: Rules that may NEVER be suppressed: they mean the analysis itself did
+#: not run (a broken manifest builder, an unparseable file). Baselining
+#: one would make "the tool is blind here" read as clean forever —
+#: save_baseline filters them out and split_by_baseline ignores
+#: hand-added fingerprints for them.
+NEVER_SUPPRESS = frozenset({"entry_error", "syntax_error"})
+
+
+def load_baseline(path: str) -> list[str]:
+    """Fingerprints from a baseline file; [] when the file is absent."""
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        data = json.load(f)
+    fps = data["suppressions"] if isinstance(data, dict) else data
+    if not all(isinstance(fp, str) for fp in fps):
+        raise ValueError(f"baseline {path} must be a list of fingerprint strings")
+    return list(fps)
+
+
+def save_baseline(path: str, findings: Iterable[Finding], note: str = "") -> None:
+    """Write the fingerprints of ``findings`` as the new baseline
+    (sorted, deduplicated — diffs stay reviewable). NEVER_SUPPRESS rules
+    are excluded: they must keep failing until the analysis runs again."""
+    fps = sorted({f.fingerprint for f in findings
+                  if f.rule not in NEVER_SUPPRESS})
+    payload = {
+        "_comment": note or (
+            "graftlint suppression baseline: pre-existing findings that do "
+            "not fail CI. Regenerate with scripts/graftlint.py "
+            "--update-baseline; see docs/ANALYSIS.md."
+        ),
+        "suppressions": fps,
+    }
+    with open(path, "w") as f:
+        json.dump(payload, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def split_by_baseline(
+    findings: Sequence[Finding], baseline: Iterable[str]
+) -> tuple[list[Finding], list[Finding], list[str]]:
+    """(new, baselined, stale_baseline_fingerprints).
+
+    NEVER_SUPPRESS findings are always new, even if someone hand-added
+    their fingerprint to the baseline file."""
+    base = set(baseline)
+
+    def suppressed(f: Finding) -> bool:
+        return f.fingerprint in base and f.rule not in NEVER_SUPPRESS
+
+    new = [f for f in findings if not suppressed(f)]
+    old = [f for f in findings if suppressed(f)]
+    present = {f.fingerprint for f in findings}
+    stale = sorted(base - present)
+    return new, old, stale
+
+
+def summary_metrics(
+    findings: Sequence[Finding],
+    new: Sequence[Finding],
+    baselined: Sequence[Finding],
+    stale: Sequence[str],
+) -> dict:
+    """Flat ``analysis/*`` metrics dict, Tracker/flight-recorder friendly
+    (plain str->int, strict-JSON safe), so CI history can chart rule-count
+    trends next to goodput."""
+    per_rule: dict[str, int] = {}
+    for f in findings:
+        per_rule[f.rule] = per_rule.get(f.rule, 0) + 1
+    out = {
+        "analysis/findings": len(findings),
+        "analysis/new": len(new),
+        "analysis/baselined": len(baselined),
+        "analysis/stale_baseline": len(stale),
+    }
+    for rule, n in sorted(per_rule.items()):
+        out[f"analysis/rule/{rule}"] = n
+    return out

@@ -1,0 +1,560 @@
+"""Level 2 of graftlint: repo-specific AST rules (no jax import needed).
+
+Three rule families:
+
+- **layering** — the import-graph rules are GENERATED from the layer map
+  in docs/architecture.md (the ASCII diagram: ``L6 Serving``,
+  ``L0 Runtime``, ... down to the cross-cutting ``Lx Observability``).
+  A package may import same-or-lower layers. Leaf substrates (obs,
+  analysis — the ``Lx`` rows) may import NOTHING else inside genrec_tpu:
+  they are fed by every layer and must stay importable from every layer
+  without cycles. ``configlib`` is declared OPEN (importable from any
+  layer): its L5 row in the diagram places the *config surface* above
+  trainers, but the package itself is a dependency-free substrate that
+  models/trainers use for registration decorators. Extra forbidden
+  edges cover dependencies the level ordering alone would allow
+  (serving must never import trainers: a serving process must not drag
+  the training stack into its image).
+
+- **trace_purity** — inside functions handed to ``jax.jit`` /
+  ``jax.lax.scan`` / ``shard_map`` (by name, decorator, or inline
+  lambda): ``time.time()``-family calls, ``np.random.*``,
+  ``int()/float()/bool()`` coercions of a traced parameter, and Python
+  ``if`` on a bare traced parameter. Each of these either bakes a
+  trace-time value into the executable (recompile ladder / frozen
+  clock) or forces a trace-time concretization error at best.
+
+- **lock_held_blocking** — in the threaded layers (serving/, obs/): no
+  ``Future.result``, ``<queue>.get`` without timeout, ``time.sleep``,
+  thread ``join``, or device sync (``block_until_ready`` /
+  ``device_get``) while a ``threading.Lock``/``RLock`` is held. The
+  batcher/watcher/tracer threads share these locks; a blocking call
+  under one is a real deadlock class (the blocked thread holds the lock
+  the unblocking thread needs). ``Condition.wait`` is exempt by design —
+  it releases the lock — and is not in the blocking set.
+
+Static analysis is conservative by construction: the traced-function
+discovery follows names within one module (the repo's idiom — factories
+close over models and are jitted in the same scope), and the coercion /
+branch rules fire only on direct parameter uses. The fixture tests in
+tests/test_analysis.py pin both the trigger and the just-barely-doesn't
+side of every rule.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Iterable, Optional
+
+from genrec_tpu.analysis.findings import Finding
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+#: The Lx rows of the layer map: leaf substrates that import nothing
+#: else from genrec_tpu. ``analysis`` itself is held to the same rule.
+LEAF_LEVEL = -1.0
+
+#: Importable from any layer (see module docstring).
+OPEN_PACKAGES = frozenset({"configlib"})
+
+#: Dependency edges forbidden even though the level ordering allows them
+#: (src imports dst): a serving image must not contain the training stack.
+FORBIDDEN_EDGES = frozenset({("serving", "trainers")})
+
+#: Top-level driver modules outside the layer discipline (task runners
+#: that by design touch every layer).
+EXEMPT_MODULES = frozenset({"pipelines"})
+
+
+# ---------------------------------------------------------------------------
+# Layer map: generated from docs/architecture.md
+# ---------------------------------------------------------------------------
+
+_LAYER_ROW = re.compile(r"^[│|]\s+L(\d+|x)\b")
+_PKG = re.compile(r"genrec_tpu[./](\w+)")
+
+
+def parse_layer_map(architecture_md: str) -> dict[str, float]:
+    """Package -> layer level from the architecture diagram.
+
+    Rows look like ``│ L6  Serving   genrec_tpu/serving/ (...)`` with
+    continuation lines (no ``Ln`` label) listing more packages of the
+    same layer; ``Lx`` rows map to LEAF_LEVEL. Raises if the diagram
+    yields no map at all — the rule must not pass vacuously when the doc
+    is restructured.
+    """
+    level: Optional[float] = None
+    mapping: dict[str, float] = {}
+    for line in architecture_md.splitlines():
+        m = _LAYER_ROW.match(line.strip())
+        if m:
+            tag = m.group(1)
+            level = LEAF_LEVEL if tag == "x" else float(tag)
+        elif not line.strip().startswith(("│", "|")):
+            level = None  # left the diagram box
+        if level is None:
+            continue
+        for pkg in _PKG.findall(line):
+            mapping.setdefault(pkg, level)
+    if not mapping:
+        raise ValueError(
+            "no layer map found in docs/architecture.md — the layering rule "
+            "would be vacuous; restore the L0..L6/Lx diagram or update "
+            "analysis/lint.py's parser"
+        )
+    return mapping
+
+
+def load_layer_map(repo: str = REPO) -> dict[str, float]:
+    with open(os.path.join(repo, "docs", "architecture.md")) as f:
+        return parse_layer_map(f.read())
+
+
+# ---------------------------------------------------------------------------
+# Per-file AST helpers
+# ---------------------------------------------------------------------------
+
+def _dotted(node: ast.AST) -> str:
+    """Best-effort dotted name of an expression ('jax.lax.scan', ...)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _genrec_imports(tree: ast.AST, relpath: str = "") -> list[tuple[str, int]]:
+    """(imported genrec_tpu package, lineno) for every import in a file,
+    including imports nested inside functions (lazy imports are still
+    dependency edges — they fire at serve/train time) and RELATIVE
+    imports (``from ..parallel import mesh`` is the same edge as the
+    absolute spelling; resolved against ``relpath``)."""
+    # The file's containing package as dotted parts: genrec_tpu/obs/x.py
+    # and genrec_tpu/obs/__init__.py both live in package genrec_tpu.obs.
+    pkg_parts = relpath.replace(os.sep, "/").split("/")[:-1] if relpath else []
+
+    def absolute(module: Optional[str], level: int) -> list[str]:
+        if level == 0:
+            return module.split(".") if module else []
+        base = pkg_parts[: len(pkg_parts) - (level - 1)]
+        return base + (module.split(".") if module else [])
+
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                m = re.match(r"genrec_tpu\.(\w+)", alias.name)
+                if m:
+                    out.append((m.group(1), node.lineno))
+        elif isinstance(node, ast.ImportFrom):
+            full = absolute(node.module, node.level)
+            if not full or full[0] != "genrec_tpu":
+                continue
+            if len(full) >= 2:
+                out.append((full[1], node.lineno))
+            else:
+                # from genrec_tpu import X / from .. import X (at the
+                # package root): each alias names the package.
+                for alias in node.names:
+                    out.append((alias.name, node.lineno))
+    return out
+
+
+def _module_package(relpath: str) -> Optional[str]:
+    """genrec_tpu/serving/engine.py -> 'serving'; genrec_tpu/pipelines.py
+    -> 'pipelines'; files outside the package -> None."""
+    parts = relpath.replace(os.sep, "/").split("/")
+    if parts[0] != "genrec_tpu" or len(parts) < 2:
+        return None
+    if len(parts) == 2:
+        return os.path.splitext(parts[1])[0]
+    return parts[1]
+
+
+# ---------------------------------------------------------------------------
+# Rule: layering
+# ---------------------------------------------------------------------------
+
+def check_layering(
+    relpath: str,
+    tree: ast.AST,
+    layers: dict[str, float],
+    *,
+    open_packages: frozenset = OPEN_PACKAGES,
+    forbidden_edges: frozenset = FORBIDDEN_EDGES,
+) -> list[Finding]:
+    src_pkg = _module_package(relpath)
+    if src_pkg is None or src_pkg in EXEMPT_MODULES:
+        return []
+    src_level = layers.get(src_pkg)
+    findings = []
+    for dst_pkg, lineno in _genrec_imports(tree, relpath):
+        if dst_pkg == src_pkg:
+            continue
+        edge = (src_pkg, dst_pkg)
+        dst_level = layers.get(dst_pkg)
+        bad = reason = None
+        if edge in forbidden_edges:
+            bad = True
+            reason = f"the {src_pkg} layer must never import {dst_pkg}"
+        elif dst_pkg in EXEMPT_MODULES:
+            # Driver modules (pipelines) sit ABOVE the library: they may
+            # import everything, but library code importing them would
+            # drag every layer into one image through a single hop.
+            bad = True
+            reason = (
+                f"{dst_pkg} is a top-level driver outside the layer "
+                "discipline; library code must not import it"
+            )
+        elif dst_pkg in open_packages:
+            # Open substrate: importable from ANY layer, leaves included
+            # — checked BEFORE the leaf-source rule so the documented
+            # "open for every layer" contract holds for obs/analysis too.
+            bad = False
+        elif src_level == LEAF_LEVEL:
+            # Leaves import NOTHING else from genrec_tpu — not even other
+            # leaves (an obs<->analysis edge would be a cycle invisible to
+            # the level ordering).
+            bad = True
+            reason = (
+                f"{src_pkg} is a cross-cutting leaf substrate: every layer "
+                f"feeds it, so it may import nothing from genrec_tpu "
+                f"(invert the dependency — inject the {dst_pkg} callable "
+                "from the caller)"
+            )
+        elif dst_level in (None, LEAF_LEVEL):
+            bad = False  # leaf destination, or unmapped (the
+            # unmapped_package rule forces a diagram row for new packages)
+        elif src_level is not None and dst_level > src_level:
+            bad = True
+            reason = (
+                f"upward import: {src_pkg} (L{src_level:g}) must not depend "
+                f"on {dst_pkg} (L{dst_level:g})"
+            )
+        if bad:
+            findings.append(Finding(
+                rule="layering",
+                where=relpath,
+                key=f"{src_pkg}->{dst_pkg}",
+                message=f"{relpath}:{lineno}: imports genrec_tpu.{dst_pkg} — "
+                        f"{reason}",
+                detail={"line": lineno, "src": src_pkg, "dst": dst_pkg},
+            ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# Rule: trace purity
+# ---------------------------------------------------------------------------
+
+#: Callee leaf name -> positional-arg indices that are traced functions
+#: (fori_loop(lo, hi, body, init) traces args[2]; while_loop traces both
+#: the cond and the body).
+_TRACING_CALLS = {
+    "jit": (0,),
+    "scan": (0,),
+    "shard_map": (0,),
+    "fori_loop": (2,),
+    "while_loop": (0, 1),
+}
+_CLOCK_CALLS = {"time.time", "time.perf_counter", "time.monotonic",
+                "time.time_ns", "time.perf_counter_ns"}
+
+
+def _traced_functions(tree: ast.AST) -> list[tuple[str, ast.AST]]:
+    """(label, function node) for every function this module hands to a
+    tracing transform: @jax.jit-decorated defs, defs whose NAME is passed
+    as the first arg to jit/scan/shard_map/..., and inline lambdas."""
+    defs: dict[str, ast.AST] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs[node.name] = node
+    traced: dict[int, tuple[str, ast.AST]] = {}
+
+    def mark(label, fn_node):
+        if fn_node is not None:
+            traced[id(fn_node)] = (label, fn_node)
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                d = _dotted(target)
+                if d.split(".")[-1] == "jit" or (
+                    isinstance(dec, ast.Call) and d.endswith("partial")
+                    and any(_dotted(a).split(".")[-1] == "jit"
+                            for a in dec.args)
+                ):
+                    mark(node.name, node)
+        elif isinstance(node, ast.Call):
+            callee = _dotted(node.func).split(".")[-1]
+            for argnum in _TRACING_CALLS.get(callee, ()):
+                if argnum >= len(node.args):
+                    continue
+                arg = node.args[argnum]
+                if isinstance(arg, ast.Lambda):
+                    mark(None, arg)  # labeled by source-order ordinal below
+                elif isinstance(arg, ast.Name):
+                    mark(arg.id, defs.get(arg.id))
+    # Label traced lambdas by SOURCE-ORDER ordinal, not line number: the
+    # label flows into the finding fingerprint, which must survive
+    # unrelated edits to the file (findings.py contract). Adding a traced
+    # lambda earlier in the file shifts later ordinals — rare, and
+    # strictly better than every line edit above one churning the
+    # baseline.
+    lambdas = sorted(
+        (node for label, node in traced.values() if label is None),
+        key=lambda n: (n.lineno, n.col_offset),
+    )
+    for i, node in enumerate(lambdas, 1):
+        traced[id(node)] = (f"<lambda#{i}>", node)
+    return list(traced.values())
+
+
+def _is_static_read(expr: ast.AST) -> bool:
+    """True when a coercion's argument reads only trace-static metadata
+    of a traced value — ``int(x.shape[0])``, ``float(x.ndim)``,
+    ``bool(len(xs))`` are correct JAX (shapes are static under jit) and
+    must not trip the purity rule."""
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Attribute) and n.attr in ("shape", "ndim",
+                                                       "size", "dtype"):
+            return True
+        if (isinstance(n, ast.Call) and isinstance(n.func, ast.Name)
+                and n.func.id == "len"):
+            return True
+    return False
+
+
+def _param_names(fn: ast.AST) -> set[str]:
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    return set(names)
+
+
+def check_trace_purity(relpath: str, tree: ast.AST) -> list[Finding]:
+    findings = []
+    for label, fn in _traced_functions(tree):
+        params = _param_names(fn)
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        for node in ast.walk(ast.Module(body=body, type_ignores=[])):
+            offense = None
+            if isinstance(node, ast.Call):
+                d = _dotted(node.func)
+                if d in _CLOCK_CALLS:
+                    offense = f"{d}() reads the host clock at TRACE time"
+                elif re.match(r"(np|numpy)\.random\.", d):
+                    offense = (f"{d}() draws host randomness at TRACE time "
+                               "(thread a jax PRNG key instead)")
+                elif (isinstance(node.func, ast.Name)
+                      and node.func.id in ("int", "float", "bool")
+                      and node.args
+                      and any(isinstance(n, ast.Name) and n.id in params
+                              for n in ast.walk(node.args[0]))
+                      and not _is_static_read(node.args[0])):
+                    offense = (f"{node.func.id}() coercion of traced "
+                               "parameter — concretizes at trace time")
+            elif (isinstance(node, ast.If) and isinstance(node.test, ast.Name)
+                  and node.test.id in params):
+                offense = (f"Python `if {node.test.id}` on a traced "
+                           "parameter — use jnp.where / lax.cond")
+            if offense:
+                findings.append(Finding(
+                    rule="trace_purity",
+                    where=relpath,
+                    key=f"{label}:{offense.split(' ')[0]}",
+                    message=f"{relpath}:{node.lineno}: in traced function "
+                            f"{label}: {offense}",
+                    detail={"line": node.lineno, "function": label},
+                ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# Rule: lock discipline
+# ---------------------------------------------------------------------------
+
+#: Directories (package names) the lock rule applies to — the layers
+#: with batcher/watcher/tracer thread pools.
+LOCKED_PACKAGES = ("serving", "obs")
+
+_LOCKISH = re.compile(r"lock", re.I)
+_QUEUEISH = re.compile(r"(^|_)(q|queue|queues|inbox|inq|outq)$", re.I)
+_THREADISH = re.compile(r"(thread|batcher|watcher|worker|proc)", re.I)
+
+
+def _is_lock_ctx(expr: ast.AST) -> bool:
+    name = _dotted(expr)
+    return bool(name) and bool(_LOCKISH.search(name.split(".")[-1]))
+
+
+def _blocking_offense(node: ast.Call) -> Optional[str]:
+    d = _dotted(node.func)
+    leaf = d.split(".")[-1]
+    recv = ".".join(d.split(".")[:-1])
+    recv_leaf = recv.split(".")[-1] if recv else ""
+    if d in ("time.sleep",):
+        return "time.sleep while holding a lock"
+    if leaf == "result":
+        # Future.result(timeout) is the same bounded-block pattern the
+        # queue.get timeout exemption allows — flag only the unbounded
+        # form (no positional timeout, no timeout kwarg).
+        bounded = bool(node.args) or any(
+            kw.arg == "timeout" for kw in node.keywords
+        )
+        if not bounded:
+            return f"unbounded Future.result ({d}) while holding a lock"
+    if leaf == "get" and _QUEUEISH.search(recv_leaf):
+        # Bounded or non-blocking reads are fine: get(timeout=...),
+        # get(block, timeout), get(False) / get(block=False).
+        bounded = (
+            any(kw.arg == "timeout" for kw in node.keywords)
+            or len(node.args) >= 2
+            or any(isinstance(a, ast.Constant) and a.value is False
+                   for a in node.args[:1])
+            or any(kw.arg == "block"
+                   and isinstance(kw.value, ast.Constant)
+                   and kw.value.value is False
+                   for kw in node.keywords)
+        )
+        if not bounded:
+            return f"{d}() without timeout while holding a lock"
+    if leaf == "join" and _THREADISH.search(recv_leaf):
+        return f"thread join ({d}) while holding a lock"
+    if leaf == "block_until_ready" or d in ("jax.block_until_ready",
+                                            "jax.device_get"):
+        return f"device sync ({d}) while holding a lock"
+    return None
+
+
+def check_lock_discipline(relpath: str, tree: ast.AST) -> list[Finding]:
+    pkg = _module_package(relpath)
+    if pkg not in LOCKED_PACKAGES:
+        return []
+    findings = []
+
+    class _V(ast.NodeVisitor):
+        def __init__(self):
+            self.ctx: list[str] = []
+
+        def visit_With(self, node: ast.With):
+            held = [_dotted(i.context_expr) for i in node.items
+                    if _is_lock_ctx(i.context_expr)]
+            self.ctx.extend(held)
+            for stmt in node.body:
+                self.visit(stmt)
+            for _ in held:
+                self.ctx.pop()
+
+        # A nested def/lambda body runs LATER, not under this lock.
+        def visit_FunctionDef(self, node):
+            if not self.ctx:
+                self.generic_visit(node)
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Lambda(self, node):
+            if not self.ctx:
+                self.generic_visit(node)
+
+        def visit_Call(self, node: ast.Call):
+            if self.ctx:
+                offense = _blocking_offense(node)
+                if offense:
+                    findings.append(Finding(
+                        rule="lock_held_blocking",
+                        where=relpath,
+                        key=f"{self.ctx[-1]}:{_dotted(node.func)}",
+                        message=f"{relpath}:{node.lineno}: {offense} "
+                                f"(holding {self.ctx[-1]}) — a blocked "
+                                "holder deadlocks every thread waiting on "
+                                "this lock",
+                        detail={"line": node.lineno, "lock": self.ctx[-1]},
+                    ))
+            self.generic_visit(node)
+
+    _V().visit(tree)
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# Driver
+# ---------------------------------------------------------------------------
+
+def lint_file(
+    path: str,
+    repo: str = REPO,
+    layers: Optional[dict[str, float]] = None,
+) -> list[Finding]:
+    relpath = os.path.relpath(path, repo)
+    with open(path) as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=relpath)
+    except SyntaxError as e:
+        return [Finding(rule="syntax_error", where=relpath, key="parse",
+                        message=f"{relpath}: does not parse: {e}")]
+    findings = []
+    if layers is not None:
+        findings += check_layering(relpath, tree, layers)
+    findings += check_trace_purity(relpath, tree)
+    findings += check_lock_discipline(relpath, tree)
+    return findings
+
+
+def iter_source_files(repo: str = REPO) -> Iterable[str]:
+    pkg_root = os.path.join(repo, "genrec_tpu")
+    for dirpath, dirnames, filenames in os.walk(pkg_root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fname in sorted(filenames):
+            if fname.endswith(".py"):
+                yield os.path.join(dirpath, fname)
+
+
+def check_unmapped_packages(repo: str, layers: dict[str, float]) -> list[Finding]:
+    """Every genrec_tpu package — and top-level module — must have a row
+    in the architecture.md diagram: a name the map does not know is one
+    the layering rule cannot constrain (as source OR destination), which
+    would make 'machine-enforced layer map' silently false for new code.
+    """
+    findings = []
+    pkg_root = os.path.join(repo, "genrec_tpu")
+    for entry in sorted(os.listdir(pkg_root)):
+        path = os.path.join(pkg_root, entry)
+        if os.path.isdir(path):
+            if entry == "__pycache__":
+                continue
+            name, where = entry, f"genrec_tpu/{entry}/"
+        elif entry.endswith(".py") and entry != "__init__.py":
+            name, where = entry[:-3], f"genrec_tpu/{entry}"
+        else:
+            continue
+        if name in layers or name in EXEMPT_MODULES:
+            continue
+        findings.append(Finding(
+            rule="unmapped_package",
+            where=where,
+            key=name,
+            message=(
+                f"{where} has no row in docs/architecture.md's layer "
+                "diagram — the layering rule cannot constrain it; add it "
+                "to the diagram (graftlint regenerates the map from the "
+                "doc)"
+            ),
+        ))
+    return findings
+
+
+def lint_repo(repo: str = REPO) -> list[Finding]:
+    layers = load_layer_map(repo)
+    findings = check_unmapped_packages(repo, layers)
+    for path in iter_source_files(repo):
+        findings += lint_file(path, repo=repo, layers=layers)
+    return findings

@@ -27,6 +27,18 @@ from genrec_tpu.core.state import TrainState
 LossFn = Callable[[Any, Any, jax.Array], tuple[jax.Array, dict]]
 
 
+def jit_train_step(step):
+    """THE production jit config for a trainer's step: the train state is
+    consumed by the call (the loop rebinds it), so it is donated — an
+    undonated state is a dead full-model copy held in HBM across every
+    step. Every trainer AND its graftlint compile-manifest entry jit
+    through this one helper, so the donation audit
+    (analysis/ir.py missing_donation) audits what production compiles;
+    dropping the donation here fails CI instead of silently
+    double-buffering."""
+    return jax.jit(step, donate_argnums=0)
+
+
 def make_train_step(
     loss_fn: LossFn,
     optimizer: optax.GradientTransformation,

@@ -28,11 +28,14 @@ Buckets sum to the epoch wall time EXACTLY (``other`` is the residual;
 tests pin the arithmetic), and ``goodput_pct = compute / wall``.
 
 Fleet-wide view: `fleet_goodput` allgathers every host's bucket
-microseconds over `parallel.mesh.allgather_host_ints` and reports the
-fleet sums — one number for "the job is 7% checkpoint-bound", even when
-only host 3 has the slow disk. Collective: every host must call it at
-the same point (the packed loop calls it in the epoch epilogue, which
-runs in lockstep).
+microseconds through an INJECTED allgather callable (the packed loop
+passes `parallel.mesh.allgather_host_ints`) and reports the fleet sums —
+one number for "the job is 7% checkpoint-bound", even when only host 3
+has the slow disk. Collective: every host must call it at the same point
+(the packed loop calls it in the epoch epilogue, which runs in
+lockstep). The callable is injected rather than imported: obs is the
+cross-cutting leaf layer — every layer feeds it, it imports none of them
+(docs/architecture.md; machine-enforced by graftlint's layering rule).
 """
 
 from __future__ import annotations
@@ -209,21 +212,29 @@ class GoodputMeter:
         }
 
 
-def fleet_goodput(report: Mapping) -> dict:
+def fleet_goodput(report: Mapping, allgather=None) -> dict:
     """Aggregate one epoch report fleet-wide (sums over hosts).
 
-    COLLECTIVE on multi-host (allgather): call at the same loop point on
-    every host. Single-process returns the local report unchanged."""
+    ``allgather`` takes a list of ints and returns an (n_hosts, n_ints)
+    array — the caller injects `parallel.mesh.allgather_host_ints` (obs
+    imports nothing upward). COLLECTIVE on multi-host: call at the same
+    loop point on every host. Single-process returns the local report
+    unchanged without touching ``allgather``."""
     import jax
 
     if jax.process_count() == 1:
         return dict(report)
-    from genrec_tpu.parallel.mesh import allgather_host_ints
+    if allgather is None:
+        raise ValueError(
+            "fleet_goodput on a multi-process run needs an allgather "
+            "callable (pass parallel.mesh.allgather_host_ints); obs does "
+            "not import the runtime layer itself"
+        )
 
     keys = list(BUCKETS)
     local_us = [int(report["buckets"][b] * 1e6) for b in keys]
     local_us.append(int(report["wall_s"] * 1e6))
-    gathered = allgather_host_ints(local_us)  # (n_hosts, len(keys)+1)
+    gathered = allgather(local_us)  # (n_hosts, len(keys)+1)
     sums = gathered.sum(axis=0)
     buckets = {b: float(sums[i]) / 1e6 for i, b in enumerate(keys)}
     wall = max(float(sums[-1]) / 1e6, 1e-9)

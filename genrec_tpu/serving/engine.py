@@ -88,6 +88,14 @@ def _sds(tree):
     )
 
 
+#: The slot-state operand of the paged decode step is dead after every
+#: call (step() overwrites it from the executable's output) and is
+#: donated. Shared with the graftlint manifest entry in serving/heads.py
+#: so the donation audit audits the SAME argnums production compiles —
+#: changing this constant changes both.
+PAGED_DECODE_DONATE_ARGNUMS = (1,)
+
+
 class _PagedRunner:
     """Slot-level continuous batching for ONE paged generative head.
 
@@ -180,7 +188,13 @@ class _PagedRunner:
             _sds(self.pool.k_pools),
             _sds(self.pool.v_pools),
         )
-        compiled = jax.jit(fn).lower(*args).compile()
+        # Donate the slot-state operand: the write-back in step()
+        # overwrites every row, so the input tree is dead after the call —
+        # undonated, XLA would double-buffer the whole slot ladder's
+        # decode state (graftlint missing_donation; docs/PERF.md note).
+        compiled = jax.jit(
+            fn, donate_argnums=self._donate(*PAGED_DECODE_DONATE_ARGNUMS)
+        ).lower(*args).compile()
         eng.metrics.record_compile()
         return compiled
 

@@ -474,3 +474,83 @@ class RetrievalHead(Head):
                  sem_ids=None)
             for i in range(len(reqs))
         ]
+
+
+# ---------------------------------------------------------------------------
+# graftlint compile manifest (scripts/graftlint.py, docs/ANALYSIS.md)
+# ---------------------------------------------------------------------------
+
+from genrec_tpu.analysis.manifest import BuiltEntry, register_entry
+
+
+def _tiny_tiger_head():
+    """CI-shape TIGER head + params for the serving manifest entries."""
+    from genrec_tpu.models.tiger import Tiger
+
+    rng = np.random.default_rng(7)
+    valid = np.unique(rng.integers(0, 8, (20, 3)), axis=0)
+    model = Tiger(embedding_dim=16, attn_dim=32, dropout=0.0, num_heads=4,
+                  n_layers=2, num_item_embeddings=8, num_user_embeddings=20,
+                  sem_id_dim=3, max_pos=64)
+    B, L, D = 2, 4, 3
+    params = model.init(
+        jax.random.key(0), jnp.zeros((B,), jnp.int32),
+        jnp.zeros((B, L * D), jnp.int32), jnp.zeros((B, L * D), jnp.int32),
+        jnp.zeros((B, D), jnp.int32), jnp.zeros((B, D), jnp.int32),
+        jnp.ones((B, L * D), jnp.int32),
+    )["params"]
+    return TigerGenerativeHead(model, valid, top_k=4), params, B, L
+
+
+@register_entry("serve/tiger_generate_dense", tags=("serving", "generative"))
+def _graftlint_dense_entry() -> BuiltEntry:
+    """The dense whole-generate executable, jitted exactly like
+    ServingEngine._compile. The trie legality tables are closed over and
+    baked as literals — the known debt the constant_bake rule tracks
+    (ROADMAP: trie as a runtime operand). At CI shapes the largest baked
+    table is the (K^2, K)=pred[64,8] legality mask (512 B; ~16 MB at the
+    production K=256), so the entry pins a 256 B threshold to keep the
+    rule biting — the same self-test discipline as the check_*_hlo
+    regexes."""
+    head, params, B, L = _tiny_tiger_head()
+    fn = jax.jit(head.make_fn(B, L))
+    args = (params, *head.make_batch([head.dummy_request()], B, L))
+    return BuiltEntry(fn=fn, args=args, max_const_bytes=256)
+
+
+@register_entry("serve/tiger_paged_decode_step", tags=("serving", "paged"))
+def _graftlint_paged_decode_entry() -> BuiltEntry:
+    """The collapsed-shape paged decode step, jitted like
+    _PagedRunner._compile_decode on TPU (donation on; the engine only
+    disables it on CPU to silence the no-op warning). The slot-state
+    operand is overwritten by the write-back every step — undonated it
+    would double-buffer the whole slot ladder. The trie legality tables
+    are baked here exactly as in the dense path, so this entry pins the
+    same 256 B constant threshold (known debt, baselined — ROADMAP:
+    trie as a runtime operand)."""
+    from genrec_tpu.serving.engine import PAGED_DECODE_DONATE_ARGNUMS
+    from genrec_tpu.serving.kv_pool import KVPagePool, PagedConfig
+
+    head, params, _B, _L = _tiny_tiger_head()
+    cfg = PagedConfig(max_slots=4, page_size=8, pages_per_slot=2)
+    pool = KVPagePool(cfg, *head.paged_layout())
+    S = cfg.max_slots
+    state = {k: jnp.asarray(v) for k, v in head.paged_state_zeros(S).items()}
+    # Same donate argnums production compiles (engine shares the
+    # constant); donation is requested unconditionally here because the
+    # audit reads the declaration, which CPU lowering preserves.
+    fn = jax.jit(head.make_decode_paged_fn(),
+                 donate_argnums=PAGED_DECODE_DONATE_ARGNUMS)
+    args = (
+        params, state,
+        jnp.zeros((S,), jnp.int32),
+        jnp.zeros((S, cfg.pages_per_slot), jnp.int32),
+        jnp.zeros((S,), jnp.int32),
+        pool.k_pools, pool.v_pools,
+    )
+    # expect_donated stays a LITERAL, independent of the shared constant:
+    # it states which buffers are dead (a fact about step()'s write-back),
+    # so emptying PAGED_DECODE_DONATE_ARGNUMS fails the audit instead of
+    # both sides silently agreeing on "no donation".
+    return BuiltEntry(fn=fn, args=args, expect_donated=(1,),
+                      max_const_bytes=256)

@@ -16,7 +16,7 @@ import numpy as np
 import optax
 
 from genrec_tpu import configlib
-from genrec_tpu.core.harness import make_train_step
+from genrec_tpu.core.harness import jit_train_step, make_train_step
 from genrec_tpu.core.logging import Tracker, setup_logger
 from genrec_tpu.core.profiling import ProfileWindow
 from genrec_tpu.core.state import TrainState
@@ -216,7 +216,7 @@ def train(
             "codebook_entropy": out.codebook_entropy,
         }
 
-    step_fn = jax.jit(make_train_step(loss_fn, optimizer, clip_norm=1.0), donate_argnums=0)
+    step_fn = jit_train_step(make_train_step(loss_fn, optimizer, clip_norm=1.0))
     state = replicate(mesh, TrainState.create(params, optimizer, state_rng))
     # Reference eval: n_candidates=10 of n_beam=20 (cobra_trainer.py:433-435);
     # clamped so small-beam debug runs stay valid.

@@ -14,7 +14,7 @@ import numpy as np
 import optax
 
 from genrec_tpu import configlib
-from genrec_tpu.core.harness import make_train_step
+from genrec_tpu.core.harness import jit_train_step, make_train_step
 from genrec_tpu.core.logging import Tracker, setup_logger
 from genrec_tpu.core.profiling import ProfileWindow
 from genrec_tpu.core.state import TrainState
@@ -208,7 +208,7 @@ def train(
             aux["real_tokens"] = jnp.sum(batch["segment_ids"] != 0).astype(jnp.float32)
         return loss, aux
 
-    step_fn = jax.jit(make_train_step(loss_fn, optimizer, clip_norm=None), donate_argnums=0)
+    step_fn = jit_train_step(make_train_step(loss_fn, optimizer, clip_norm=None))
     state = replicate(mesh, TrainState.create(params, optimizer, state_rng))
     eval_step = make_eval_step(model)
 

@@ -21,7 +21,7 @@ import numpy as np
 import optax
 
 from genrec_tpu import configlib
-from genrec_tpu.core.harness import make_train_step
+from genrec_tpu.core.harness import jit_train_step, make_train_step
 from genrec_tpu.core.logging import Tracker, setup_logger
 from genrec_tpu.core.profiling import ProfileWindow
 from genrec_tpu.core.lora import lora_init, lora_merge, lora_param_count
@@ -550,7 +550,7 @@ def train(
         trainable = params
         params_of = lambda tp: tp
 
-    step_fn = jax.jit(make_train_step(loss_fn, optimizer, clip_norm=1.0), donate_argnums=0)
+    step_fn = jit_train_step(make_train_step(loss_fn, optimizer, clip_norm=1.0))
     from genrec_tpu.parallel.shardings import make_place_state, moe_rules, qwen_rules
 
     rules = (

@@ -463,8 +463,13 @@ class PackedTrainLoop:
             report = self.goodput.end_epoch()
             log_goodput(self.logger, self.tracker, epoch, report)
             if jax.process_count() > 1:
+                # obs imports nothing upward (graftlint layering): the
+                # collective is injected from the runtime layer here.
+                from genrec_tpu.parallel.mesh import allgather_host_ints
+
                 log_goodput(self.logger, self.tracker, epoch,
-                            fleet_goodput(report), fleet=True)
+                            fleet_goodput(report, allgather_host_ints),
+                            fleet=True)
         self._flight.record("epoch_end", epoch=epoch, global_step=global_step,
                             n_batches=n_batches)
         return EpochResult(state, global_step, False, n_batches)

@@ -25,7 +25,7 @@ import numpy as np
 import optax
 
 from genrec_tpu import configlib
-from genrec_tpu.core.harness import make_train_step
+from genrec_tpu.core.harness import jit_train_step, make_train_step
 from genrec_tpu.core.logging import Tracker, setup_logger
 from genrec_tpu.core.profiling import ProfileWindow
 from genrec_tpu.core.state import TrainState
@@ -206,9 +206,7 @@ def train(
             "p_unique_ids": out.p_unique_ids,
         }
 
-    step_fn = jax.jit(
-        make_train_step(loss_fn, optimizer, clip_norm=1.0), donate_argnums=0
-    )
+    step_fn = jit_train_step(make_train_step(loss_fn, optimizer, clip_norm=1.0))
     state = replicate(mesh, TrainState.create(params, optimizer, state_rng))
 
     @jax.jit

@@ -18,7 +18,7 @@ import numpy as np
 import optax
 
 from genrec_tpu import configlib
-from genrec_tpu.core.harness import make_train_step
+from genrec_tpu.core.harness import jit_train_step, make_train_step
 from genrec_tpu.core.logging import Tracker, setup_logger
 from genrec_tpu.core.profiling import ProfileWindow
 from genrec_tpu.core.state import TrainState
@@ -211,7 +211,7 @@ def train(
             aux["real_tokens"] = jnp.sum(batch["segment_ids"] != 0).astype(jnp.float32)
         return loss, aux
 
-    step_fn = jax.jit(make_train_step(loss_fn, optimizer, clip_norm=None), donate_argnums=0)
+    step_fn = jit_train_step(make_train_step(loss_fn, optimizer, clip_norm=None))
     state = replicate(mesh, TrainState.create(params, optimizer, state_rng))
     # One jit cache for every eval call; packed training reads predictions
     # from the last valid slot of right-padded eval rows.
@@ -281,6 +281,49 @@ def train(
         save_params(os.path.join(save_dir_root, "best_model"), final_params)
     loop.shutdown()
     return valid_metrics, test_metrics
+
+
+# ---------------------------------------------------------------------------
+# graftlint compile manifest (scripts/graftlint.py, docs/ANALYSIS.md)
+# ---------------------------------------------------------------------------
+
+from genrec_tpu.analysis.manifest import BuiltEntry, register_entry
+
+
+@register_entry("train/sasrec_packed_step", tags=("train", "packed"))
+def _graftlint_entry() -> BuiltEntry:
+    """CI-shape replica of this trainer's jitted step, SAME jit config as
+    train() above (make_train_step flags, donate_argnums=0): the IR rules
+    audit what production compiles, at sizes a CPU lowers in seconds."""
+    import numpy as np
+
+    model = SASRec(num_items=50, max_seq_len=16, embed_dim=16, num_heads=2,
+                   num_blocks=1, ffn_dim=32, dropout=0.0)
+    params = model.init(
+        jax.random.key(0), jnp.zeros((1, 16), jnp.int32), deterministic=True
+    )["params"]
+    optimizer = optax.adam(1e-3, b2=0.98)
+
+    def loss_fn(p, batch, step_rng):
+        _, loss = model.apply(
+            {"params": p}, batch["input_ids"], batch["targets"],
+            deterministic=False, segment_ids=batch["segment_ids"],
+            positions=batch["positions"], rngs={"dropout": step_rng},
+        )
+        return loss, {"real_tokens": jnp.sum(batch["segment_ids"] != 0).astype(jnp.float32)}
+
+    step_fn = jit_train_step(make_train_step(loss_fn, optimizer, clip_norm=None))
+    state = TrainState.create(params, optimizer, jax.random.key(1))
+    rng = np.random.default_rng(0)
+    batch = {
+        "input_ids": jnp.asarray(rng.integers(1, 51, (4, 16)), jnp.int32),
+        "targets": jnp.asarray(rng.integers(1, 51, (4, 16)), jnp.int32),
+        "segment_ids": jnp.asarray(rng.integers(0, 3, (4, 16)), jnp.int32),
+        "positions": jnp.asarray(np.tile(np.arange(16), (4, 1)), jnp.int32),
+    }
+    # The train state is consumed by the step (the trainer rebinds it);
+    # an undonated buffer there is a dead full-model copy in HBM.
+    return BuiltEntry(fn=step_fn, args=(state, batch), expect_donated=(0,))
 
 
 if __name__ == "__main__":

@@ -19,7 +19,7 @@ import numpy as np
 import optax
 
 from genrec_tpu import configlib
-from genrec_tpu.core.harness import make_train_step
+from genrec_tpu.core.harness import jit_train_step, make_train_step
 from genrec_tpu.core.logging import Tracker, setup_logger
 from genrec_tpu.core.profiling import ProfileWindow
 from genrec_tpu.core.state import TrainState
@@ -287,12 +287,11 @@ def train(
             )
             return out.loss, {}
 
-    step_fn = jax.jit(
+    step_fn = jit_train_step(
         make_train_step(
             loss_fn, optimizer,
             accum_steps=gradient_accumulate_every, clip_norm=1.0,
-        ),
-        donate_argnums=0,
+        )
     )
     from genrec_tpu.parallel.shardings import make_place_state, tiger_rules
 
@@ -346,6 +345,58 @@ def train(
         save_params(os.path.join(save_dir_root, "best_model"), final_params)
     loop.shutdown()
     return valid_metrics, test_metrics
+
+
+# ---------------------------------------------------------------------------
+# graftlint compile manifest (scripts/graftlint.py, docs/ANALYSIS.md)
+# ---------------------------------------------------------------------------
+
+from genrec_tpu.analysis.manifest import BuiltEntry, register_entry
+
+
+@register_entry("train/tiger_step", tags=("train",))
+def _graftlint_entry() -> BuiltEntry:
+    """CI-shape replica of this trainer's jitted step (unpacked path),
+    SAME jit config as train() above (accum/clip flags, donate_argnums=0)."""
+    import numpy as np
+
+    model = Tiger(embedding_dim=16, attn_dim=32, dropout=0.0, num_heads=4,
+                  n_layers=2, num_item_embeddings=8, num_user_embeddings=20,
+                  sem_id_dim=3)
+    D, B, items = 3, 4, 4
+    L = items * D
+    rng = np.random.default_rng(0)
+    user = jnp.asarray(rng.integers(0, 20, (B,)), jnp.int32)
+    ids = jnp.asarray(rng.integers(0, 8, (B, L)), jnp.int32)
+    types = jnp.asarray(np.tile(np.arange(D), (B, items)), jnp.int32)
+    tgt = jnp.asarray(rng.integers(0, 8, (B, D)), jnp.int32)
+    tgt_types = jnp.asarray(np.tile(np.arange(D), (B, 1)), jnp.int32)
+    mask = jnp.ones((B, L), jnp.int32)
+    params = model.init(
+        jax.random.key(0), user, ids, types, tgt, tgt_types, mask
+    )["params"]
+    optimizer = optax.adamw(1e-3, weight_decay=0.01)
+
+    def loss_fn(p, batch, step_rng):
+        out = model.apply(
+            {"params": p},
+            batch["user_ids"], batch["item_input_ids"],
+            batch["token_type_ids"], batch["target_ids"],
+            batch["target_token_type_ids"], batch["seq_mask"],
+            deterministic=False, rngs={"dropout": step_rng},
+        )
+        return out.loss, {}
+
+    step_fn = jit_train_step(
+        make_train_step(loss_fn, optimizer, accum_steps=1, clip_norm=1.0)
+    )
+    state = TrainState.create(params, optimizer, jax.random.key(1))
+    batch = {
+        "user_ids": user, "item_input_ids": ids, "token_type_ids": types,
+        "target_ids": tgt, "target_token_type_ids": tgt_types,
+        "seq_mask": mask,
+    }
+    return BuiltEntry(fn=step_fn, args=(state, batch), expect_donated=(0,))
 
 
 if __name__ == "__main__":

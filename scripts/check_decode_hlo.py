@@ -1,6 +1,9 @@
-"""HLO checklist for the KV-cached decode engine (pattern:
-scripts/check_fused_ce_hlo.py): does the compiled `tiger_generate` really
-avoid the K-fold memory expansion?
+"""HLO checklist for the KV-cached decode engine: does the compiled
+`tiger_generate` really avoid the K-fold memory expansion?
+
+Built on the shared graftlint IR harness (genrec_tpu/analysis/ir.py) —
+the CLI, verdict JSON and rc conventions are unchanged; only the
+duplicated lower/compile/emit plumbing moved there.
 
 Lowers the cached beam-decode loop (encoder + sem_id_dim cached decode
 steps, one jit program) and asserts:
@@ -11,7 +14,7 @@ steps, one jit program) and asserts:
      cached engine removes by keeping memory at batch size B and
      resolving beams with an einsum against cached K/V;
   2. the whole decode loop (encoder + all sem_id_dim cached steps) lowers
-     and compiles inside ONE jit program — `fn.lower(...).compile()`
+     and compiles inside ONE jit program — the harness's optimized_hlo
      succeeding over the full generate is what certifies it; a loop that
      needed per-step host round-trips could not be traced this way.
 
@@ -26,8 +29,6 @@ Appends a verdict line to docs/PERF.md when --write-note is passed.
 
 from __future__ import annotations
 
-import argparse
-import json
 import os
 import re
 import sys
@@ -35,19 +36,17 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+from genrec_tpu.analysis import ir  # noqa: E402
+
 
 def main(argv=None):
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--write-note", action="store_true",
-                    help="append the verdict to docs/PERF.md")
-    ap.add_argument("--small", action="store_true",
-                    help="tiny shapes for fast CI runs")
-    ap.add_argument("--platform", default=None)
-    args = ap.parse_args(argv)
+    args = ir.check_args(argv)
 
     import jax
 
     if args.platform:
+        # Platform pinning stays OUT of the leaf analysis package (its own
+        # layering rule): scripts import the runtime helper directly.
         from genrec_tpu.parallel.mesh import pin_platform
 
         pin_platform(args.platform)
@@ -88,13 +87,13 @@ def main(argv=None):
     )["params"]
 
     def hlo(use_cache: bool) -> str:
-        fn = jax.jit(
+        return ir.optimized_hlo(
             lambda p, key: tiger_generate(
                 model, p, trie, user, ids, types, mask, key,
                 n_top_k_candidates=K, use_cache=use_cache,
-            ).sem_ids
+            ).sem_ids,
+            params, jax.random.key(1),
         )
-        return fn.lower(params, jax.random.key(1)).compile().as_text()
 
     # The K-fold expanded memory: any tensor whose leading dims are
     # (B*K, Lm, ...) — XLA fuses the (B*K, Lm, d_model) broadcast into the
@@ -124,7 +123,7 @@ def main(argv=None):
         "regex_bites": regex_bites,
         "ok": ok,
     }
-    print(json.dumps(verdict))
+    ir.emit_verdict(verdict)
 
     if args.write_note:
         if ok:
@@ -135,15 +134,11 @@ def main(argv=None):
             )
         else:
             msg = "ATTENTION: inspect out/decode_hlo.txt"
-        note = (
+        ir.append_perf_note(
             f"\n- Decode HLO check (scripts/check_decode_hlo.py, backend="
             f"{backend}): {msg}\n"
         )
-        with open(os.path.join(REPO, "docs", "PERF.md"), "a") as f:
-            f.write(note)
-        os.makedirs(os.path.join(REPO, "out"), exist_ok=True)
-        with open(os.path.join(REPO, "out", "decode_hlo.txt"), "w") as f:
-            f.write(cached_hlo)
+        ir.dump_artifact("decode_hlo.txt", cached_hlo)
     return 0 if ok else 1
 
 

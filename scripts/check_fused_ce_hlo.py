@@ -2,6 +2,11 @@
 partition the compiled fused-CE train step without wrapping the
 pallas_call in unexpected full-gathers?
 
+Built on the shared graftlint IR harness (genrec_tpu/analysis/ir.py) —
+the CLI, verdict JSON and rc conventions (including rc 2 =
+ran-but-inconclusive) are unchanged; only the duplicated
+lower/compile/emit plumbing moved there.
+
 Jit the SASRec fused-CE train step under a {"data": n_devices} mesh with
 sharded-batch annotations and inspect the optimized HLO around the
 Mosaic custom call:
@@ -29,8 +34,6 @@ Appends a verdict line to docs/PERF.md when --write-note is passed
 
 from __future__ import annotations
 
-import argparse
-import json
 import os
 import re
 import sys
@@ -38,19 +41,20 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+from genrec_tpu.analysis import ir  # noqa: E402
+
 
 def main(argv=None):
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--write-note", action="store_true",
-                    help="append the verdict to docs/PERF.md")
-    ap.add_argument("--small", action="store_true",
-                    help="tiny shapes for fast CI runs (scripts/ci_checks.sh --smoke)")
-    ap.add_argument("--platform", default=None)
-    args = ap.parse_args(argv)
+    args = ir.check_args(
+        argv,
+        small_help="tiny shapes for fast CI runs (scripts/ci_checks.sh --smoke)",
+    )
 
     import jax
 
     if args.platform:
+        # Platform pinning stays OUT of the leaf analysis package (its own
+        # layering rule): scripts import the runtime helper directly.
         from genrec_tpu.parallel.mesh import pin_platform
 
         pin_platform(args.platform)
@@ -91,8 +95,7 @@ def main(argv=None):
         "input_ids": jax.device_put(ids, NamedSharding(mesh, P("data"))),
         "targets": jax.device_put(ids, NamedSharding(mesh, P("data"))),
     }
-    lowered = jax.jit(step).lower(state, batch)
-    hlo = lowered.compile().as_text()
+    hlo = ir.optimized_hlo(step, state, batch)
 
     custom_calls = re.findall(r".*custom-call.*tpu_custom_call.*", hlo)
     gathers = re.findall(r".*(all-gather|all-reduce|collective-permute).*", hlo)
@@ -137,7 +140,7 @@ def main(argv=None):
         "global_sized_custom_call_operands": len(global_sized),
         "ok": ok,
     }
-    print(json.dumps(verdict))
+    ir.emit_verdict(verdict)
 
     if args.write_note:
         if not conclusive:
@@ -156,16 +159,12 @@ def main(argv=None):
                    "operands are per-device-sized")
         else:
             msg = "ATTENTION: inspect out/fused_ce_hlo.txt"
-        note = (
+        ir.append_perf_note(
             f"\n- HLO check (scripts/check_fused_ce_hlo.py, backend="
             f"{backend}, {n_dev} device(s)): {len(custom_calls)} Mosaic "
             f"custom-call(s) -> {msg}\n"
         )
-        with open(os.path.join(REPO, "docs", "PERF.md"), "a") as f:
-            f.write(note)
-        os.makedirs(os.path.join(REPO, "out"), exist_ok=True)
-        with open(os.path.join(REPO, "out", "fused_ce_hlo.txt"), "w") as f:
-            f.write(hlo)
+        ir.dump_artifact("fused_ce_hlo.txt", hlo)
     # rc: 0 = verified good; 2 = ran fine but inconclusive (1 device or
     # non-TPU backend, where Mosaic cannot appear at all); 1 = a check
     # failed (including a TPU run whose kernel vanished from the module).

@@ -1,6 +1,10 @@
 #!/usr/bin/env python
 """Obs smoke: traced serve + train loops, schema checks, overhead bound.
 
+Built on the shared graftlint harness (genrec_tpu/analysis/ir.py) for the
+CLI and one-verdict-JSON conventions; CLI, verdict schema and rc are
+unchanged.
+
 What it proves (the ISSUE-7 acceptance, CI-sized):
 
 1. A single served request through the PAGED generative path yields a
@@ -24,7 +28,6 @@ Usage: python scripts/check_obs.py [--small] [--platform cpu]
 
 from __future__ import annotations
 
-import argparse
 import json
 import os
 import sys
@@ -33,6 +36,8 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+
+from genrec_tpu.analysis import ir  # noqa: E402
 
 
 def log(msg: str) -> None:
@@ -233,14 +238,13 @@ def check_disabled_overhead(mean_latency_s: float) -> dict:
 
 
 def main(argv=None) -> int:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--small", action="store_true",
-                    help="CI shapes (this check is already small)")
-    ap.add_argument("--platform", default=None,
-                    help="pin a jax platform (e.g. cpu)")
-    ap.add_argument("--write-note", action="store_true",
-                    help="accepted for ci_checks.sh symmetry (no-op)")
-    args = ap.parse_args(argv)
+    args = ir.check_args(
+        argv,
+        small_help="CI shapes (this check is already small)",
+        note_help="accepted for ci_checks.sh symmetry (no-op)",
+    )
+    # Env-var pin (not mesh.pin_platform): this check spawns engine and
+    # train-loop threads that must all see the platform choice.
     if args.platform:
         os.environ["JAX_PLATFORMS"] = args.platform
 
@@ -254,7 +258,7 @@ def main(argv=None) -> int:
     except AssertionError as e:
         verdict["error"] = str(e)
         log(f"FAILED: {e}")
-    print(json.dumps(verdict))
+    ir.emit_verdict(verdict)
     return 0 if verdict["ok"] else 1
 
 

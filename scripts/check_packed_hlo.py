@@ -1,6 +1,9 @@
-"""HLO checklist for packed-sequence training (pattern:
-scripts/check_decode_hlo.py): does the compiled packed SASRec train step
-stay in the packed (rows, row_len) layout end to end?
+"""HLO checklist for packed-sequence training: does the compiled packed
+SASRec train step stay in the packed (rows, row_len) layout end to end?
+
+Built on the shared graftlint IR harness (genrec_tpu/analysis/ir.py) —
+the CLI, verdict JSON and rc conventions are unchanged; only the
+duplicated lower/compile/emit plumbing moved there.
 
 A naive implementation would "re-pad" per example somewhere in the step —
 scattering each segment back into its own (n_examples, row_len) row to
@@ -27,8 +30,6 @@ Appends a verdict line to docs/PERF.md when --write-note is passed.
 
 from __future__ import annotations
 
-import argparse
-import json
 import os
 import re
 import sys
@@ -36,19 +37,17 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+from genrec_tpu.analysis import ir  # noqa: E402
+
 
 def main(argv=None):
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--write-note", action="store_true",
-                    help="append the verdict to docs/PERF.md")
-    ap.add_argument("--small", action="store_true",
-                    help="tiny shapes for fast CI runs")
-    ap.add_argument("--platform", default=None)
-    args = ap.parse_args(argv)
+    args = ir.check_args(argv)
 
     import jax
 
     if args.platform:
+        # Platform pinning stays OUT of the leaf analysis package (its own
+        # layering rule): scripts import the runtime helper directly.
         from genrec_tpu.parallel.mesh import pin_platform
 
         pin_platform(args.platform)
@@ -99,7 +98,7 @@ def main(argv=None):
 
     step = make_train_step(loss_fn, optimizer, clip_norm=None)
     state = TrainState.create(params, optimizer, jax.random.key(1))
-    hlo = jax.jit(step).lower(state, batch).compile().as_text()
+    hlo = ir.optimized_hlo(step, state, batch)
 
     # The per-example re-pad: a scatter producing an
     # (n_examples, row_len, ...)-shaped tensor. HLO shapes print as
@@ -123,10 +122,8 @@ def main(argv=None):
             tokens.reshape(-1)
         )
 
-    self_hlo = (
-        jax.jit(unpack)
-        .lower(batch["input_ids"], batch["segment_ids"], batch["positions"])
-        .compile().as_text()
+    self_hlo = ir.optimized_hlo(
+        unpack, batch["input_ids"], batch["segment_ids"], batch["positions"]
     )
     self_lines = [l for l in self_hlo.splitlines() if "scatter" in l]
     regex_bites = any(repad_re.search(l) for l in self_lines)
@@ -139,13 +136,13 @@ def main(argv=None):
         "scatter_ops_in_step": len(scatter_lines),
         "repad_scatter_hits": len(repad_hits),
         # True by reaching this point: packed fwd+bwd+optimizer lowered
-        # and compiled as one jit program (the .compile() above raises
+        # and compiled as one jit program (optimized_hlo raises
         # otherwise).
         "compiled_one_program": True,
         "regex_bites": regex_bites,
         "ok": ok,
     }
-    print(json.dumps(verdict))
+    ir.emit_verdict(verdict)
 
     if args.write_note:
         if ok:
@@ -157,15 +154,11 @@ def main(argv=None):
             )
         else:
             msg = "ATTENTION: inspect out/packed_hlo.txt"
-        note = (
+        ir.append_perf_note(
             f"\n- Packed-step HLO check (scripts/check_packed_hlo.py, "
             f"backend={backend}): {msg}\n"
         )
-        with open(os.path.join(REPO, "docs", "PERF.md"), "a") as f:
-            f.write(note)
-        os.makedirs(os.path.join(REPO, "out"), exist_ok=True)
-        with open(os.path.join(REPO, "out", "packed_hlo.txt"), "w") as f:
-            f.write(hlo)
+        ir.dump_artifact("packed_hlo.txt", hlo)
     return 0 if ok else 1
 
 

@@ -1,5 +1,6 @@
-"""Steady-state compilation check for the serving engine (pattern:
-scripts/check_decode_hlo.py): does the bucketed compilation ladder — and
+"""Steady-state compilation check for the serving engine (built on the
+shared graftlint harness, genrec_tpu/analysis/ir.py — CLI, verdict JSON
+and rc conventions unchanged): does the bucketed compilation ladder — and
 the paged decode path's collapsed shape set — really make the serving
 path shape-stable?
 
@@ -27,13 +28,13 @@ Prints ONE JSON verdict line on stdout; rc 0 ok / 1 failed.
 
 from __future__ import annotations
 
-import argparse
-import json
 import os
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+
+from genrec_tpu.analysis import ir  # noqa: E402
 
 
 def _drive_dense(engine, head, valid_ids, n_requests, max_hist, n_users, rng):
@@ -91,17 +92,13 @@ def _drive_churn(engine, head, valid_ids, n_requests, max_hist, n_users, rng):
 
 
 def main(argv=None):
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--write-note", action="store_true",
-                    help="append the verdict to docs/PERF.md")
-    ap.add_argument("--small", action="store_true",
-                    help="tiny shapes for fast CI runs")
-    ap.add_argument("--platform", default=None)
-    args = ap.parse_args(argv)
+    args = ir.check_args(argv)
 
     import jax
 
     if args.platform:
+        # Platform pinning stays OUT of the leaf analysis package (its own
+        # layering rule): scripts import the runtime helper directly.
         from genrec_tpu.parallel.mesh import pin_platform
 
         pin_platform(args.platform)
@@ -206,7 +203,7 @@ def main(argv=None):
         + phases["paged"]["recompilations"],
         "ok": ok,
     }
-    print(json.dumps(verdict))
+    ir.emit_verdict(verdict)
 
     if args.write_note:
         if ok:
@@ -219,12 +216,10 @@ def main(argv=None):
             )
         else:
             msg = "ATTENTION: serving engine recompiled in steady state"
-        note = (
+        ir.append_perf_note(
             f"\n- Serving HLO check (scripts/check_serving_hlo.py, backend="
             f"{backend}): {msg}\n"
         )
-        with open(os.path.join(REPO, "docs", "PERF.md"), "a") as f:
-            f.write(note)
     return 0 if ok else 1
 
 

@@ -14,6 +14,13 @@
 #                            goodput buckets sum to wall time with
 #                            strict-JSON metrics.jsonl, and tracing-off
 #                            overhead stays under the 2% budget
+#   graftlint.py           — repo-wide static analysis (ISSUE 8): AST
+#                            layering/trace-purity/lock-discipline +
+#                            IR rules (constant bake, donation audit,
+#                            f64, host transfers in loops) over the
+#                            compile manifest; fails on NEW findings
+#                            (pre-existing debt lives in
+#                            genrec_tpu/analysis/baseline.json)
 #   kv_pool / paged parity — page-allocator churn property tests + paged
 #                            decode == dense-cache parity (TIGER, COBRA)
 #   serving smoke          — CPU in-process engine: all four heads answer,
@@ -90,6 +97,13 @@ if [ "$MODE" = "--smoke" ]; then
     if [ -z "${GENREC_CI_SKIP_OBS:-}" ]; then
         run python scripts/check_obs.py --small --platform cpu
     fi
+    # graftlint (AST + IR over the compile manifest). GENREC_CI_SKIP_LINT=1
+    # skips it for callers whose pytest pass already runs
+    # tests/test_analysis.py directly (same contract as the obs/chaos
+    # knobs).
+    if [ -z "${GENREC_CI_SKIP_LINT:-}" ]; then
+        run python scripts/graftlint.py --small --platform cpu
+    fi
     # Chaos-unit subset (checkpoint corruption, non-finite guard, signal
     # latching; no trainer runs) — pytest output goes to stderr so the
     # entrypoint's stdout stays one verdict JSON per HLO check.
@@ -124,6 +138,7 @@ else
     run python scripts/check_packed_hlo.py --write-note
     run python scripts/check_serving_hlo.py --write-note
     run python scripts/check_obs.py
+    run python scripts/graftlint.py
     # Full serving suite (incl. the slow all-four-heads drain test, the
     # slow COBRA trie-constraint pins, and the full paged-parity matrix).
     run_strict env JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py \

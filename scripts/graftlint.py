@@ -1,0 +1,152 @@
+#!/usr/bin/env python
+"""graftlint: the repo-wide static-analysis gate (ISSUE 8).
+
+Level 2 (AST, fast, no jax): layering generated from docs/architecture.md,
+trace purity inside jit/scan/shard_map'd functions, lock-held blocking
+calls in the threaded serving/obs layers.
+
+Level 1 (IR): lowers every compile-manifest entry point (registered by
+trainers and serving heads — analysis/manifest.py) and runs the IR rules:
+constant bake over threshold, donation audit, f64 discipline, host
+transfers inside device loop bodies.
+
+Verdict: ONE JSON line on stdout (ci_checks.sh convention); human detail
+on stderr. A checked-in suppression baseline
+(genrec_tpu/analysis/baseline.json) keeps pre-existing findings from
+failing CI while NEW findings do; stale baseline entries are reported so
+the file shrinks as debt is paid.
+
+Exit codes: 0 = clean modulo baseline; 1 = new findings (or an entry
+failed to build/lower).
+
+Usage:
+  python scripts/graftlint.py                     # both levels
+  python scripts/graftlint.py --ast-only          # skip IR (no jax needed)
+  python scripts/graftlint.py --ir-only
+  python scripts/graftlint.py --update-baseline   # re-baseline ALL current
+  python scripts/graftlint.py --small --platform cpu   # ci_checks symmetry
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DEFAULT_BASELINE = os.path.join(REPO, "genrec_tpu", "analysis", "baseline.json")
+
+
+def log(msg: str) -> None:
+    print(f"graftlint: {msg}", file=sys.stderr)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    level = ap.add_mutually_exclusive_group()
+    level.add_argument("--ast-only", action="store_true",
+                       help="run only the AST linter (no jax import)")
+    level.add_argument("--ir-only", action="store_true",
+                       help="run only the IR analyzer")
+    ap.add_argument("--small", action="store_true",
+                    help="accepted for ci_checks.sh symmetry (manifest "
+                         "entries are already CI-sized)")
+    ap.add_argument("--platform", default=None,
+                    help="pin a jax platform for the IR level (e.g. cpu)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="suppression baseline path")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="write ALL current findings as the new baseline "
+                         "and exit 0")
+    ap.add_argument("--max-const-bytes", type=int, default=None,
+                    help="constant-bake threshold override (bytes)")
+    ap.add_argument("--max-report", type=int, default=20,
+                    help="max findings echoed into the verdict JSON")
+    args = ap.parse_args(argv)
+
+    if args.update_baseline and (args.ast_only or args.ir_only):
+        # A partial run cannot see the other level's findings; rewriting
+        # the baseline from it would silently DROP the other level's
+        # suppressions and fail the next full CI run on already-tracked
+        # debt. Refuse instead.
+        ap.error("--update-baseline requires a both-level run "
+                 "(drop --ast-only/--ir-only)")
+
+    from genrec_tpu.analysis import findings as F
+    from genrec_tpu.analysis import lint
+
+    all_findings: list[F.Finding] = []
+    levels_run = []
+    entry_stats: dict = {}
+
+    if not args.ir_only:
+        ast_findings = lint.lint_repo(REPO)
+        all_findings += ast_findings
+        levels_run.append("ast")
+        log(f"AST level: {len(ast_findings)} finding(s) over "
+            f"{sum(1 for _ in lint.iter_source_files(REPO))} files")
+
+    if not args.ast_only:
+        from genrec_tpu.analysis import ir, manifest
+
+        import jax  # noqa: F401 — the IR level needs a backend
+
+        if args.platform:
+            # Pinning lives in the runtime layer; the driver (not the leaf
+            # analysis package) is the one allowed to import it.
+            from genrec_tpu.parallel.mesh import pin_platform
+
+            pin_platform(args.platform)
+        entries = manifest.load_default_entries()
+        kw = {}
+        if args.max_const_bytes is not None:
+            kw["max_const_bytes"] = args.max_const_bytes
+        ir_findings, entry_stats = ir.analyze_manifest(entries, **kw)
+        all_findings += ir_findings
+        levels_run.append("ir")
+        log(f"IR level: {len(ir_findings)} finding(s) over "
+            f"{len(entries)} manifest entries")
+
+    if args.update_baseline:
+        F.save_baseline(args.baseline, all_findings)
+        log(f"baseline updated: {len({f.fingerprint for f in all_findings})} "
+            f"suppression(s) -> {args.baseline}")
+
+    baseline = F.load_baseline(args.baseline)
+    new, baselined, stale = F.split_by_baseline(all_findings, baseline)
+    # A partial run (--ast-only / --ir-only) never sees the other level's
+    # findings; its baseline entries would all read stale. Only a
+    # both-level run may report staleness.
+    if len(levels_run) < 2:
+        stale = []
+
+    for f in new:
+        log(f"NEW {f.fingerprint}: {f.message}")
+    for f in baselined:
+        log(f"baselined {f.fingerprint}")
+    for fp in stale:
+        log(f"STALE baseline entry (remove it): {fp}")
+
+    metrics = F.summary_metrics(all_findings, new, baselined, stale)
+    ok = not new
+    verdict = {
+        "check": "graftlint",
+        "ok": ok,
+        "levels": levels_run,
+        "findings": len(all_findings),
+        "new": len(new),
+        "baselined": len(baselined),
+        "stale_baseline": len(stale),
+        "entries": entry_stats,
+        "new_findings": [f.to_dict() for f in new[: args.max_report]],
+        "metrics": metrics,
+    }
+    print(json.dumps(verdict))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

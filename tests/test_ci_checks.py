@@ -1,9 +1,12 @@
 """scripts/ci_checks.sh — the single entrypoint for the standalone static
-checks — plus a fast in-process run of the new packed-step HLO check.
+checks — plus fast in-process runs of the packed/fused HLO checks and
+verdict-schema parity pins for the check_* scripts' PR-8 migration onto
+the shared analysis/ir.py harness.
 
-The full smoke invocation (all three checks through the shell entrypoint)
+The full smoke invocation (all checks through the shell entrypoint)
 is exercised once; check_decode_hlo additionally has its own in-process
-CI wrapper (tests/test_check_decode_hlo.py)."""
+CI wrapper (tests/test_check_decode_hlo.py), and graftlint has
+tests/test_analysis.py."""
 
 import importlib.util
 import json
@@ -12,6 +15,19 @@ import subprocess
 import sys
 
 REPO = os.path.join(os.path.dirname(__file__), "..")
+
+# Bit-compat pins for the ISSUE-8 refactor: the migrated scripts must
+# emit EXACTLY the verdict keys their consumers grep/parse.
+DECODE_KEYS = {"backend", "shapes", "cached_broadcast_hits",
+               "uncached_broadcast_hits", "compiled_one_program",
+               "regex_bites", "ok"}
+PACKED_KEYS = {"backend", "shapes", "scatter_ops_in_step",
+               "repad_scatter_hits", "compiled_one_program",
+               "regex_bites", "ok"}
+FUSED_KEYS = {"backend", "devices", "conclusive", "mosaic_custom_calls",
+              "collectives_in_module", "all_gather_feeding_custom_call",
+              "global_sized_custom_call_operands", "ok"}
+SERVING_KEYS = {"backend", "dense", "paged", "recompilations", "ok"}
 
 
 def _load(name):
@@ -33,6 +49,7 @@ def test_packed_hlo_check_small(capsys):
     )
     assert verdict["repad_scatter_hits"] == 0, verdict
     assert verdict["compiled_one_program"]
+    assert set(verdict) == PACKED_KEYS  # harness migration parity
     assert rc == 0
 
 
@@ -43,21 +60,39 @@ def test_fused_ce_hlo_check_small_is_inconclusive_not_failed(capsys):
     rc = mod.main(["--small"])
     verdict = json.loads(capsys.readouterr().out)
     assert verdict["conclusive"] is False
+    assert set(verdict) == FUSED_KEYS  # harness migration parity
     assert rc == 2
+
+
+def test_check_scripts_keep_their_cli():
+    """The shared harness must preserve every script's flag surface
+    (ci_checks.sh and the watchdog pass these exact flags)."""
+    for script in ("check_decode_hlo", "check_packed_hlo",
+                   "check_fused_ce_hlo", "check_serving_hlo", "check_obs"):
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts", f"{script}.py"),
+             "--help"],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 0, (script, proc.stderr[-500:])
+        for flag in ("--write-note", "--small", "--platform"):
+            assert flag in proc.stdout, (script, flag)
 
 
 def test_ci_checks_smoke_entrypoint():
     """The consolidated entrypoint runs every smoke check and exits 0
     (rc=2 inconclusives tolerated, real failures propagated)."""
-    # The chaos-unit and obs subsets are skipped here: this test runs
-    # INSIDE the suite that already executes tests/test_fault_tolerance.py
-    # and tests/test_obs.py directly, and nesting them would double-pay
+    # The chaos-unit, obs, and graftlint subsets are skipped here: this
+    # test runs INSIDE the suite that already executes
+    # tests/test_fault_tolerance.py, tests/test_obs.py and
+    # tests/test_analysis.py directly, and nesting them would double-pay
     # their cold-start (~30s each) for no coverage.
     proc = subprocess.run(
         ["bash", os.path.join(REPO, "scripts", "ci_checks.sh"), "--smoke"],
         capture_output=True, text=True, timeout=600,
         env={**os.environ, "JAX_PLATFORMS": "cpu",
-             "GENREC_CI_SKIP_CHAOS": "1", "GENREC_CI_SKIP_OBS": "1"},
+             "GENREC_CI_SKIP_CHAOS": "1", "GENREC_CI_SKIP_OBS": "1",
+             "GENREC_CI_SKIP_LINT": "1"},
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     # One verdict JSON per check on stdout (decode, fused-ce, packed,
@@ -66,3 +101,6 @@ def test_ci_checks_smoke_entrypoint():
     assert len(verdicts) == 4
     serving = [v for v in verdicts if "recompilations" in v]
     assert len(serving) == 1 and serving[0]["recompilations"] == 0
+    assert set(serving[0]) == SERVING_KEYS  # harness migration parity
+    decode = [v for v in verdicts if "cached_broadcast_hits" in v]
+    assert len(decode) == 1 and set(decode[0]) == DECODE_KEYS
