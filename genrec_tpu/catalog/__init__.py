@@ -1,0 +1,37 @@
+"""Live catalog subsystem: the item corpus as a versioned, swappable
+RUNTIME artifact.
+
+- `tensor_trie.TensorTrie` — the legal-item trie flattened into int32
+  tensors (node child CSR offsets + sorted keys, padded to a static
+  capacity ladder) and registered as a jax pytree, so constrained decode
+  takes it as a runtime OPERAND instead of baking tables into every
+  executable ("Vectorizing the Trie", arxiv 2602.22647).
+- `snapshot.CatalogSnapshot` — sem-id tuples + corpus lookup + optional
+  COBRA item-tower embeddings, content-hash versioned, with an atomic
+  on-disk format the serving watcher hot-swaps between micro-batches
+  (genrec_tpu/serving/catalog.py).
+
+See docs/SERVING.md ("Live catalog") for swap semantics.
+"""
+
+from genrec_tpu.catalog.snapshot import (
+    CatalogIntegrityError,
+    CatalogSnapshot,
+    list_snapshots,
+)
+from genrec_tpu.catalog.tensor_trie import (
+    MIN_CAPACITY,
+    PAD_KEY,
+    TensorTrie,
+    capacity_for,
+)
+
+__all__ = [
+    "CatalogIntegrityError",
+    "CatalogSnapshot",
+    "MIN_CAPACITY",
+    "PAD_KEY",
+    "TensorTrie",
+    "capacity_for",
+    "list_snapshots",
+]

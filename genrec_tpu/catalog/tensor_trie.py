@@ -1,0 +1,216 @@
+"""TensorTrie: the legal-item trie as a device-resident RUNTIME OPERAND.
+
+The `ops/trie` representations (DenseTrie boolean tables, PackedTrie
+sorted-key arrays) are correct and fast — but every serving executable
+that closes over one bakes the tables in as XLA literals: a catalog
+change recompiles every bucket, executable size scales with the corpus,
+and graftlint's `constant_bake` rule carried the debt as two baseline
+suppressions. "Vectorizing the Trie" (PAPERS.md, arxiv 2602.22647) gives
+the fix: flatten the trie into plain int32 tensors and pass them as
+runtime ARGUMENTS, with gather/segment ops replacing pointer chasing, so
+ONE compiled executable serves any catalog snapshot.
+
+Encoding — a rank-based child CSR, one row per depth:
+
+- ``keys``    (D, C) int32 — step t's sorted unique ``parent_rank * K +
+  code`` pairs (the CSR values, parent recoverable as ``key // K``),
+  padded to the static capacity C with ``PAD_KEY`` (int32 max, sorting
+  above every real key so binary search ignores the padding);
+- ``offsets`` (D, C+1) int32 — the CSR row index: node p's children at
+  step t occupy ``keys[t, offsets[t, p]:offsets[t, p+1]]``. Derived
+  from ``keys`` at build time; carried for segment reads and stats
+  (``n_nodes`` per step is ``offsets[t, -1]``).
+
+A prefix is represented by its RANK among the sorted valid prefixes of
+that length (exactly PackedTrie's representation, so the two agree
+rank-for-rank along every valid path); the dead-prefix sentinel is the
+static capacity C, whose candidate keys exceed every storable key.
+``legal_mask``/``advance`` are vmapped ``searchsorted`` gathers — no
+host sync, no Python loops — and the ragged variants gather the PER-ROW
+key row directly (``keys[steps]``) instead of the compute-all-depths
+row-select the heterogeneous-shape tries need.
+
+Capacity ladder: C is padded UP to a static rung (geometric, x4 from
+``MIN_CAPACITY``) so catalog snapshots of similar size share an aval —
+swapping them into a compiled executable is a pure operand change.
+Growth past a rung changes the aval and is the ONLY recompile, done AOT
+on the serving engine's staging thread (serving/catalog.py).
+
+TensorTrie is registered as a jax pytree (arrays are children,
+``codebook_size`` is static aux data), so it can be passed straight
+through ``jax.jit`` boundaries, lowered from ShapeDtypeStructs, and
+duck-types the DenseTrie/PackedTrie interface (``legal_mask`` /
+``advance`` / ``depth`` / ``codebook_size``) everywhere the models
+already take a ``trie`` argument.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+#: Padding key: int32 max sorts above every real key (< (C+1) * K, checked
+#: at build), so searchsorted over a padded row never lands on padding.
+PAD_KEY = np.iinfo(np.int32).max
+
+#: Smallest capacity rung. Rungs grow geometrically (x4): snapshots whose
+#: node counts land in the same rung share an executable.
+MIN_CAPACITY = 64
+CAPACITY_GROWTH = 4
+
+
+def capacity_for(n_nodes: int) -> int:
+    """The static capacity rung covering ``n_nodes`` trie nodes."""
+    c = MIN_CAPACITY
+    while c < n_nodes:
+        c *= CAPACITY_GROWTH
+    return c
+
+
+@jax.tree_util.register_pytree_node_class
+class TensorTrie:
+    """Flat tensor trie over sem-id tuples of depth D, codebook size K.
+
+    ``keys``/``offsets`` may be numpy arrays, jax arrays, tracers, or
+    ShapeDtypeStructs — the same object flows from the snapshot builder
+    through ``jax.jit`` lowering into the compiled call.
+    """
+
+    def __init__(self, keys, offsets, codebook_size: int):
+        self.keys = keys          # (D, C) int32, per-row sorted, PAD_KEY-padded
+        self.offsets = offsets    # (D, C+1) int32 CSR row index
+        self.codebook_size = int(codebook_size)
+
+    # -- pytree protocol (arrays are leaves, K is static) --------------------
+
+    def tree_flatten(self):
+        return (self.keys, self.offsets), (self.codebook_size,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        keys, offsets = children
+        return cls(keys, offsets, aux[0])
+
+    @property
+    def depth(self) -> int:
+        return int(self.keys.shape[0])
+
+    @property
+    def capacity(self) -> int:
+        return int(self.keys.shape[1])
+
+    # -- build ---------------------------------------------------------------
+
+    @classmethod
+    def build(cls, valid_ids: np.ndarray, codebook_size: int,
+              capacity: int | None = None) -> "TensorTrie":
+        """Flatten (N, D) legal tuples into the padded runtime encoding.
+
+        ``capacity`` pins an explicit rung (it must cover the widest
+        step); by default the smallest ladder rung covering the catalog
+        is used, so same-rung snapshots share executables.
+        """
+        valid_ids = np.asarray(valid_ids, np.int64)
+        if valid_ids.ndim != 2 or valid_ids.size == 0:
+            raise ValueError(f"need a (N, D) tuple table, got {valid_ids.shape}")
+        N, D = valid_ids.shape
+        K = int(codebook_size)
+        if valid_ids.min() < 0 or valid_ids.max() >= K:
+            raise ValueError(f"sem-id codes outside [0, {K})")
+        step_keys = []
+        rank = np.zeros(N, np.int64)
+        for t in range(D):
+            k = rank * K + valid_ids[:, t]
+            uniq = np.unique(k)
+            step_keys.append(uniq)
+            rank = np.searchsorted(uniq, k)
+        n_max = max(len(u) for u in step_keys)
+        C = capacity_for(n_max) if capacity is None else int(capacity)
+        if C < n_max:
+            raise ValueError(f"capacity {C} < {n_max} trie nodes at the widest step")
+        # The dead-prefix sentinel C must still produce int32 candidate
+        # keys below PAD_KEY: (C + 1) * K is the largest candidate formed.
+        if (C + 1) * K > PAD_KEY:
+            raise ValueError(
+                f"capacity {C} x codebook {K} overflows int32 keys; "
+                "a wider key dtype is needed for this corpus"
+            )
+        keys = np.full((D, C), PAD_KEY, np.int32)
+        offsets = np.zeros((D, C + 1), np.int32)
+        for t, uniq in enumerate(step_keys):
+            keys[t, : len(uniq)] = uniq
+            # CSR row starts: node p's children begin where key p*K would
+            # insert. Rows past the real node count collapse to empty
+            # segments at n_keys (PAD_KEY sorts above every probe).
+            offsets[t] = np.searchsorted(uniq, np.arange(C + 1) * K)
+        return cls(keys, offsets, K)
+
+    def device(self) -> "TensorTrie":
+        """The same trie with its tensors as jax device arrays."""
+        return TensorTrie(
+            jnp.asarray(self.keys), jnp.asarray(self.offsets), self.codebook_size
+        )
+
+    def n_nodes(self) -> list[int]:
+        """Real (unpadded) node count per step — build-time stats only."""
+        return [int(np.asarray(self.offsets[t, -1])) for t in range(self.depth)]
+
+    # -- the decode-loop interface (DenseTrie/PackedTrie-compatible) ---------
+
+    def legal_mask(self, prefix_idx: jax.Array, step: int) -> jax.Array:
+        """prefix_idx: (...,) ranks -> (..., K) bool of legal next codes."""
+        with jax.named_scope("trie_legal_mask"):
+            return self._mask_row(self.keys[step], prefix_idx)
+
+    def advance(self, prefix_idx: jax.Array, token: jax.Array, step: int) -> jax.Array:
+        """Rank of the extended prefix; dead/illegal -> sentinel capacity."""
+        return self._advance_row(self.keys[step], prefix_idx, token)
+
+    def legal_mask_ragged(self, prefix_idx: jax.Array, steps: jax.Array) -> jax.Array:
+        """Per-row step operand: prefix_idx (S, ...) + steps (S,) ->
+        (S, ..., K). The uniform (D, C) layout lets the row gather
+        ``keys[steps]`` replace the compute-all-depths select that
+        `ops/trie.legal_mask_ragged` needs for heterogeneous tables."""
+        with jax.named_scope("trie_legal_mask_ragged"):
+            row_keys = self.keys[steps]  # (S, C)
+            return jax.vmap(self._mask_row)(row_keys, prefix_idx)
+
+    def advance_ragged(self, prefix_idx: jax.Array, token: jax.Array,
+                       steps: jax.Array) -> jax.Array:
+        with jax.named_scope("trie_advance_ragged"):
+            row_keys = self.keys[steps]
+            return jax.vmap(self._advance_row)(row_keys, prefix_idx, token)
+
+    # -- shared row kernels (sorted-gather binary search) --------------------
+
+    def _mask_row(self, row_keys: jax.Array, prefix_idx: jax.Array) -> jax.Array:
+        K = self.codebook_size
+        cand = prefix_idx[..., None] * K + jnp.arange(K, dtype=jnp.int32)
+        pos = jnp.clip(jnp.searchsorted(row_keys, cand), 0, row_keys.shape[0] - 1)
+        return row_keys[pos] == cand
+
+    def _advance_row(self, row_keys: jax.Array, prefix_idx: jax.Array,
+                     token: jax.Array) -> jax.Array:
+        C = row_keys.shape[0]
+        key = prefix_idx * self.codebook_size + token
+        pos = jnp.clip(jnp.searchsorted(row_keys, key), 0, C - 1)
+        return jnp.where(row_keys[pos] == key, pos, C).astype(jnp.int32)
+
+    # -- misc ----------------------------------------------------------------
+
+    def aval_signature(self) -> tuple:
+        """The shape/dtype facts that decide executable compatibility: a
+        snapshot whose trie matches this signature swaps into a compiled
+        executable as a pure operand change (no recompile)."""
+        return (
+            tuple(int(s) for s in self.keys.shape),
+            tuple(int(s) for s in self.offsets.shape),
+            self.codebook_size,
+        )
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging nicety
+        return (
+            f"TensorTrie(depth={self.keys.shape[0]}, "
+            f"capacity={self.keys.shape[1]}, K={self.codebook_size})"
+        )

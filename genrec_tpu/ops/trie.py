@@ -134,7 +134,15 @@ def legal_mask_ragged(trie, prefix_idx: jax.Array, steps: jax.Array) -> jax.Arra
     Rows evaluated at a foreign step index clip/clamp into that step's
     table (jax gathers clamp out-of-range indices) — garbage, but never
     selected.
+
+    Tries with uniform per-step tables (catalog.TensorTrie, whose (D, C)
+    key table makes a direct row gather possible) implement the ragged
+    variants natively; this helper dispatches to them so the decode
+    paths stay trie-agnostic.
     """
+    own = getattr(trie, "legal_mask_ragged", None)
+    if own is not None:
+        return own(prefix_idx, steps)
     # named_scope: trie-masking ops group under one label in XLA profiler
     # traces, so host-side decode spans (obs/spans.py) line up with the
     # kernel time the constraint actually costs.
@@ -152,6 +160,9 @@ def legal_mask_ragged(trie, prefix_idx: jax.Array, steps: jax.Array) -> jax.Arra
 def advance_ragged(trie, prefix_idx: jax.Array, token: jax.Array,
                    steps: jax.Array) -> jax.Array:
     """`trie.advance` with a per-row step operand (see legal_mask_ragged)."""
+    own = getattr(trie, "advance_ragged", None)
+    if own is not None:
+        return own(prefix_idx, token, steps)
     with jax.named_scope("trie_advance_ragged"):
         sel_shape = steps.shape + (1,) * (prefix_idx.ndim - 1)
         out = None

@@ -1,8 +1,11 @@
 """Online serving: dynamic micro-batching, bucketed compilation,
 trie-constrained generative + sharded retrieval heads, hot checkpoint
-reload, graceful drain. See docs/SERVING.md for the architecture."""
+reload, hot catalog swap (the trie as a device-resident runtime operand,
+genrec_tpu/catalog/), graceful drain. See docs/SERVING.md for the
+architecture."""
 
 from genrec_tpu.serving.buckets import BucketLadder, default_ladder
+from genrec_tpu.serving.catalog import CatalogWatcher
 from genrec_tpu.serving.engine import ServingEngine
 from genrec_tpu.serving.kv_pool import (
     KVPagePool,
@@ -26,6 +29,7 @@ from genrec_tpu.serving.types import (
 
 __all__ = [
     "BucketLadder",
+    "CatalogWatcher",
     "CobraGenerativeHead",
     "DrainingError",
     "KVPagePool",

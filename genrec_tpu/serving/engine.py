@@ -33,6 +33,21 @@ restored tree is staged and swapped in by the batcher BETWEEN
 micro-batches (never mid-batch), so every request is answered by exactly
 one params version, reported as `Response.params_step`.
 
+Hot CATALOG swap (the live-catalog subsystem, genrec_tpu/catalog/):
+catalog heads take their legal-item trie as a RUNTIME OPERAND
+(`head.runtime_operands()`, threaded between params and the batch in
+every compiled call), so one executable serves any same-rung
+`CatalogSnapshot`. `stage_catalog()` — or a `CatalogWatcher` polling a
+snapshot directory (serving/catalog.py) — validates the snapshot (aval
+check against the live trie; a garbled file is quarantined, mirroring
+the params ladder) and stages it; the batcher applies it BETWEEN
+micro-batches, after paged slots drain, so no request ever mixes two
+catalog versions (`Response.catalog_version` beside `params_step`).
+Growth past a capacity rung changes the trie aval: the staging path
+precompiles replacement executables AOT on the staging thread (counted
+as `catalog_compiles`, never as steady-state recompilations) and the
+swap installs them atomically — the hot path never compiles.
+
 Graceful drain: a one-shot `PreemptionGuard` latches SIGTERM/SIGINT.
 On fire the engine finishes every in-flight and queued request, rejects
 new submissions with the typed `DrainingError`, and stops; a second
@@ -90,10 +105,13 @@ def _sds(tree):
 
 #: The slot-state operand of the paged decode step is dead after every
 #: call (step() overwrites it from the executable's output) and is
-#: donated. Shared with the graftlint manifest entry in serving/heads.py
-#: so the donation audit audits the SAME argnums production compiles —
-#: changing this constant changes both.
-PAGED_DECODE_DONATE_ARGNUMS = (1,)
+#: donated. The paged signature is (params, trie-operand, state, ...) —
+#: the trie (catalog.TensorTrie) is threaded, NOT donated: it survives
+#: every step and is swapped only by set_catalog. Shared with the
+#: graftlint manifest entry in serving/heads.py so the donation audit
+#: audits the SAME argnums production compiles — changing this constant
+#: changes both.
+PAGED_DECODE_DONATE_ARGNUMS = (2,)
 
 
 class _PagedRunner:
@@ -176,11 +194,13 @@ class _PagedRunner:
         # CPU has no buffer donation; avoid the per-call warning there.
         return argnums if jax.default_backend() != "cpu" else ()
 
-    def _compile_decode(self, S: int):
+    def _compile_decode(self, S: int, operands=None, catalog_compile=False):
         eng = self.engine
         fn = self.head.make_decode_paged_fn()
+        ops = operands if operands is not None else self.head.runtime_operands()
         args = (
             eng._select(self.head, eng._params),
+            *(_sds(op) for op in ops),  # trie operand: threaded, not baked
             _sds({k: v[:S] for k, v in self.state.items()}),
             jax.ShapeDtypeStruct((S,), np.int32),
             jax.ShapeDtypeStruct((S, self.cfg.pages_per_slot), np.int32),
@@ -195,25 +215,28 @@ class _PagedRunner:
         compiled = jax.jit(
             fn, donate_argnums=self._donate(*PAGED_DECODE_DONATE_ARGNUMS)
         ).lower(*args).compile()
-        eng.metrics.record_compile()
+        eng.metrics.record_compile(catalog=catalog_compile)
         return compiled
 
-    def _compile_prefill(self, B: int, L: int):
+    def _compile_prefill(self, B: int, L: int, operands=None,
+                         catalog_compile=False):
         eng = self.engine
         fn = self.head.make_prefill_paged_fn(B, L)
+        ops = operands if operands is not None else self.head.runtime_operands()
         batch = self.head.make_batch([self.head.dummy_request()], B, L)
-        n_batch = len(batch)
+        n = 1 + len(ops) + len(batch)  # params + operands + batch
         args = (
             eng._select(self.head, eng._params),
+            *(_sds(op) for op in ops),
             *batch,
             jax.ShapeDtypeStruct((B, self.cfg.pages_per_slot), np.int32),
             _sds(self.pool.k_pools),
             _sds(self.pool.v_pools),
         )
         compiled = jax.jit(
-            fn, donate_argnums=self._donate(n_batch + 2, n_batch + 3)
+            fn, donate_argnums=self._donate(n + 1, n + 2)  # k_pools, v_pools
         ).lower(*args).compile()
-        eng.metrics.record_compile()
+        eng.metrics.record_compile(catalog=catalog_compile)
         return compiled
 
     # -- admission (prefill into pages) --------------------------------------
@@ -304,8 +327,8 @@ class _PagedRunner:
         bt = np.zeros((B, self.cfg.pages_per_slot), np.int32)
         bt[: len(slots)] = self.pool.block_tables[slots]
         k_pools, v_pools, init = compiled(
-            eng._select(head, eng._params), *args, jnp.asarray(bt),
-            self.pool.k_pools, self.pool.v_pools,
+            eng._select(head, eng._params), *head.runtime_operands(), *args,
+            jnp.asarray(bt), self.pool.k_pools, self.pool.v_pools,
         )
         self.pool.k_pools, self.pool.v_pools = k_pools, v_pools
         n = len(slots)
@@ -352,6 +375,7 @@ class _PagedRunner:
         t0 = time.monotonic()
         out = self._decode[S](
             eng._select(self.head, eng._params),
+            *self.head.runtime_operands(),
             {k: jnp.asarray(v[:S]) for k, v in self.state.items()},
             jnp.asarray(np.where(self.active[:S], self.steps[:S], 0).astype(np.int32)),
             jnp.asarray(self.pool.block_tables[:S]),
@@ -387,12 +411,20 @@ class _PagedRunner:
         total = head.paged_total_steps
         done = np.nonzero(self.active & (self.steps >= total))[0]
         step_id = eng._step
+        # Stable while any slot is active: catalog swaps barrier on slot
+        # drain, so every finished request decoded under THIS version.
+        cat_version = head.catalog_version
         for slot in done:
             req, fut, t_enq, tr, t_admit = self.entries[slot]
             t_done = time.monotonic()
             try:
+                # COPY the slot's state row: a bare v[slot] is a numpy
+                # VIEW into the live slot buffer, and the payload arrays
+                # built from it would silently change when the slot is
+                # reused by a later admission (observed as responses
+                # "mixing" catalog versions after a hot swap).
                 payload = head.paged_finalize(
-                    {k: v[slot] for k, v in self.state.items()}, req
+                    {k: np.array(v[slot]) for k, v in self.state.items()}, req
                 )
                 now = time.monotonic()
                 resp = Response(
@@ -401,6 +433,7 @@ class _PagedRunner:
                     scores=payload["scores"],
                     sem_ids=payload.get("sem_ids"),
                     params_step=step_id,
+                    catalog_version=cat_version,
                     bucket=self.buckets[slot],
                     queue_wait_s=t_admit - t_enq,
                     compute_s=now - t_admit,
@@ -450,6 +483,8 @@ class ServingEngine:
         max_wait_ms: float = 4.0,
         ckpt_dir: Optional[str] = None,
         ckpt_poll_secs: float = 2.0,
+        catalog_dirs: Optional[dict] = None,
+        catalog_poll_secs: float = 2.0,
         params_step: Optional[int] = None,
         params_by_head: Optional[bool] = None,
         handle_signals: bool = True,
@@ -491,6 +526,17 @@ class ServingEngine:
         self._runners: dict[str, _PagedRunner] = {}
         self._ckpt_dir = ckpt_dir
         self._ckpt_poll_secs = ckpt_poll_secs
+        # Catalog watcher config: {head_name: snapshot_dir}. Watchers poll
+        # for new CatalogSnapshot files and stage them through
+        # stage_catalog (serving/catalog.py).
+        self._catalog_dirs = dict(catalog_dirs or {})
+        self._catalog_poll_secs = catalog_poll_secs
+        for name in self._catalog_dirs:
+            if name not in self._heads:
+                raise ValueError(f"catalog_dirs names unknown head {name!r}")
+            if not getattr(self._heads[name], "supports_catalog", False):
+                raise ValueError(f"head {name!r} has no swappable catalog")
+        self._catalog_watchers: list = []
         self._handle_signals = handle_signals
         self._guard = guard
         self._log = logger or logging.getLogger("genrec_tpu")
@@ -506,6 +552,13 @@ class ServingEngine:
         self._work = threading.Condition(self._lock)
         self._queues = {name: collections.deque() for name in self._heads}
         self._pending_params = None  # (tree, step) staged by the watcher
+        # {head_name: (snapshot, dense_exec | None, runner_exec | None)}
+        # staged by stage_catalog; applied by the batcher between batches.
+        self._pending_catalog: dict[str, tuple] = {}
+        # Serializes concurrent stage_catalog callers (watchers + manual
+        # stagers); never taken by the batcher, so no ordering cycle with
+        # _lock (which stage_catalog takes nested, briefly).
+        self._stage_lock = threading.Lock()
         self._rr = 0  # round-robin head cursor (_next_batch)
         self._draining = False
         self._stop_watch = threading.Event()
@@ -543,6 +596,16 @@ class ServingEngine:
                 target=self._watch_loop, name="serving-ckpt-watcher", daemon=True
             )
             self._watcher.start()
+        if self._catalog_dirs:
+            from genrec_tpu.serving.catalog import CatalogWatcher
+
+            for name, directory in self._catalog_dirs.items():
+                w = CatalogWatcher(
+                    self, name, directory,
+                    poll_secs=self._catalog_poll_secs, logger=self._log,
+                )
+                w.start()
+                self._catalog_watchers.append(w)
         self._batcher = threading.Thread(
             target=self._batch_loop, name="serving-batcher", daemon=True
         )
@@ -599,6 +662,9 @@ class ServingEngine:
             self._work.notify_all()
         self._flight.record("serving_stop", completed=self.metrics.completed)
         self._stop_watch.set()
+        for w in self._catalog_watchers:
+            w.stop(timeout)
+        self._catalog_watchers = []
         if self._batcher is not None:
             self._batcher.join(timeout)
         if self._watcher is not None:
@@ -707,10 +773,12 @@ class ServingEngine:
                             "in-flight requests, rejecting new submissions"
                         )
                     swap_pending = self._apply_pending_params()
+                    swap_pending |= self._apply_pending_catalog()
                     # Slot-level continuous batching: admit queued requests
-                    # into free slots (paused while a params swap is
-                    # staged, so every request decodes under ONE version),
-                    # then advance every active slot one decode step.
+                    # into free slots (paused while a params OR catalog
+                    # swap is staged, so every request decodes under ONE
+                    # version of each), then advance every active slot
+                    # one decode step.
                     progressed = False
                     for runner in self._runners.values():
                         if not swap_pending:
@@ -775,10 +843,13 @@ class ServingEngine:
         L_nat = max((head.natural_len(r) for r in reqs), default=1)
         L = self._ladder.history_bucket(max(L_nat, 1))
         B = self._ladder.batch_bucket(len(reqs))
+        cat_version = head.catalog_version  # stable: swaps apply on this thread
         try:
             args = head.make_batch(reqs, B, L)
             compiled = self._get_executable(head, B, L)
-            out = compiled(self._select(head, self._params), *args)
+            out = compiled(
+                self._select(head, self._params), *head.runtime_operands(), *args
+            )
             out = jax.tree_util.tree_map(np.asarray, out)  # host sync
             t_done = time.monotonic()
             payloads = head.finalize(out, reqs)
@@ -804,6 +875,7 @@ class ServingEngine:
                 scores=payload["scores"],
                 sem_ids=payload.get("sem_ids"),
                 params_step=step,
+                catalog_version=cat_version,
                 bucket=(B, L),
                 queue_wait_s=t_start - t_enq,
                 compute_s=t_done - t_start,
@@ -843,12 +915,22 @@ class ServingEngine:
             compiled = self._compile(head, B, L)
         return compiled
 
-    def _compile(self, head, B: int, L: int):
+    def _compile(self, head, B: int, L: int, operands=None, install=True,
+                 catalog_compile=False):
+        """AOT-compile one (head, bucket) executable. Catalog operands
+        (the trie) are lowered as runtime ARGUMENTS between params and
+        the batch; ``operands`` overrides them for catalog-growth
+        precompiles (install=False: the staged swap installs the result,
+        the live table keeps serving the old catalog meanwhile)."""
         fn = head.make_fn(B, L)
+        ops = operands if operands is not None else head.runtime_operands()
         args = head.make_batch([head.dummy_request()], B, L)
-        compiled = jax.jit(fn).lower(self._select(head, self._params), *args).compile()
-        self._exec[(head.name, B, L)] = compiled
-        self.metrics.record_compile()
+        compiled = jax.jit(fn).lower(
+            self._select(head, self._params), *(_sds(op) for op in ops), *args
+        ).compile()
+        if install:
+            self._exec[(head.name, B, L)] = compiled
+        self.metrics.record_compile(catalog=catalog_compile)
         return compiled
 
     # -- hot checkpoint reload -----------------------------------------------
@@ -919,4 +1001,142 @@ class ServingEngine:
         for head in self._heads.values():
             head.on_params(self._select(head, restored))
         self._log.info(f"serving: now serving checkpoint step {step}")
+        return False
+
+    # -- hot catalog swap ----------------------------------------------------
+
+    def catalog_version(self, head_name: str) -> Optional[str]:
+        return self._heads[head_name].catalog_version
+
+    def staged_catalog_version(self, head_name: str) -> Optional[str]:
+        with self._lock:
+            staged = self._pending_catalog.get(head_name)
+        return staged[0].version if staged is not None else None
+
+    def stage_catalog(self, head_name: str, snapshot) -> bool:
+        """Validate + stage a CatalogSnapshot for ``head_name``; the
+        batcher swaps it in between micro-batches (paged slots drain
+        first). Returns False when the snapshot is already live/staged.
+
+        Runs on the CALLER'S thread (a CatalogWatcher or a test), which
+        is the point: if the snapshot's trie sits on a different capacity
+        rung than the installed executables (aval change), replacement
+        executables are precompiled HERE, off the hot path, and installed
+        atomically with the swap; head-side staging work (COBRA's tower
+        encode for text-only snapshots) runs here too. Same-rung
+        snapshots stage with zero compiles.
+
+        Concurrent stagers are serialized by ``_stage_lock``, and the
+        rung comparison is made against the EFFECTIVE aval — the staged
+        pending snapshot when one exists, else the live trie — so a
+        snapshot staged while a rung-changing swap is still pending can
+        never be applied against mismatched executables.
+        """
+        head = self._heads.get(head_name)
+        if head is None:
+            raise UnknownHeadError(f"unknown head {head_name!r}")
+        if not getattr(head, "supports_catalog", False):
+            raise ValueError(f"head {head_name!r} has no swappable catalog")
+        head.validate_snapshot(snapshot)
+        with self._stage_lock:
+            if snapshot.version == head.catalog_version:
+                return False
+            with self._lock:
+                staged = self._pending_catalog.get(head_name)
+            if staged is not None and staged[0].version == snapshot.version:
+                return False
+            # Expensive head-side derivations (e.g. COBRA's item-tower
+            # encode from snapshot text) happen on THIS thread, so the
+            # batcher's set_catalog is a pure pointer swap.
+            prepare = getattr(head, "prepare_snapshot", None)
+            if prepare is not None:
+                prepare(snapshot)
+            new_trie = snapshot.device_trie()
+            # Effective aval: what the executables will expect AT APPLY
+            # time. While a swap is pending, that is the pending
+            # snapshot's trie — and replacing the pending entry must
+            # INHERIT its precompiled executables (it may be a
+            # rung-change whose executables are not installed yet; the
+            # dict holds one entry per head, so dropping them would swap
+            # a new-rung trie against old-rung executables).
+            if staged is not None:
+                base = staged[0].device_trie()
+                dense_exec, runner_exec = staged[1], staged[2]
+            else:
+                base = head.trie
+                dense_exec = runner_exec = None
+            same_rung = new_trie.aval_signature() == base.aval_signature()
+            if not same_rung:
+                dense_exec, runner_exec = self._precompile_catalog(head, new_trie)
+            with self._lock:
+                self._pending_catalog[head_name] = (
+                    snapshot, dense_exec, runner_exec
+                )
+                self._work.notify()
+        self._flight.record(
+            "catalog_staged", head=head_name, version=snapshot.version,
+            n_items=snapshot.n_items, capacity=snapshot.capacity,
+            recompiled=not same_rung,
+        )
+        self._log.info(
+            f"serving: staged catalog {snapshot.version} for head "
+            f"{head_name} ({snapshot.n_items} items, capacity "
+            f"{snapshot.capacity}{'' if same_rung else ', rung grew: executables precompiled'})"
+        )
+        return True
+
+    def _precompile_catalog(self, head, new_trie):
+        """Capacity-rung growth: AOT-compile every executable the head
+        owns against the NEW trie aval (staging thread; the live tables
+        keep serving the old catalog until the swap installs these)."""
+        operands = (new_trie,)
+        runner = self._runners.get(head.name)
+        if runner is not None:
+            decode = {
+                S: runner._compile_decode(S, operands=operands,
+                                          catalog_compile=True)
+                for S in runner.slot_shapes
+            }
+            prefill = {
+                (B, L): runner._compile_prefill(B, L, operands=operands,
+                                                catalog_compile=True)
+                for B, L in self._ladder.combos()
+            }
+            return None, (decode, prefill)
+        dense = {
+            (head.name, B, L): self._compile(
+                head, B, L, operands=operands, install=False,
+                catalog_compile=True,
+            )
+            for B, L in self._ladder.combos()
+        }
+        return dense, None
+
+    def _apply_pending_catalog(self) -> bool:
+        """Atomic catalog swap BETWEEN micro-batches (batcher thread),
+        after every paged decode slot drains — so one request never
+        mixes catalog versions, the property tests/test_catalog.py pins.
+        Returns True while a swap is still staged (admission pauses)."""
+        with self._lock:
+            if not self._pending_catalog:
+                return False
+        if any(not r.idle for r in self._runners.values()):
+            return True  # swap barrier: drain decode slots first
+        with self._lock:
+            pending, self._pending_catalog = self._pending_catalog, {}
+        for name, (snapshot, dense_exec, runner_exec) in pending.items():
+            head = self._heads[name]
+            head.set_catalog(snapshot)
+            if dense_exec is not None:
+                self._exec.update(dense_exec)
+            runner = self._runners.get(name)
+            if runner is not None and runner_exec is not None:
+                runner._decode, runner._prefill = runner_exec
+            self.metrics.record_catalog_swap()
+            self._flight.record(
+                "catalog_swapped", head=name, version=snapshot.version
+            )
+            self._log.info(
+                f"serving: head {name} now serving catalog {snapshot.version}"
+            )
         return False

@@ -16,10 +16,16 @@ engine composes:
 Two families:
 
 - **Generative** (TIGER, COBRA): trie-constrained KV-cached beam search —
-  `ops/trie` legal-item masking is fused into every decode step, so each
-  emitted sem-id tuple is a REAL item and maps back to an item id through
-  the corpus lookup ("Vectorizing the Trie", arxiv 2602.22647: the mask
-  must live on-accelerator or the decode loop syncs to host every step).
+  legal-item masking is fused into every decode step, so each emitted
+  sem-id tuple is a REAL item and maps back to an item id through the
+  corpus lookup ("Vectorizing the Trie", arxiv 2602.22647: the mask must
+  live on-accelerator or the decode loop syncs to host every step). The
+  corpus lives in a `catalog.CatalogSnapshot` and its trie is a
+  `catalog.TensorTrie` RUNTIME OPERAND: `runtime_operands()` threads the
+  trie tensors between params and the batch in every compiled call, so
+  one executable serves any same-rung catalog snapshot and the engine
+  hot-swaps catalogs between micro-batches exactly like params
+  (`set_catalog`, `Response.catalog_version`).
 - **Retrieval** (SASRec, HSTU): `last_hidden` (one position, not the full
   sequence) scored against the tied item-embedding table through
   `parallel.shardings.item_topk`, which shards the item axis when the
@@ -28,13 +34,13 @@ Two families:
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from genrec_tpu.ops.trie import build_trie
+from genrec_tpu.catalog import CatalogSnapshot
 
 
 class Head:
@@ -53,21 +59,50 @@ class Head:
       init_step and finishes when its step counter reaches total_steps;
     - ``paged_state_zeros(n_slots)``: the slot-major decode-state dict;
     - ``make_prefill_paged_fn(B, L)``: compiled per (batch, history)
-      bucket — runs the encoder/prefill, WRITES its K/V into the pools
-      through the batch's block tables, returns (k_pools, v_pools, init)
-      with init rows scattered into admitted slots;
-    - ``make_decode_paged_fn()``: compiled ONCE at max_slots — advances
-      every slot one step (per-slot step operands);
+      bucket — signature (params, *runtime_operands, *batch,
+      block_tables, k_pools, v_pools): runs the encoder/prefill, WRITES
+      its K/V into the pools through the batch's block tables, returns
+      (k_pools, v_pools, init) with init rows scattered into admitted
+      slots;
+    - ``make_decode_paged_fn()``: compiled ONCE at max_slots — signature
+      (params, *runtime_operands, state, steps, block_tables, seq_lens,
+      k_pools, v_pools): advances every slot one step (per-slot step
+      operands);
     - ``paged_finalize(state_row, req)``: slot state -> response payload.
+
+    Catalog heads additionally thread their trie through
+    ``runtime_operands()`` (the engine inserts it between params and the
+    batch in every compiled call), so the corpus swaps without a
+    recompile.
     """
 
     name: str
     top_k: int
     generative = False
     supports_paged = False
+    #: Heads whose corpus is a swappable CatalogSnapshot (set_catalog /
+    #: runtime_operands / catalog_version below).
+    supports_catalog = False
 
     def on_params(self, params) -> None:  # derived-table refresh hook
         del params
+
+    def runtime_operands(self) -> tuple:
+        """Device-side catalog operands threaded between ``params`` and
+        the batch in EVERY compiled call — runtime arguments, never
+        closure constants (graftlint's constant_bake rule is the guard).
+        Catalog heads return ``(trie,)``; others return ``()``."""
+        return ()
+
+    @property
+    def catalog_version(self) -> Optional[str]:
+        return None
+
+    def set_catalog(self, snapshot) -> None:
+        raise NotImplementedError(f"head {self.name!r} has no swappable catalog")
+
+    def validate_snapshot(self, snapshot) -> None:
+        raise NotImplementedError(f"head {self.name!r} has no swappable catalog")
 
     def validate(self, req) -> None:
         """Reject malformed requests AT SUBMIT TIME, so the error goes to
@@ -121,10 +156,13 @@ def _clip_history(history, L: int) -> np.ndarray:
 class _CorpusLookup:
     """sem-id tuple -> corpus item id, for mapping generative beams back
     to servable items. Constrained decoding guarantees every tuple is in
-    the corpus; -1 (never expected) would flag a constraint violation."""
+    the corpus; -1 (never expected) would flag a constraint violation.
+    The underlying dict is the snapshot's cached ``item_index()`` —
+    built once per snapshot, on the staging thread when the catalog is
+    hot-swapped."""
 
-    def __init__(self, item_sem_ids: np.ndarray):
-        self._map = {tuple(int(c) for c in row): i for i, row in enumerate(item_sem_ids)}
+    def __init__(self, snapshot):
+        self._map = snapshot.item_index()
 
     def __call__(self, tuples: np.ndarray) -> np.ndarray:
         return np.asarray(
@@ -135,23 +173,70 @@ class _CorpusLookup:
 class TigerGenerativeHead(Head):
     """TIGER beam search through the PR-1 KV-cached engine, trie-masked.
 
-    ``item_sem_ids``: (N, D) sem-id tuple per corpus item; requests carry
-    item ids indexing this table. Beam search is deterministic (pure beam,
-    no Gumbel sampling) so identical requests get identical answers.
+    The corpus comes either as a prebuilt ``catalog=`` CatalogSnapshot or
+    as a raw ``item_sem_ids`` (N, D) table (wrapped into a snapshot);
+    requests carry item ids indexing it. The snapshot's TensorTrie is the
+    head's single runtime operand — the compiled executables never bake
+    it, so `set_catalog` swaps the corpus without recompiling (same-rung
+    snapshots; a rung change is precompiled AOT by the engine's staging
+    path). Beam search is deterministic (pure beam, no Gumbel sampling)
+    so identical requests get identical answers.
     """
 
     generative = True
+    supports_catalog = True
 
-    def __init__(self, model, item_sem_ids: np.ndarray, trie=None,
-                 top_k: int = 10, name: str = "tiger"):
+    def __init__(self, model, item_sem_ids: Optional[np.ndarray] = None,
+                 top_k: int = 10, name: str = "tiger", catalog=None):
         self.model = model
         self.name = name
         self.top_k = top_k
-        self.item_sem_ids = np.asarray(item_sem_ids, np.int64)
-        self.trie = trie if trie is not None else build_trie(
-            self.item_sem_ids, model.num_item_embeddings
-        )
-        self._lookup = _CorpusLookup(self.item_sem_ids)
+        if catalog is None:
+            if item_sem_ids is None:
+                raise ValueError("need item_sem_ids or catalog=")
+            catalog = CatalogSnapshot.build(
+                np.asarray(item_sem_ids, np.int64), model.num_item_embeddings
+            )
+        self.validate_snapshot(catalog)
+        self.set_catalog(catalog)
+
+    def validate_snapshot(self, snapshot) -> None:
+        if snapshot.depth != self.model.sem_id_dim:
+            raise ValueError(
+                f"catalog depth {snapshot.depth} != model sem_id_dim "
+                f"{self.model.sem_id_dim}"
+            )
+        if snapshot.codebook_size != self.model.num_item_embeddings:
+            raise ValueError(
+                f"catalog codebook {snapshot.codebook_size} != model "
+                f"num_item_embeddings {self.model.num_item_embeddings}"
+            )
+
+    def prepare_snapshot(self, snapshot) -> None:
+        """Staging-thread hook (engine.stage_catalog): warm the cached
+        device trie + item index so the batcher's set_catalog is pure
+        pointer swaps — no host->device upload, no O(N) Python on the
+        hot path."""
+        snapshot.device_trie()
+        snapshot.item_index()
+
+    def set_catalog(self, snapshot) -> None:
+        """Swap the whole corpus atomically (called by the engine's
+        batcher BETWEEN micro-batches / after slot drain): trie operand,
+        id-range validation bound, and the beam -> item-id lookup. All
+        derived artifacts are snapshot-cached (prepare_snapshot warms
+        them on the staging thread)."""
+        self.catalog = snapshot
+        self.item_sem_ids = snapshot.item_sem_ids
+        self.trie = snapshot.device_trie()
+        self._lookup = _CorpusLookup(snapshot)
+
+    @property
+    def catalog_version(self) -> Optional[str]:
+        return self.catalog.version
+
+    def runtime_operands(self) -> tuple:
+        return (self.trie,)
 
     def max_item_id(self):
         return len(self.item_sem_ids) - 1
@@ -162,7 +247,13 @@ class TigerGenerativeHead(Head):
         mask = np.zeros((B, L * D), np.int32)
         user = np.zeros((B,), np.int32)
         for i, r in enumerate(reqs):
+            # Items past the live corpus are DROPPED, not indexed:
+            # validate() checked ids at submit time, but a hot swap to a
+            # SMALLER catalog can land while a request is queued — a
+            # removed item simply vanishes from the history instead of
+            # IndexError-failing the whole co-batched micro-batch.
             h = _clip_history(r.history, L)
+            h = h[h < len(self.item_sem_ids)]
             if len(h):
                 ids[i, : len(h) * D] = self.item_sem_ids[h].reshape(-1)
                 mask[i, : len(h) * D] = 1
@@ -174,9 +265,11 @@ class TigerGenerativeHead(Head):
     def make_fn(self, B: int, L: int):
         from genrec_tpu.models.tiger import tiger_generate
 
-        def fn(params, user, ids, types, mask):
+        def fn(params, trie, user, ids, types, mask):
+            # The trie is a runtime OPERAND (catalog.TensorTrie pytree),
+            # threaded by the engine — never closed over, never baked.
             out = tiger_generate(
-                self.model, params, self.trie, user, ids, types, mask,
+                self.model, params, trie, user, ids, types, mask,
                 jax.random.key(0), n_top_k_candidates=self.top_k,
                 deterministic=True, use_cache=True,
             )
@@ -227,7 +320,12 @@ class TigerGenerativeHead(Head):
 
         del B, L  # shapes come from make_batch/block_tables
 
-        def fn(params, user, ids, types, mask, block_tables, k_pools, v_pools):
+        def fn(params, trie, user, ids, types, mask, block_tables,
+               k_pools, v_pools):
+            # TIGER's prefill is trie-free; the operand rides the uniform
+            # paged signature (params, *operands, *batch, ...) and jit
+            # prunes the unused arg.
+            del trie
             k_pools, v_pools, _ = tiger_prefill_paged(
                 self.model, params, user, ids, types, mask, block_tables,
                 k_pools, v_pools,
@@ -239,11 +337,12 @@ class TigerGenerativeHead(Head):
     def make_decode_paged_fn(self):
         from genrec_tpu.models.tiger import tiger_paged_decode_step
 
-        def fn(params, state, steps, block_tables, seq_lens, k_pools, v_pools):
+        def fn(params, trie, state, steps, block_tables, seq_lens,
+               k_pools, v_pools):
             # Deterministic pure beam (the serving contract: identical
             # requests get identical answers), same as the dense make_fn.
             return tiger_paged_decode_step(
-                self.model, params, self.trie, state, steps, block_tables,
+                self.model, params, trie, state, steps, block_tables,
                 seq_lens, k_pools, v_pools, rng=None,
             )
 
@@ -258,50 +357,152 @@ class TigerGenerativeHead(Head):
 class CobraGenerativeHead(Head):
     """COBRA cached beam search, trie-masked, over a precomputed item tower.
 
-    The sparse side of each history item comes from ``item_sem_ids``
-    (N, C); the dense side from per-item vectors — either supplied
-    directly (``item_vecs``) or re-encoded from ``item_text_tokens``
-    through the model's text encoder on every params (re)load, so a hot
-    checkpoint reload refreshes the item tower too.
+    The sparse side of each history item comes from the catalog's
+    ``item_sem_ids`` (N, C); the dense side from per-item vectors, which
+    are CATALOG artifacts: either snapshot-held (``item_vecs`` — the
+    catalog pipeline precomputed the tower, reused unchanged across
+    params-only hot reloads) or encoded HERE from the snapshot's
+    ``item_text_tokens``, exactly ONCE per catalog version — a params
+    reload with an unchanged catalog keeps the tower (the PR-5 behavior
+    of re-encoding the whole corpus on every params reload is retired;
+    ``tower_encodes`` counts the real encodes for tests/metrics).
     """
 
     generative = True
+    supports_catalog = True
 
-    def __init__(self, model, item_sem_ids: np.ndarray,
+    def __init__(self, model, item_sem_ids: Optional[np.ndarray] = None,
                  item_vecs: Optional[np.ndarray] = None,
                  item_text_tokens: Optional[np.ndarray] = None,
-                 trie=None, top_k: int = 10, name: str = "cobra"):
-        if item_vecs is None and item_text_tokens is None:
-            raise ValueError("need item_vecs or item_text_tokens")
+                 top_k: int = 10, name: str = "cobra", catalog=None):
         self.model = model
         self.name = name
         self.top_k = top_k
-        self.item_sem_ids = np.asarray(item_sem_ids, np.int64)
-        self.item_vecs = None if item_vecs is None else np.asarray(item_vecs)
-        self._text_tokens = (
-            None if item_text_tokens is None else jnp.asarray(item_text_tokens)
-        )
         self._encode = None
-        self.trie = trie if trie is not None else build_trie(
-            self.item_sem_ids, model.id_vocab_size
+        self._last_params = None
+        self._vecs_version = None  # catalog version the tower was encoded for
+        self._prepared_tower = None  # (version, vecs) from prepare_snapshot
+        self.tower_encodes = 0
+        if catalog is None:
+            if item_sem_ids is None:
+                raise ValueError("need item_sem_ids or catalog=")
+            catalog = CatalogSnapshot.build(
+                np.asarray(item_sem_ids, np.int64), model.id_vocab_size,
+                item_vecs=item_vecs, item_text_tokens=item_text_tokens,
+            )
+        self.validate_snapshot(catalog)
+        self.set_catalog(catalog)
+
+    def validate_snapshot(self, snapshot) -> None:
+        if snapshot.depth != self.model.n_codebooks:
+            raise ValueError(
+                f"catalog depth {snapshot.depth} != model n_codebooks "
+                f"{self.model.n_codebooks}"
+            )
+        if snapshot.codebook_size != self.model.id_vocab_size:
+            raise ValueError(
+                f"catalog codebook {snapshot.codebook_size} != model "
+                f"id_vocab_size {self.model.id_vocab_size}"
+            )
+        if snapshot.item_vecs is None and snapshot.item_text_tokens is None:
+            raise ValueError(
+                "COBRA catalog snapshot needs item_vecs or item_text_tokens "
+                "(the dense item tower has to come from somewhere)"
+            )
+        cur = getattr(self, "item_vecs", None)
+        if cur is not None and snapshot.item_vecs is not None and (
+            snapshot.item_vecs.shape[-1] != cur.shape[-1]
+        ):
+            raise ValueError(
+                f"snapshot tower dim {snapshot.item_vecs.shape[-1]} != "
+                f"serving tower dim {cur.shape[-1]} — batch avals would drift"
+            )
+
+    def prepare_snapshot(self, snapshot) -> None:
+        """Staging-thread hook (engine.stage_catalog): warm the device
+        trie + item index, and encode the dense tower for a TEXT-only
+        snapshot BEFORE the swap is staged — the batcher's set_catalog
+        is a pure pointer swap; the hot path never compiles, uploads,
+        or encodes a corpus."""
+        snapshot.device_trie()
+        snapshot.item_index()
+        if snapshot.item_vecs is not None or self._last_params is None:
+            return
+        self._prepared_tower = (
+            snapshot.version,
+            self._encode_text(self._last_params, snapshot),
         )
-        self._lookup = _CorpusLookup(self.item_sem_ids)
+
+    def set_catalog(self, snapshot) -> None:
+        self.catalog = snapshot
+        self.item_sem_ids = snapshot.item_sem_ids
+        self.trie = snapshot.device_trie()
+        self._lookup = _CorpusLookup(snapshot)
+        if snapshot.item_vecs is not None:
+            # Snapshot-held tower: reused as-is until the NEXT catalog
+            # version, including across params-only hot reloads.
+            self.item_vecs = np.asarray(snapshot.item_vecs)
+            self._vecs_version = snapshot.version
+        elif self._prepared_tower is not None and (
+            self._prepared_tower[0] == snapshot.version
+        ):
+            # Tower encoded ahead of time by prepare_snapshot (the
+            # engine staging path).
+            self.item_vecs = self._prepared_tower[1]
+            self._vecs_version = snapshot.version
+            self._prepared_tower = None
+        elif self._last_params is not None:
+            # Direct set_catalog without staging (tests, bootstrap):
+            # encode inline — caller's thread, not the hot path.
+            self._encode_tower(self._last_params)
+        else:
+            # Before the first on_params: the engine's start() delivers
+            # params to every head before compiling anything.
+            self.item_vecs = None
+            self._vecs_version = None
+
+    @property
+    def catalog_version(self) -> Optional[str]:
+        return self.catalog.version
+
+    def runtime_operands(self) -> tuple:
+        return (self.trie,)
 
     def max_item_id(self):
         return len(self.item_sem_ids) - 1
 
     def on_params(self, params) -> None:
-        if self._text_tokens is None:
+        """Params (re)load hook. The item tower is a CATALOG artifact:
+        it re-encodes only when the catalog version actually changed
+        (or was never encoded), never on a params-only reload."""
+        self._last_params = params
+        if self._vecs_version == self.catalog.version:
             return
+        self._encode_tower(params)
+
+    def _encode_text(self, params, snapshot) -> np.ndarray:
+        """One full-corpus tower encode from ``snapshot``'s item text."""
         from genrec_tpu.models.cobra import Cobra
 
+        if snapshot.item_text_tokens is None:
+            raise ValueError(
+                f"catalog {snapshot.version} carries no item_vecs and no "
+                "item_text_tokens — cannot build the dense item tower"
+            )
         if self._encode is None:
             self._encode = jax.jit(
                 lambda p, t: self.model.apply(
                     {"params": p}, t, method=Cobra.encode_items
                 )
             )
-        self.item_vecs = np.asarray(self._encode(params, self._text_tokens))
+        self.tower_encodes += 1
+        return np.asarray(
+            self._encode(params, jnp.asarray(snapshot.item_text_tokens))
+        )
+
+    def _encode_tower(self, params) -> None:
+        self.item_vecs = self._encode_text(params, self.catalog)
+        self._vecs_version = self.catalog.version
 
     def make_batch(self, reqs, B: int, L: int):
         C = self.model.n_codebooks
@@ -309,7 +510,10 @@ class CobraGenerativeHead(Head):
         ids = np.full((B, L * C), self.model.pad_id, np.int32)
         vecs = np.zeros((B, L, d), self.item_vecs.dtype)
         for i, r in enumerate(reqs):
+            # Drop items removed by a shrinking hot swap (see the TIGER
+            # make_batch note): never index past the live corpus.
             h = _clip_history(r.history, L)
+            h = h[h < len(self.item_sem_ids)]
             if len(h):
                 ids[i, : len(h) * C] = self.item_sem_ids[h].reshape(-1)
                 vecs[i, : len(h)] = self.item_vecs[h]
@@ -318,11 +522,11 @@ class CobraGenerativeHead(Head):
     def make_fn(self, B: int, L: int):
         from genrec_tpu.models.cobra import cobra_generate
 
-        def fn(params, ids, vecs):
+        def fn(params, trie, ids, vecs):
             out = cobra_generate(
                 self.model, params, ids, None, n_candidates=self.top_k,
                 temperature=1.0, item_vecs=vecs, use_cache=True,
-                trie=self.trie,
+                trie=trie,
             )
             return out.sem_ids, out.scores
 
@@ -374,10 +578,12 @@ class CobraGenerativeHead(Head):
 
         del B, L
 
-        def fn(params, ids, vecs, block_tables, k_pools, v_pools):
+        def fn(params, trie, ids, vecs, block_tables, k_pools, v_pools):
+            # COBRA resolves codebook 0 AT prefill, so the trie operand
+            # is live here (unlike TIGER's trie-free prefill).
             return cobra_prefill_paged(
                 self.model, params, ids, vecs, block_tables, k_pools, v_pools,
-                self.trie, self.top_k, temperature=1.0,
+                trie, self.top_k, temperature=1.0,
             )
 
         return fn
@@ -385,9 +591,10 @@ class CobraGenerativeHead(Head):
     def make_decode_paged_fn(self):
         from genrec_tpu.models.cobra import cobra_paged_decode_step
 
-        def fn(params, state, steps, block_tables, seq_lens, k_pools, v_pools):
+        def fn(params, trie, state, steps, block_tables, seq_lens,
+               k_pools, v_pools):
             return cobra_paged_decode_step(
-                self.model, params, self.trie, state, steps, block_tables,
+                self.model, params, trie, state, steps, block_tables,
                 seq_lens, k_pools, v_pools, temperature=1.0,
             )
 
@@ -505,16 +712,17 @@ def _tiny_tiger_head():
 @register_entry("serve/tiger_generate_dense", tags=("serving", "generative"))
 def _graftlint_dense_entry() -> BuiltEntry:
     """The dense whole-generate executable, jitted exactly like
-    ServingEngine._compile. The trie legality tables are closed over and
-    baked as literals — the known debt the constant_bake rule tracks
-    (ROADMAP: trie as a runtime operand). At CI shapes the largest baked
-    table is the (K^2, K)=pred[64,8] legality mask (512 B; ~16 MB at the
-    production K=256), so the entry pins a 256 B threshold to keep the
-    rule biting — the same self-test discipline as the check_*_hlo
-    regexes."""
+    ServingEngine._compile: (params, trie-operand, *batch). The trie is a
+    catalog.TensorTrie RUNTIME OPERAND — the debt this entry used to
+    baseline (dense legality tables baked as pred[64,8] literals) is
+    retired, and the tight 256 B threshold now ASSERTS no catalog-sized
+    literal creeps back in (at CI shapes the old bake was 512 B, so the
+    threshold still bites — the same self-test discipline as the
+    check_*_hlo regexes)."""
     head, params, B, L = _tiny_tiger_head()
     fn = jax.jit(head.make_fn(B, L))
-    args = (params, *head.make_batch([head.dummy_request()], B, L))
+    args = (params, *head.runtime_operands(),
+            *head.make_batch([head.dummy_request()], B, L))
     return BuiltEntry(fn=fn, args=args, max_const_bytes=256)
 
 
@@ -524,10 +732,10 @@ def _graftlint_paged_decode_entry() -> BuiltEntry:
     _PagedRunner._compile_decode on TPU (donation on; the engine only
     disables it on CPU to silence the no-op warning). The slot-state
     operand is overwritten by the write-back every step — undonated it
-    would double-buffer the whole slot ladder. The trie legality tables
-    are baked here exactly as in the dense path, so this entry pins the
-    same 256 B constant threshold (known debt, baselined — ROADMAP:
-    trie as a runtime operand)."""
+    would double-buffer the whole slot ladder. The trie rides as a
+    runtime operand at argnum 1 (catalog.TensorTrie) — NOT donated, it
+    survives across every step — and the 256 B constant threshold now
+    asserts the old baked-table debt stays retired."""
     from genrec_tpu.serving.engine import PAGED_DECODE_DONATE_ARGNUMS
     from genrec_tpu.serving.kv_pool import KVPagePool, PagedConfig
 
@@ -542,15 +750,16 @@ def _graftlint_paged_decode_entry() -> BuiltEntry:
     fn = jax.jit(head.make_decode_paged_fn(),
                  donate_argnums=PAGED_DECODE_DONATE_ARGNUMS)
     args = (
-        params, state,
+        params, *head.runtime_operands(), state,
         jnp.zeros((S,), jnp.int32),
         jnp.zeros((S, cfg.pages_per_slot), jnp.int32),
         jnp.zeros((S,), jnp.int32),
         pool.k_pools, pool.v_pools,
     )
     # expect_donated stays a LITERAL, independent of the shared constant:
-    # it states which buffers are dead (a fact about step()'s write-back),
-    # so emptying PAGED_DECODE_DONATE_ARGNUMS fails the audit instead of
-    # both sides silently agreeing on "no donation".
-    return BuiltEntry(fn=fn, args=args, expect_donated=(1,),
+    # it states which buffers are dead (a fact about step()'s write-back:
+    # params 0, trie 1, slot state 2), so emptying
+    # PAGED_DECODE_DONATE_ARGNUMS fails the audit instead of both sides
+    # silently agreeing on "no donation".
+    return BuiltEntry(fn=fn, args=args, expect_donated=(2,),
                       max_const_bytes=256)

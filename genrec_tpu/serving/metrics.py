@@ -79,6 +79,12 @@ class ServingMetrics:
         self.warmup_compiles = 0
         self.recompilations = 0  # post-warmup compiles: steady state => 0
         self.params_swaps = 0
+        # Live-catalog subsystem: swaps applied, and AOT compiles done by
+        # the catalog STAGING path on capacity-rung growth — intentional
+        # off-hot-path work, counted apart from steady-state
+        # recompilations (which check_serving_hlo pins at zero).
+        self.catalog_swaps = 0
+        self.catalog_compiles = 0
         # Paged decode (slot-level continuous batching): admit/evict churn,
         # deferred-for-OOM admits, decode-step count, and per-head KV-pool
         # gauges so pool pressure is visible in the operator line.
@@ -98,9 +104,11 @@ class ServingMetrics:
             self._warm = True
             self._started = time.monotonic()
 
-    def record_compile(self) -> None:
+    def record_compile(self, catalog: bool = False) -> None:
         with self._lock:
-            if self._warm:
+            if catalog:
+                self.catalog_compiles += 1
+            elif self._warm:
                 self.recompilations += 1
             else:
                 self.warmup_compiles += 1
@@ -149,6 +157,10 @@ class ServingMetrics:
     def record_swap(self) -> None:
         with self._lock:
             self.params_swaps += 1
+
+    def record_catalog_swap(self) -> None:
+        with self._lock:
+            self.catalog_swaps += 1
 
     def record_batch(self, head: str, bucket: tuple[int, int]) -> None:
         with self._lock:
@@ -202,6 +214,8 @@ class ServingMetrics:
                 warmup_compiles=self.warmup_compiles,
                 recompilations=self.recompilations,
                 params_swaps=self.params_swaps,
+                catalog_swaps=self.catalog_swaps,
+                catalog_compiles=self.catalog_compiles,
                 admits=self.admits,
                 evictions=self.evictions,
                 oom_deferred_admits=self.oom_deferred_admits,

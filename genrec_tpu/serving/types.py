@@ -57,6 +57,11 @@ class Response:
     queue_wait_s: float
     compute_s: float
     total_s: float
+    #: Content-hash version of the CatalogSnapshot that answered this
+    #: request (catalog heads only; None for retrieval heads). Catalog
+    #: swaps apply between micro-batches / after slot drain, so exactly
+    #: ONE version ever serves a request — provenance beside params_step.
+    catalog_version: Optional[str] = None
     # Request/trace ID minted at submit() when the engine has a tracer:
     # the key into the span tree (obs/spans.py) for this request. None
     # when tracing is off (the default).

@@ -489,7 +489,7 @@ def train(
             valid_vocab=live_vocab,
         )
     elif pipeline_parallel > 1:
-        from genrec_tpu.parallel.pipeline import make_pp_sft_loss
+        from genrec_tpu.models.pp_sft import make_pp_sft_loss
         from genrec_tpu.parallel.shardings import qwen_rules as _qr
 
         base_loss = make_pp_sft_loss(

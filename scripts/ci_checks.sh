@@ -8,6 +8,11 @@
 #   check_serving_hlo.py   — serving engine: zero steady-state XLA
 #                            recompilations across mixed-shape traffic,
 #                            incl. paged-decode admit/evict churn
+#   check_catalog_hlo.py   — live catalog: one warmed engine serves TWO
+#                            catalog snapshots through a hot swap with
+#                            zero recompiles, no catalog-sized constants
+#                            in the optimized HLO, bit-identical sem_ids
+#                            vs the baked-trie reference
 #   check_obs.py           — obs smoke: a traced serve loop yields a
 #                            complete per-request span tree + valid
 #                            Chrome-trace JSON, a traced train loop's
@@ -90,6 +95,13 @@ if [ "$MODE" = "--smoke" ]; then
     run python scripts/check_fused_ce_hlo.py --small --platform cpu
     run python scripts/check_packed_hlo.py --small --platform cpu
     run python scripts/check_serving_hlo.py --small --platform cpu
+    # Live-catalog smoke: hot snapshot swap through one warmed engine,
+    # zero recompiles + no baked catalog constants. GENREC_CI_SKIP_CATALOG=1
+    # skips it for callers whose pytest pass already runs
+    # tests/test_catalog.py directly (same contract as the knobs below).
+    if [ -z "${GENREC_CI_SKIP_CATALOG:-}" ]; then
+        run python scripts/check_catalog_hlo.py --small --platform cpu
+    fi
     # Obs smoke (traced serve span tree + goodput schema + overhead
     # budget). GENREC_CI_SKIP_OBS=1 skips it for callers whose pytest
     # pass already runs tests/test_obs.py directly (same contract as
@@ -116,7 +128,11 @@ if [ "$MODE" = "--smoke" ]; then
         # continues. Output to stderr so stdout stays one verdict JSON
         # per HLO check; same skip knob as the chaos subset (the tier-1
         # pytest pass already runs these tests directly).
+        # test_catalog's serving_smoke subset rides along: the hot
+        # catalog swap tests are slow-marked (outside the tier-1 budget)
+        # but belong in the serving smoke.
         run_strict env JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py \
+            tests/test_catalog.py \
             -q -m serving_smoke -p no:cacheprovider 1>&2
         # Paged decode subset: allocator never leaks/double-frees/aliases
         # pages under churn, and the paged pool path answers exactly like
@@ -137,12 +153,14 @@ else
     run python scripts/check_fused_ce_hlo.py --write-note
     run python scripts/check_packed_hlo.py --write-note
     run python scripts/check_serving_hlo.py --write-note
+    run python scripts/check_catalog_hlo.py --write-note
     run python scripts/check_obs.py
     run python scripts/graftlint.py
     # Full serving suite (incl. the slow all-four-heads drain test, the
     # slow COBRA trie-constraint pins, and the full paged-parity matrix).
     run_strict env JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py \
-        tests/test_trie_constrained.py tests/test_kv_pool.py \
+        tests/test_trie_constrained.py tests/test_catalog.py \
+        tests/test_kv_pool.py \
         tests/test_paged_parity.py -q -p no:cacheprovider 1>&2
     # Full chaos suite: SIGTERM mid-epoch + exact-resume parity for all
     # seven trainers, ladder fallback, NaN injection — plus the 2-process

@@ -68,7 +68,8 @@ def test_check_scripts_keep_their_cli():
     """The shared harness must preserve every script's flag surface
     (ci_checks.sh and the watchdog pass these exact flags)."""
     for script in ("check_decode_hlo", "check_packed_hlo",
-                   "check_fused_ce_hlo", "check_serving_hlo", "check_obs"):
+                   "check_fused_ce_hlo", "check_serving_hlo",
+                   "check_catalog_hlo", "check_obs"):
         proc = subprocess.run(
             [sys.executable, os.path.join(REPO, "scripts", f"{script}.py"),
              "--help"],
@@ -82,17 +83,18 @@ def test_check_scripts_keep_their_cli():
 def test_ci_checks_smoke_entrypoint():
     """The consolidated entrypoint runs every smoke check and exits 0
     (rc=2 inconclusives tolerated, real failures propagated)."""
-    # The chaos-unit, obs, and graftlint subsets are skipped here: this
-    # test runs INSIDE the suite that already executes
-    # tests/test_fault_tolerance.py, tests/test_obs.py and
-    # tests/test_analysis.py directly, and nesting them would double-pay
-    # their cold-start (~30s each) for no coverage.
+    # The chaos-unit, obs, graftlint and catalog subsets are skipped
+    # here: this test runs INSIDE the suite that already executes
+    # tests/test_fault_tolerance.py, tests/test_obs.py,
+    # tests/test_analysis.py and tests/test_catalog.py directly, and
+    # nesting them would double-pay their cold-start (~30s each) for no
+    # coverage.
     proc = subprocess.run(
         ["bash", os.path.join(REPO, "scripts", "ci_checks.sh"), "--smoke"],
         capture_output=True, text=True, timeout=600,
         env={**os.environ, "JAX_PLATFORMS": "cpu",
              "GENREC_CI_SKIP_CHAOS": "1", "GENREC_CI_SKIP_OBS": "1",
-             "GENREC_CI_SKIP_LINT": "1"},
+             "GENREC_CI_SKIP_LINT": "1", "GENREC_CI_SKIP_CATALOG": "1"},
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     # One verdict JSON per check on stdout (decode, fused-ce, packed,

@@ -9,9 +9,9 @@ import pytest
 
 from genrec_tpu.models.backbones.qwen import QwenConfig, QwenLM
 from genrec_tpu.models.lcrec import sft_loss
+from genrec_tpu.models.pp_sft import make_pp_sft_loss
 from genrec_tpu.parallel import make_mesh
 from genrec_tpu.parallel.pipeline import (
-    make_pp_sft_loss,
     stack_layer_params,
     unstack_layer_params,
 )
