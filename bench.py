@@ -98,6 +98,59 @@ def host_fingerprint() -> str:
     return f"{platform.node()}/cpus={os.cpu_count()}"
 
 
+#: Version of the result-line metadata schema (the "meta" section every
+#: emitted line carries). scripts/bench_gate.py keys off it to compare
+#: runs across PRs; bump it only with a migration note in docs/PERF.md.
+BENCH_META_SCHEMA = 1
+
+
+def run_metadata(backend: str | None = None,
+                 jax_version: str | None = None,
+                 measured_this_session: bool = True) -> dict:
+    """Stable per-run metadata stamped onto every output line: git sha,
+    backend, jax version, host, and the benchmarked shape config — so
+    scripts/bench_gate.py can refuse apples-to-oranges comparisons
+    (backend/shape drift) instead of flagging them as regressions.
+
+    Lines built from CACHED evidence (the fallback ladder's cached-tpu /
+    committed-artifact sources) pass ``measured_this_session=False``:
+    the measurement's commit and shape config are the OLD session's and
+    unknown here, so git_sha/shapes are stamped None rather than falsely
+    attributing old numbers to the current checkout."""
+    meta = {
+        "schema": BENCH_META_SCHEMA,
+        "host": host_fingerprint(),
+        "t": round(time.time(), 1),
+        "measured_this_session": measured_this_session,
+        "shapes": {
+            "tiger_arch": dict(TIGER_BENCH_ARCH),
+            "bench_items": BENCH_ITEMS,
+            "cpu_batch": CPU_BATCH,
+            "tpu_batch": TPU_BATCH,
+            "decode_batch": DECODE_BATCH,
+            "decode_beam_k": DECODE_BEAM_K,
+            "serve_batch": SERVE_BATCH,
+            "paged_max_history": PAGED_MAX_HISTORY,
+        } if measured_this_session else None,
+    }
+    if backend:
+        meta["backend"] = backend
+    if jax_version:
+        meta["jax_version"] = jax_version
+    if not measured_this_session:
+        meta["git_sha"] = None
+        return meta
+    try:
+        sha = subprocess.run(
+            ["git", "rev-parse", "HEAD"], cwd=REPO, capture_output=True,
+            text=True, timeout=10,
+        ).stdout.strip()
+        meta["git_sha"] = sha or None
+    except Exception:  # noqa: BLE001 — metadata must never fail the line
+        meta["git_sha"] = None
+    return meta
+
+
 def amazon_like_lengths(n: int, max_items: int, rng):
     """Sliding-window sample lengths (in ITEMS) from Amazon-like user
     histories: users have >= 5 events with a geometric tail, and every
@@ -144,7 +197,8 @@ def _measure(platform: str) -> None:
     # Liveness marker: the parent treats its absence after PROBE_WINDOW_S
     # as a dead tunnel and short-circuits to the fallback ladder.
     print(f"BACKEND_READY {backend}", flush=True)
-    result: dict = {"backend": backend, "n_chips": jax.device_count()}
+    result: dict = {"backend": backend, "n_chips": jax.device_count(),
+                    "jax_version": jax.__version__}
 
     if only_serve:
         # Serve-only supplement child (the serve ratio and latency
@@ -1247,6 +1301,9 @@ def main():
                 sup = _cpu_serve_supplement()
                 if sup is not None:
                     line["serve"] = {**sup["serve"], "source": "cpu"}
+            line["meta"] = run_metadata(backend=line.get("backend"),
+                                        jax_version=line.get("jax_version"),
+                                        measured_this_session=False)
             print(json.dumps(line))
             return
     if result is None:
@@ -1342,6 +1399,15 @@ def main():
             pass
     if error:
         line["error"] = error
+    # Stable run metadata (git sha / backend / jax version / shape
+    # config) — the cross-PR comparison key scripts/bench_gate.py uses.
+    # cached-tpu evidence predates this checkout: its measurement commit
+    # and shapes are not THIS session's.
+    line["meta"] = run_metadata(
+        backend=line.get("backend"),
+        jax_version=(result or {}).get("jax_version"),
+        measured_this_session=source in ("live", "cpu-fallback"),
+    )
     print(json.dumps(line))
 
 

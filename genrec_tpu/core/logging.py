@@ -108,6 +108,39 @@ def log_serving_stats(logger, tracker, stats: Mapping[str, Any]) -> None:
             f"slots {g.get('slots_active', 0)}/{g.get('slots_total', 0)}, "
             f"kv_tokens={g.get('kv_tokens_resident', 0)}"
         )
+    # Device-memory ledger (obs/memory.py): one HBM line per head —
+    # ledger total vs the declared budget with headroom %, so "how close
+    # to OOM is this replica" reads off the same interval line as the
+    # pool gauges.
+    hbm = stats.get("hbm") or {}
+    budget = hbm.get("budget_bytes")
+    for head, h in (hbm.get("heads") or {}).items():
+        total = h.get("total_bytes", 0)
+        line = (
+            f"serving hbm[{head}]: {total / 2**20:.2f} MB "
+            f"(operands {h.get('operand_bytes', 0) / 2**20:.2f} MB + "
+            f"transient peak {h.get('transient_peak_bytes', 0) / 2**20:.2f} MB"
+            f" across {h.get('n_executables', 0)} executables)"
+        )
+        if budget:
+            line += (
+                f", budget {budget / 2**20:.1f} MB, "
+                f"headroom {hbm.get('headroom_pct', 0.0):.1f}%"
+            )
+        logger.info(line)
+    # SLO shed state: one line while any head is ACTIVELY shedding
+    # (gating on the lifetime overload counter would log forever after
+    # the first episode; the counter still reaches dashboards via the
+    # tracker flatten below).
+    slo = stats.get("slo")
+    if slo:
+        shed = [h for h, s in (slo.get("heads") or {}).items()
+                if s.get("shedding")]
+        if shed:
+            logger.info(
+                f"serving slo: shedding={sorted(shed)} "
+                f"overload_rejected={stats.get('overload_rejected', 0)}"
+            )
 
     def _flatten(prefix: str, tree: Mapping, out: dict) -> None:
         for k, v in tree.items():
@@ -141,12 +174,17 @@ def log_goodput(logger, tracker, epoch: int, report: Mapping[str, Any],
         f"of {wall:.1f}s wall" + (f" [{detail}]" if detail else "")
     )
     ns = "goodput/fleet" if fleet else "goodput"
-    tracker.log({
+    payload = {
         "epoch": epoch,
         f"{ns}/pct": float(report.get("goodput_pct", 0.0)),
         f"{ns}/wall_s": wall,
         **{f"{ns}/{k}_s": float(v) for k, v in buckets.items()},
-    })
+    }
+    # Peak device bytes (obs.memory.device_memory_stats, folded in by
+    # the packed loop on backends whose allocator exposes it).
+    if report.get("peak_device_bytes"):
+        payload[f"{ns}/peak_device_bytes"] = float(report["peak_device_bytes"])
+    tracker.log(payload)
 
 
 class Tracker:

@@ -10,6 +10,10 @@ serving, streaming-training -> hot-serving) sit on:
 - `flight_recorder` — bounded structured-event ring dumped atomically on
                       SIGTERM / crash / chaos kill points
 - `export`          — Prometheus-style text exposition of any snapshot
+- `memory`          — device-memory ledger: operands + compiled
+                      executables summed into an HBM budget model
+- `slo`             — declared per-head SLO targets, sustained-breach
+                      detection, load-shed/recover hysteresis
 
 Layering: `obs` imports nothing from core/trainers/serving (jax only,
 lazily), so every layer above may use it freely.
@@ -27,6 +31,13 @@ from genrec_tpu.obs.goodput import (
     GoodputMeter,
     fleet_goodput,
 )
+from genrec_tpu.obs.memory import (
+    MemoryLedger,
+    device_memory_stats,
+    executable_memory_stats,
+    tree_nbytes,
+)
+from genrec_tpu.obs.slo import SLOMonitor, SLOTarget
 from genrec_tpu.obs.spans import NULL_TRACER, Span, SpanTracer
 
 __all__ = [
@@ -34,12 +45,18 @@ __all__ = [
     "CompileEvents",
     "FlightRecorder",
     "GoodputMeter",
+    "MemoryLedger",
     "NULL_TRACER",
+    "SLOMonitor",
+    "SLOTarget",
     "Span",
     "SpanTracer",
+    "device_memory_stats",
+    "executable_memory_stats",
     "fleet_goodput",
     "get_flight_recorder",
     "json_safe",
     "prometheus_text",
+    "tree_nbytes",
     "write_prometheus",
 ]

@@ -27,6 +27,7 @@ _COUNTER_LEAVES = frozenset({
     "submitted", "completed", "rejected", "failed", "batches",
     "warmup_compiles", "recompilations", "params_swaps", "admits",
     "evictions", "oom_deferred_admits", "decode_steps", "count", "steps",
+    "catalog_swaps", "catalog_compiles", "overload_rejected", "breaches",
 })
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_]")

@@ -1,0 +1,255 @@
+"""Device-memory ledger: account every byte a serving process holds.
+
+Every fleet-scale roadmap item (disaggregated serving, 100M+ catalogs,
+streaming training) rations ONE scarce resource — HBM — yet before this
+module every budget in the repo was a hand-computed comment
+(`PagedConfig.hbm_bytes`, the trie sizing note) and nothing observed
+what XLA actually allocated. Ragged Paged Attention (PAPERS.md, arxiv
+2604.15464) frames HBM as *the* serving capacity lever; the ledger makes
+it a measured, budgeted quantity instead of an asserted one.
+
+`MemoryLedger` models one device's resident set per GROUP (the serving
+engine uses one group per head):
+
+- **operands** — logical runtime state that stays resident between
+  executable calls: params, KV page pools, catalog trie tensors, paged
+  slot state. Recorded as named byte counts (`tree_nbytes` sums any
+  pytree without touching device buffers).
+- **executables** — every AOT-compiled executable, accounted through
+  ``compiled.memory_analysis()`` (XLA's own post-optimization numbers:
+  argument/output/temp/generated-code bytes). Arguments alias the
+  resident operands, so the ledger's per-group budget model is
+
+      total = sum(operands) + max over executables(temp + output)
+
+  — the steady-state resident set plus the worst single executable's
+  transient requirement (one executable runs at a time per engine; the
+  batcher is single-threaded by design). The ENGINE total applies the
+  same premise across groups: all operands are resident together, but
+  only the single largest transient is added — summing per-head peaks
+  would refuse multi-head configs that actually fit.
+
+The ledger is pure host-side bookkeeping: populate it at warmup, read
+``summary()`` into metrics/Prometheus, and let the owner refuse to start
+when the model exceeds a declared budget — predicting the OOM before
+hardware discovers it. Layering: obs imports nothing from
+core/trainers/serving (jax only, lazily), so the engine and the trainers
+both feed it.
+
+`device_memory_stats()` is the complementary MEASURED view: the live
+allocator counters (`peak_bytes_in_use` et al.) where the backend
+exposes them (TPU/GPU; CPU returns ``{}``) — the packed train loop folds
+the peak into its goodput summary.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Mapping, Optional
+
+
+def tree_nbytes(tree: Any) -> int:
+    """Total bytes of every array leaf in a pytree (shape x itemsize —
+    attribute reads only, no device-to-host copies)."""
+    import math
+
+    import jax
+    import numpy as np
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        shape = getattr(leaf, "shape", None)
+        if shape is None:
+            continue
+        dtype = getattr(leaf, "dtype", None)
+        itemsize = np.dtype(dtype).itemsize if dtype is not None else 0
+        total += int(math.prod(shape)) * itemsize if itemsize else 0
+    return total
+
+
+def executable_memory_stats(compiled: Any) -> Optional[dict]:
+    """XLA's memory analysis of one AOT-compiled executable, as plain
+    ints: {argument, output, temp, alias, code} bytes. None when the
+    backend/runtime does not expose it (the ledger still counts the
+    executable, with zero transient bytes)."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:  # noqa: BLE001 — accounting must never break serving
+        return None
+    if ma is None:
+        return None
+    try:
+        return {
+            "argument": int(ma.argument_size_in_bytes),
+            "output": int(ma.output_size_in_bytes),
+            "temp": int(ma.temp_size_in_bytes),
+            "alias": int(ma.alias_size_in_bytes),
+            "code": int(ma.generated_code_size_in_bytes),
+        }
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def device_memory_stats(device=None) -> dict:
+    """Live allocator counters of one device ({} where unsupported —
+    CPU's memory_stats() is None). Keys pass through as ints; the
+    interesting ones are ``bytes_in_use`` / ``peak_bytes_in_use`` /
+    ``bytes_limit``."""
+    import jax
+
+    try:
+        d = device if device is not None else jax.local_devices()[0]
+        stats = d.memory_stats()
+    except Exception:  # noqa: BLE001
+        return {}
+    if not stats:
+        return {}
+    out = {}
+    for k, v in stats.items():
+        try:
+            out[str(k)] = int(v)
+        except (TypeError, ValueError):
+            continue
+    return out
+
+
+class MemoryLedger:
+    """Per-group HBM budget model over operands + compiled executables.
+
+    Thread-safe (the engine populates on warmup/staging threads and
+    snapshots on caller threads); all methods are lock-then-dict-ops,
+    never blocking calls under the lock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # group -> {"operands": {name: bytes},
+        #           "executables": {name: stats-dict | None}}
+        self._groups: dict[str, dict] = {}
+
+    def _group(self, group: str) -> dict:
+        return self._groups.setdefault(
+            group, {"operands": {}, "executables": {}}
+        )
+
+    def reset_group(self, group: str) -> None:
+        """Drop a group's entries (re-ledgering after a catalog swap
+        replaced its operands/executables)."""
+        with self._lock:
+            self._groups.pop(group, None)
+
+    def record_operand(self, group: str, name: str, n_bytes: int) -> None:
+        """One resident runtime operand (params, pool, trie, slot state)."""
+        with self._lock:
+            self._group(group)["operands"][name] = int(n_bytes)
+
+    def record_executable(self, group: str, name: str, compiled: Any = None,
+                          *, stats: Optional[Mapping] = None) -> None:
+        """One warmed executable: pass the compiled object (analyzed via
+        ``memory_analysis``) or precomputed ``stats``. Always counted,
+        even when the backend yields no numbers — "ledger present for
+        every warmed executable" is the CI contract."""
+        if stats is None and compiled is not None:
+            stats = executable_memory_stats(compiled)
+        with self._lock:
+            self._group(group)["executables"][name] = (
+                dict(stats) if stats is not None else None
+            )
+
+    # -- reporting -----------------------------------------------------------
+
+    def group_summary(self, group: str) -> dict:
+        with self._lock:
+            g = self._groups.get(group, {"operands": {}, "executables": {}})
+            operands = dict(g["operands"])
+            execs = {k: (dict(v) if v else None)
+                     for k, v in g["executables"].items()}
+        operand_bytes = sum(operands.values())
+        peak_name, peak_bytes, code_bytes, analyzed = None, 0, 0, 0
+        for name, st in execs.items():
+            if st is None:
+                continue
+            analyzed += 1
+            code_bytes += st.get("code", 0)
+            transient = st.get("temp", 0) + st.get("output", 0)
+            if transient >= peak_bytes:
+                peak_name, peak_bytes = name, transient
+        return {
+            "operands": operands,
+            "operand_bytes": operand_bytes,
+            "n_executables": len(execs),
+            "n_executables_analyzed": analyzed,
+            "transient_peak_bytes": peak_bytes,
+            "transient_peak_executable": peak_name,
+            "code_bytes": code_bytes,
+            "total_bytes": operand_bytes + peak_bytes,
+        }
+
+    def executables(self, group: str) -> dict:
+        """Per-executable stats (the breakdown view; summary() keeps the
+        gauge surface to per-group aggregates)."""
+        with self._lock:
+            g = self._groups.get(group)
+            if g is None:
+                return {}
+            return {k: (dict(v) if v else None)
+                    for k, v in g["executables"].items()}
+
+    def summary(self, budget_bytes: Optional[int] = None) -> dict:
+        """The gauge snapshot: per-group aggregates + the budget verdict.
+        Nested-numeric, so it flattens straight into Prometheus
+        exposition (obs/export.py) and the serve/ tracker namespace.
+
+        The cross-group total is Σ all operands + max single transient —
+        one executable runs at a time, so per-group transient peaks
+        never coexist; summing them would over-refuse multi-head
+        configs."""
+        with self._lock:
+            names = sorted(self._groups)
+        heads = {n: self.group_summary(n) for n in names}
+        total = (
+            sum(h["operand_bytes"] for h in heads.values())
+            + max((h["transient_peak_bytes"] for h in heads.values()),
+                  default=0)
+        )
+        out: dict[str, Any] = {"heads": heads, "total_bytes": total}
+        if budget_bytes is not None:
+            out["budget_bytes"] = int(budget_bytes)
+            out["headroom_pct"] = round(
+                100.0 * (1.0 - total / budget_bytes), 2
+            ) if budget_bytes > 0 else 0.0
+            out["over_budget"] = total > budget_bytes
+        return out
+
+    def breakdown_text(self, budget_bytes: Optional[int] = None,
+                       top_executables: int = 3) -> str:
+        """Actionable per-component breakdown (the refusal message): one
+        line per group with its operands, plus the largest executables'
+        transient bytes."""
+        mb = 1.0 / 2**20
+        lines = []
+        summ = self.summary(budget_bytes)
+        for group, h in summ["heads"].items():
+            ops = ", ".join(
+                f"{k}={v * mb:.2f}MB"
+                for k, v in sorted(h["operands"].items(), key=lambda kv: -kv[1])
+            ) or "none"
+            lines.append(
+                f"  {group}: total {h['total_bytes'] * mb:.2f}MB = "
+                f"operands {h['operand_bytes'] * mb:.2f}MB ({ops}) + "
+                f"transient peak {h['transient_peak_bytes'] * mb:.2f}MB "
+                f"({h['transient_peak_executable'] or 'n/a'}; "
+                f"{h['n_executables']} executables)"
+            )
+            execs = [
+                (name, st.get("temp", 0) + st.get("output", 0))
+                for name, st in self.executables(group).items() if st
+            ]
+            for name, b in sorted(execs, key=lambda kv: -kv[1])[:top_executables]:
+                lines.append(f"    executable {name}: transient {b * mb:.2f}MB")
+        head = f"ledger total {summ['total_bytes'] * mb:.2f}MB"
+        if budget_bytes is not None:
+            head += (
+                f" vs budget {budget_bytes * mb:.2f}MB "
+                f"(headroom {summ.get('headroom_pct', 0.0):.1f}%)"
+            )
+        return "\n".join([head, *lines])

@@ -21,25 +21,34 @@ from genrec_tpu.serving.heads import (
 from genrec_tpu.serving.metrics import LatencyHistogram, ServingMetrics
 from genrec_tpu.serving.types import (
     DrainingError,
+    HBMBudgetError,
+    OverloadError,
     Request,
     Response,
     ServingError,
     UnknownHeadError,
 )
 
+# Re-exported so engine users configure SLO targets without reaching
+# into the obs layer themselves (the engine takes `slo_targets=`).
+from genrec_tpu.obs.slo import SLOTarget
+
 __all__ = [
     "BucketLadder",
     "CatalogWatcher",
     "CobraGenerativeHead",
     "DrainingError",
+    "HBMBudgetError",
     "KVPagePool",
     "LatencyHistogram",
+    "OverloadError",
     "PageAllocator",
     "PagedConfig",
     "PoolExhausted",
     "Request",
     "Response",
     "RetrievalHead",
+    "SLOTarget",
     "ServingEngine",
     "ServingError",
     "ServingMetrics",

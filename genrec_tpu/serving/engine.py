@@ -67,6 +67,22 @@ at submit() (`Response.request_id`), with p99-outlier exemplars
 persisted past ring eviction. Tracing is off by default (one attribute
 check per site; budget pinned <2% by scripts/check_obs.py). The flight
 recorder gets lifecycle/drain/hot-reload/OOM-deferral events regardless.
+
+Device-memory ledger (obs/memory.py): warmup sums every compiled
+executable's XLA memory analysis with the logical runtime operands
+(params, KV page pools, catalog trie, paged slot state) into a per-head
+HBM model. ``hbm_budget_bytes=`` makes it a gate — an over-budget
+config is REFUSED at warmup with a per-component breakdown
+(`HBMBudgetError`) instead of OOMing on hardware; the gauges ride every
+stats() snapshot into Prometheus/operator lines.
+
+SLO guard (obs/slo.py): ``slo_targets=`` declares per-head p99 /
+queue-depth / OOM-deferral-rate objectives. The batcher polls the
+monitor off the hot path; a SUSTAINED breach sheds load — new
+submissions get the typed recoverable `OverloadError` while in-flight
+and queued work completes (the drain discipline, reversible) — and
+hysteresis un-sheds once the targets hold again. Zero effect on the
+compiled surface: shedding is pure host-side admission control.
 """
 
 from __future__ import annotations
@@ -84,12 +100,16 @@ import numpy as np
 
 from genrec_tpu.core import chaos
 from genrec_tpu.obs.flight_recorder import get_flight_recorder
+from genrec_tpu.obs.memory import MemoryLedger, tree_nbytes
+from genrec_tpu.obs.slo import SLOMonitor, SLOTarget
 from genrec_tpu.obs.spans import NULL_TRACER, SpanTracer
 from genrec_tpu.serving.buckets import BucketLadder, default_ladder
 from genrec_tpu.serving.kv_pool import KVPagePool, PagedConfig, PoolExhausted
 from genrec_tpu.serving.metrics import ServingMetrics
 from genrec_tpu.serving.types import (
     DrainingError,
+    HBMBudgetError,
+    OverloadError,
     Request,
     Response,
     UnknownHeadError,
@@ -286,7 +306,8 @@ class _PagedRunner:
                 fresh = [e for e in leftover if id(e[1]) not in self._oom_counted]
                 if fresh:  # count each request's deferral ONCE, not per retry
                     self._oom_counted.update(id(e[1]) for e in fresh)
-                    eng.metrics.record_oom_admit(len(fresh))
+                    eng.metrics.record_oom_admit(len(fresh),
+                                                 head=self.head.name)
                     eng._flight.record(
                         "pool_oom_deferred", head=self.head.name,
                         n=len(fresh), pages_free=self.pool.stats().get("pages_free"),
@@ -449,7 +470,8 @@ class _PagedRunner:
                 eng.metrics.record_failure(1)
             else:
                 eng.metrics.record_response(
-                    resp.queue_wait_s, resp.compute_s, resp.total_s
+                    resp.queue_wait_s, resp.compute_s, resp.total_s,
+                    head=head.name,
                 )
                 if tr is not None:
                     tid, root = tr
@@ -493,6 +515,9 @@ class ServingEngine:
         paged: bool = True,
         paged_config: Optional[PagedConfig] = None,
         tracer: Optional[SpanTracer] = None,
+        hbm_budget_bytes: Optional[int] = None,
+        slo_targets=None,
+        slo_poll_secs: float = 0.05,
     ):
         self._heads = {h.name: h for h in heads}
         if len(self._heads) != len(heads):
@@ -545,6 +570,33 @@ class ServingEngine:
         # read. The flight recorder is always on (bounded ring).
         self._tracer = tracer if tracer is not None else NULL_TRACER
         self._flight = get_flight_recorder()
+        # Device-memory ledger (obs/memory.py): populated at warmup from
+        # every compiled executable's XLA memory analysis + the logical
+        # runtime operands; hbm_budget_bytes makes it a hard gate —
+        # warmup REFUSES (HBMBudgetError, per-component breakdown) when
+        # the model exceeds budget, and warns within 10% of it.
+        self.memory = MemoryLedger()
+        self._hbm_budget = (
+            int(hbm_budget_bytes) if hbm_budget_bytes is not None else None
+        )
+        # SLO monitor (obs/slo.py): `slo_targets` is one SLOTarget for
+        # every head or a {head: SLOTarget} dict. The batcher polls
+        # observations off the hot path; a sustained breach sheds load
+        # (typed OverloadError at submit, in-flight work completes) and
+        # hysteresis un-sheds on recovery.
+        if slo_targets is None:
+            self._slo = None
+        else:
+            if isinstance(slo_targets, SLOTarget):
+                targets = {name: slo_targets for name in self._heads}
+            else:
+                targets = dict(slo_targets)
+                unknown = [n for n in targets if n not in self._heads]
+                if unknown:
+                    raise ValueError(f"slo_targets names unknown heads {unknown}")
+            self._slo = SLOMonitor(targets, flight=self._flight)
+        self._slo_poll_secs = float(slo_poll_secs)
+        self._slo_next_poll = 0.0
 
         self.metrics = ServingMetrics()
         self._exec: dict[tuple[str, int, int], object] = {}
@@ -646,6 +698,9 @@ class ServingEngine:
             else:
                 for B, L in self._ladder.combos():
                     self._compile(head, B, L)
+        for head in self._heads.values():
+            self._ledger_head(head)
+        self._enforce_hbm_budget()
         self.metrics.mark_warm()
         self._log.info(
             f"serving warmup: {self.metrics.warmup_compiles} executables "
@@ -653,6 +708,82 @@ class ServingEngine:
             f"buckets; {len(self._runners)} paged decode heads) "
             f"in {time.monotonic() - t0:.1f}s"
         )
+
+    # -- device-memory ledger ------------------------------------------------
+
+    def _ledger_head(self, head) -> None:
+        """(Re)account one head: resident runtime operands + every warmed
+        executable's XLA memory analysis. Called at warmup and again
+        after a catalog swap replaces operands/executables. Attribute
+        reads + host sums only — nothing touches device buffers."""
+        led = self.memory
+        led.reset_group(head.name)
+        led.record_operand(
+            head.name, "params", tree_nbytes(self._select(head, self._params))
+        )
+        ops = head.runtime_operands()
+        if ops:
+            led.record_operand(head.name, "catalog_operands", tree_nbytes(ops))
+        runner = self._runners.get(head.name)
+        if runner is not None:
+            led.record_operand(
+                head.name, "kv_page_pool",
+                tree_nbytes((runner.pool.k_pools, runner.pool.v_pools)),
+            )
+            # Slot state is host-resident numpy between steps but lives
+            # on device during every decode call (and the decode
+            # executable double-buffers what it cannot donate) — budget
+            # it as resident.
+            led.record_operand(
+                head.name, "paged_slot_state", tree_nbytes(runner.state)
+            )
+            for S, ex in runner._decode.items():
+                led.record_executable(head.name, f"decode/S{S}", ex)
+            for (B, L), ex in runner._prefill.items():
+                led.record_executable(head.name, f"prefill/B{B}/L{L}", ex)
+        else:
+            for (name, B, L), ex in self._exec.items():
+                if name == head.name:
+                    led.record_executable(head.name, f"dense/B{B}/L{L}", ex)
+
+    def _enforce_hbm_budget(self, during_swap: bool = False) -> None:
+        """Warmup gate: refuse (typed, with an actionable per-component
+        breakdown) when the ledger model exceeds the declared budget;
+        warn inside the last 10% of headroom. A post-warmup re-check
+        (catalog rung growth) can only WARN — failing the batcher thread
+        mid-serve would be worse than running hot."""
+        if self._hbm_budget is None:
+            return
+        summary = self.memory.summary(budget_bytes=self._hbm_budget)
+        if summary["over_budget"]:
+            breakdown = self.memory.breakdown_text(self._hbm_budget)
+            self._flight.record(
+                "hbm_budget_exceeded", total_bytes=summary["total_bytes"],
+                budget_bytes=self._hbm_budget, during_swap=during_swap,
+            )
+            msg = (
+                f"HBM budget model exceeds hbm_budget_bytes="
+                f"{self._hbm_budget}: predicted "
+                f"{summary['total_bytes']} bytes resident+transient. "
+                "Shrink the bucket ladder / paged pool / catalog, or "
+                f"raise the budget.\n{breakdown}"
+            )
+            if during_swap:
+                self._log.warning(f"serving: {msg}")
+                return
+            raise HBMBudgetError(msg)
+        if summary.get("headroom_pct", 100.0) < 10.0:
+            self._flight.record(
+                "hbm_budget_warning", total_bytes=summary["total_bytes"],
+                budget_bytes=self._hbm_budget,
+                headroom_pct=summary["headroom_pct"],
+            )
+            self._log.warning(
+                "serving: HBM budget headroom is "
+                f"{summary['headroom_pct']:.1f}% "
+                f"({summary['total_bytes']} of {self._hbm_budget} bytes) — "
+                "the next catalog rung or ladder growth will not fit"
+            )
 
     def stop(self, timeout: float = 60.0) -> dict:
         """Drain (finish queued work, reject new) and join the threads.
@@ -716,6 +847,13 @@ class ServingEngine:
         snap = self.metrics.snapshot()
         snap["params_step"] = self._step
         snap["draining"] = self._draining
+        # Device-memory ledger gauges (per-head operand/executable HBM
+        # model + budget headroom) and the SLO shed state ride in every
+        # snapshot, so log_serving_stats / write_prometheus expose them
+        # with the pool gauges.
+        snap["hbm"] = self.memory.summary(budget_bytes=self._hbm_budget)
+        if self._slo is not None:
+            snap["slo"] = self._slo.snapshot()
         return snap
 
     # -- request path --------------------------------------------------------
@@ -730,11 +868,30 @@ class ServingEngine:
         # micro-batch it would have been padded into.
         self._heads[req.head].validate(req)
         with self._lock:
+            # Drain wins over shed: a dying replica must report the
+            # TERMINAL DrainingError ("fail over"), never the
+            # recoverable OverloadError ("retry") — a client backing
+            # off and retrying a draining replica would just watch it
+            # exit.
             if self._draining:
                 self.metrics.record_reject(req.head)
                 raise DrainingError(
                     "engine is draining (shutdown signal received); "
                     "request rejected — fail over to another replica"
+                )
+            # SLO load shed: while the monitor holds this head in
+            # SHEDDING, new submissions bounce with the recoverable
+            # typed error — queued and in-flight work keeps completing
+            # (that completion is what drives recovery), exactly the
+            # drain discipline but reversible via hysteresis. (Monitor
+            # lock nests inside the engine lock; the monitor never
+            # takes the engine lock, so the order is acyclic.)
+            if self._slo is not None and self._slo.is_shedding(req.head):
+                self.metrics.record_overload(req.head)
+                raise OverloadError(
+                    f"head {req.head!r} is load-shedding "
+                    f"({self._slo.shed_reason(req.head)}); back off and "
+                    "retry or fail over to another replica"
                 )
             # Trace context minted AT submit: (request/trace id, pre-
             # allocated root span id) so spans recorded before the root
@@ -746,7 +903,7 @@ class ServingEngine:
             entry = (req, Future(), time.monotonic(), tr)
             self._queues[req.head].append(entry)
             self._work.notify()
-        self.metrics.record_submit()
+        self.metrics.record_submit(head=req.head)
         return entry[1]
 
     def serve(self, req: Request, timeout: Optional[float] = 60.0) -> Response:
@@ -774,6 +931,7 @@ class ServingEngine:
                         )
                     swap_pending = self._apply_pending_params()
                     swap_pending |= self._apply_pending_catalog()
+                    self._poll_slo()
                     # Slot-level continuous batching: admit queued requests
                     # into free slots (paused while a params OR catalog
                     # swap is staged, so every request decodes under ONE
@@ -811,6 +969,34 @@ class ServingEngine:
                     self._log.exception("serving: batcher iteration failed")
         finally:
             self._drained.set()
+
+    def _poll_slo(self) -> None:
+        """Feed the SLO monitor (batcher thread, rate-limited to
+        ``slo_poll_secs``): windowed p99 from the metrics' recent-latency
+        ring, live queue depths, and the cumulative deferral/submit
+        counters the monitor differences over its window. The idle loop
+        still iterates (condition-wait timeouts), so recovery keeps
+        being evaluated when traffic stops."""
+        if self._slo is None:
+            return
+        now = time.monotonic()
+        if now < self._slo_next_poll:
+            return
+        self._slo_next_poll = now + self._slo_poll_secs
+        with self._lock:
+            depths = {name: len(q) for name, q in self._queues.items()}
+        for head, target in self._slo.targets.items():
+            # Every observation is PER HEAD (latency ring, queue, and
+            # the deferral/submit counters): one head's pool pressure
+            # or slow decode must never shed a healthy co-hosted head.
+            self._slo.observe(
+                head,
+                p99_ms=self.metrics.recent_p99_ms(target.window_s, head=head),
+                queue_depth=depths.get(head, 0),
+                oom_deferred_total=self.metrics.oom_deferred_by_head[head],
+                submitted_total=self.metrics.submitted_by_head[head],
+                now=now,
+            )
 
     def _next_batch(self):
         """Pop the next flush-ready head queue: full micro-batch, oldest
@@ -883,7 +1069,8 @@ class ServingEngine:
                 request_id=tr[0] if tr is not None else None,
             )
             self.metrics.record_response(
-                resp.queue_wait_s, resp.compute_s, resp.total_s
+                resp.queue_wait_s, resp.compute_s, resp.total_s,
+                head=head.name,
             )
             if tr is not None:
                 # Dense whole-batch span tree: queue -> compute (the
@@ -1133,10 +1320,15 @@ class ServingEngine:
             if runner is not None and runner_exec is not None:
                 runner._decode, runner._prefill = runner_exec
             self.metrics.record_catalog_swap()
+            # Re-ledger the swapped head: the trie operand changed size
+            # and a rung growth installed new executables. Post-warmup
+            # the budget check can only warn (never fail the batcher).
+            self._ledger_head(head)
             self._flight.record(
                 "catalog_swapped", head=name, version=snapshot.version
             )
             self._log.info(
                 f"serving: head {name} now serving catalog {snapshot.version}"
             )
+        self._enforce_hbm_budget(during_swap=True)
         return False

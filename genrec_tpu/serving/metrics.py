@@ -93,8 +93,27 @@ class ServingMetrics:
         self.oom_deferred_admits = 0
         self.decode_steps = 0
         self.rejected_by_head: collections.Counter = collections.Counter()
+        # Per-head submit/deferral attribution (the SLO monitor's rate
+        # denominators/numerators — engine totals would let one head's
+        # pool pressure read as every head's breach).
+        self.submitted_by_head: collections.Counter = collections.Counter()
+        self.oom_deferred_by_head: collections.Counter = collections.Counter()
         self.pool_gauges: dict[str, dict] = {}
+        # SLO load shedding (obs/slo.py via the engine): submissions
+        # rejected with the typed OverloadError while a head sheds.
+        # Separate from `rejected` — that one means draining (terminal);
+        # overload is recoverable and per-head attributed.
+        self.overload_rejected = 0
+        self.overload_by_head: collections.Counter = collections.Counter()
         self._recent = collections.deque(maxlen=recent_window)
+        # PER-HEAD rings of (t, total_s) samples for SLIDING-WINDOW
+        # percentiles — the SLO monitor evaluates p99 over its window,
+        # not over the lifetime histogram (which can never recover from
+        # an old bad minute). One bounded ring per head: a high-QPS
+        # head can neither read as a breach on a healthy co-hosted head
+        # nor evict a quiet head's samples out of evaluation.
+        self._recent_window = recent_window
+        self._recent_lat: dict = {}
         self._started = time.monotonic()
         self._warm = False
 
@@ -113,9 +132,11 @@ class ServingMetrics:
             else:
                 self.warmup_compiles += 1
 
-    def record_submit(self) -> None:
+    def record_submit(self, head: str | None = None) -> None:
         with self._lock:
             self.submitted += 1
+            if head is not None:
+                self.submitted_by_head[head] += 1
 
     def record_reject(self, head: str | None = None) -> None:
         """Draining rejection; per-head attribution feeds the drain report
@@ -126,6 +147,12 @@ class ServingMetrics:
             if head is not None:
                 self.rejected_by_head[head] += 1
 
+    def record_overload(self, head: str) -> None:
+        """SLO load-shed rejection (typed OverloadError at submit)."""
+        with self._lock:
+            self.overload_rejected += 1
+            self.overload_by_head[head] += 1
+
     def record_admit(self, n: int = 1) -> None:
         with self._lock:
             self.admits += n
@@ -134,13 +161,16 @@ class ServingMetrics:
         with self._lock:
             self.evictions += n
 
-    def record_oom_admit(self, n: int = 1) -> None:
+    def record_oom_admit(self, n: int = 1, head: str | None = None) -> None:
         """Admissions DEFERRED because the KV pool had no pages/slots —
         the request stays queued and retries as evictions free pages, so
         a nonzero rate means the pool budget, not the arrival rate, is
-        the bottleneck."""
+        the bottleneck. Per-head attribution feeds the SLO monitor: one
+        head's pool pressure must not shed a healthy co-hosted head."""
         with self._lock:
             self.oom_deferred_admits += n
+            if head is not None:
+                self.oom_deferred_by_head[head] += n
 
     def record_decode_step(self) -> None:
         with self._lock:
@@ -167,7 +197,8 @@ class ServingMetrics:
             self.batches += 1
             self.bucket_hits[(head, *bucket)] += 1
 
-    def record_response(self, queue_wait: float, compute: float, total: float) -> None:
+    def record_response(self, queue_wait: float, compute: float, total: float,
+                        head: str | None = None) -> None:
         now = time.monotonic()
         with self._lock:
             self.queue_wait.record(queue_wait)
@@ -175,6 +206,34 @@ class ServingMetrics:
             self.total.record(total)
             self.completed += 1
             self._recent.append(now)
+            ring = self._recent_lat.get(head)
+            if ring is None:
+                ring = self._recent_lat[head] = collections.deque(
+                    maxlen=self._recent_window
+                )
+            ring.append((now, float(total)))
+
+    def recent_p99_ms(self, window_s: float, head: str | None = None,
+                      q: float = 0.99, min_count: int = 20) -> float | None:
+        """Total-latency quantile over responses completed within the
+        last ``window_s`` seconds — one head's ring when given, pooled
+        over every head otherwise — or None below ``min_count`` samples
+        (an empty window must not read as 'SLO met at 0ms' — the SLO
+        monitor skips the latency dimension instead). Only the ring
+        copy happens under the lock; filter + sort run outside it, off
+        the response hot path."""
+        cut = time.monotonic() - window_s
+        with self._lock:
+            if head is None:
+                samples = [s for ring in self._recent_lat.values()
+                           for s in ring]
+            else:
+                ring = self._recent_lat.get(head)
+                samples = list(ring) if ring else []
+        vals = sorted(v for t, v in samples if t >= cut)
+        if len(vals) < min_count:
+            return None
+        return vals[min(len(vals) - 1, int(q * len(vals)))] * 1e3
 
     def slow_threshold_s(self, q: float = 0.99, min_count: int = 64) -> float | None:
         """Latency above which a request counts as a slow outlier (the
@@ -220,8 +279,11 @@ class ServingMetrics:
                 evictions=self.evictions,
                 oom_deferred_admits=self.oom_deferred_admits,
                 decode_steps=self.decode_steps,
+                overload_rejected=self.overload_rejected,
             )
             rejected_by_head = dict(sorted(self.rejected_by_head.items()))
+            overload_by_head = dict(sorted(self.overload_by_head.items()))
+            oom_deferred_by_head = dict(sorted(self.oom_deferred_by_head.items()))
             kv_pool = {h: dict(g) for h, g in sorted(self.pool_gauges.items())}
         return {
             **counts,
@@ -232,5 +294,7 @@ class ServingMetrics:
             "total_ms": self.total.summary(),
             "bucket_hits": bucket_hits,
             "rejected_by_head": rejected_by_head,
+            "overload_by_head": overload_by_head,
+            "oom_deferred_by_head": oom_deferred_by_head,
             "kv_pool": kv_pool,
         }

@@ -25,6 +25,23 @@ class DrainingError(ServingError):
     are rejected with this typed error so callers can fail over."""
 
 
+class OverloadError(ServingError):
+    """The head's SLO monitor is load-shedding (sustained breach of a
+    declared target — p99 latency, queue depth, or OOM-deferral rate):
+    new submissions are rejected with this typed error while in-flight
+    and queued work completes — the same discipline as drain, but
+    recoverable: hysteresis un-sheds once the targets hold again, so
+    callers should back off and retry or fail over to another replica."""
+
+
+class HBMBudgetError(ServingError):
+    """The memory ledger's warmup model (every compiled executable's
+    XLA memory analysis + the logical runtime operands) exceeds the
+    declared ``hbm_budget_bytes``: the engine refuses to start instead
+    of letting the device discover the OOM under load. The message
+    carries the per-component breakdown."""
+
+
 class UnknownHeadError(ServingError, KeyError):
     """Request names a head the engine was not built with."""
 

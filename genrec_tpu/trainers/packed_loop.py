@@ -73,6 +73,7 @@ from genrec_tpu.core.profiling import StepTimer, log_epoch_perf
 from genrec_tpu.data.batching import batch_iterator, prefetch_to_device
 from genrec_tpu.obs.flight_recorder import get_flight_recorder
 from genrec_tpu.obs.goodput import CompileEvents, GoodputMeter, fleet_goodput
+from genrec_tpu.obs.memory import device_memory_stats
 from genrec_tpu.obs.spans import NULL_TRACER
 
 
@@ -294,9 +295,13 @@ class PackedTrainLoop:
         self.prof.close()
         run = self.goodput.run_report()
         if run["wall_s"] > 0 and self._steps_run:
+            mem = device_memory_stats()
+            peak = mem.get("peak_bytes_in_use")
             self.logger.info(
                 f"run goodput {run['goodput_pct']:.1f}% over "
                 f"{run['wall_s']:.1f}s wall (see goodput/* metrics)"
+                + (f"; peak device memory {peak / 2**20:.1f} MB"
+                   if peak else "")
             )
         self.tracker.finish()
         self._flight.record(
@@ -461,6 +466,13 @@ class PackedTrainLoop:
                 self.monitor.skipped_steps - skipped_before
             )
             report = self.goodput.end_epoch()
+            # Peak device bytes ride the goodput summary where the
+            # backend exposes allocator stats (TPU/GPU; CPU has none) —
+            # the trainers' view of the same HBM lever the serving
+            # ledger budgets (obs/memory.py).
+            mem = device_memory_stats()
+            if mem.get("peak_bytes_in_use"):
+                report["peak_device_bytes"] = mem["peak_bytes_in_use"]
             log_goodput(self.logger, self.tracker, epoch, report)
             if jax.process_count() > 1:
                 # obs imports nothing upward (graftlint layering): the

@@ -5,7 +5,8 @@ Built on the shared graftlint harness (genrec_tpu/analysis/ir.py) for the
 CLI and one-verdict-JSON conventions; CLI, verdict schema and rc are
 unchanged.
 
-What it proves (the ISSUE-7 acceptance, CI-sized):
+What it proves (the ISSUE-7 acceptance plus the ISSUE-10 device-memory
+ledger and SLO guard, CI-sized):
 
 1. A single served request through the PAGED generative path yields a
    COMPLETE span tree — request -> queue_wait / admission / prefill /
@@ -19,6 +20,16 @@ What it proves (the ISSUE-7 acceptance, CI-sized):
    microbenchmark x the per-request call count) must be <2% of the
    measured per-request latency. bench.py's serve.obs section carries
    the complementary tracing-ON closed-loop sweep.
+4. The memory ledger (obs/memory.py) accounts EVERY warmed executable
+   of the engine in (1) plus its runtime operands, its per-head sums are
+   internally consistent (total == operands + transient peak), and the
+   ledger gauges survive Prometheus exposition.
+5. The SLO monitor (obs/slo.py) sheds under a synthetic overload —
+   sustained queue breach -> typed OverloadError for new submissions
+   while every accepted request completes — recovery un-sheds, and the
+   steady state never recompiles. GENREC_CI_SKIP_SLO=1 skips this
+   section (same contract as the other GENREC_CI_SKIP_* knobs) for
+   callers whose pytest pass already runs the SLO tests directly.
 
 Exit codes: 0 ok, 1 check failed. Stdout is one verdict JSON
 (ci_checks.sh convention); human detail goes to stderr.
@@ -111,6 +122,7 @@ def check_serve_trace(tmp: str) -> dict:
         if n_decode < 2:  # sem_id_dim=3, first code resolved at prefill
             raise AssertionError(f"expected >=2 decode_step spans, got {n_decode}")
         log(f"span tree OK: {names}, {n_decode} decode steps")
+        memory = check_memory_ledger(eng)
     finally:
         eng.stop()
 
@@ -136,6 +148,140 @@ def check_serve_trace(tmp: str) -> dict:
         "n_trace_events": len(data["traceEvents"]),
         "p50_request_ms": summary["phases"]["request"]["p50_ms"],
         "mean_latency_s": sum(lat_s) / len(lat_s),
+        "memory": memory,
+    }
+
+
+def check_memory_ledger(eng) -> dict:
+    """ISSUE-10 acceptance, CI-sized: the ledger holds an entry for
+    EVERY warmed executable, every runtime operand class the paged head
+    carries is accounted, the per-head sums are consistent, and the
+    gauges survive Prometheus exposition."""
+    from genrec_tpu.obs import prometheus_text
+
+    st = eng.stats()
+    head = st["hbm"]["heads"].get("tiger")
+    if head is None:
+        raise AssertionError("memory ledger has no entry for the tiger head")
+    if head["n_executables"] != st["warmup_compiles"]:
+        raise AssertionError(
+            f"ledger holds {head['n_executables']} executables but warmup "
+            f"compiled {st['warmup_compiles']} — a warmed executable is "
+            "missing from the ledger"
+        )
+    want_ops = {"params", "catalog_operands", "kv_page_pool",
+                "paged_slot_state"}
+    missing = want_ops - set(head["operands"])
+    if missing:
+        raise AssertionError(f"ledger missing runtime operands: {missing}")
+    if any(v <= 0 for v in head["operands"].values()):
+        raise AssertionError(f"zero-byte operand entries: {head['operands']}")
+    if head["total_bytes"] != head["operand_bytes"] + head["transient_peak_bytes"]:
+        raise AssertionError(
+            f"ledger sums inconsistent: total {head['total_bytes']} != "
+            f"operands {head['operand_bytes']} + transient peak "
+            f"{head['transient_peak_bytes']}"
+        )
+    if st["hbm"]["total_bytes"] < head["total_bytes"]:
+        raise AssertionError("engine total smaller than its one head")
+    text = prometheus_text(st)
+    for needle in ("genrec_hbm_heads_tiger_total_bytes",
+                   "genrec_hbm_heads_tiger_operand_bytes",
+                   "genrec_hbm_total_bytes"):
+        if needle not in text:
+            raise AssertionError(f"ledger gauge {needle} missing from "
+                                 "Prometheus exposition")
+    log(f"memory ledger OK: {head['n_executables']} executables, "
+        f"{head['operand_bytes']} operand bytes, "
+        f"total {head['total_bytes']} bytes")
+    return {
+        "n_executables": head["n_executables"],
+        "operand_bytes": head["operand_bytes"],
+        "total_bytes": head["total_bytes"],
+        "sums_consistent": True,
+        "ledger_complete": True,
+    }
+
+
+def check_slo_shed() -> dict:
+    """Synthetic overload: an aggressive queue-depth target sheds under
+    a submit flood (typed OverloadError), every ACCEPTED request still
+    completes, hysteresis un-sheds once the queue drains, and the whole
+    episode never recompiles."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from genrec_tpu.models.sasrec import SASRec
+    from genrec_tpu.obs import get_flight_recorder
+    from genrec_tpu.serving import (
+        BucketLadder, OverloadError, Request, RetrievalHead, SLOTarget,
+        ServingEngine,
+    )
+
+    model = SASRec(num_items=30, max_seq_len=8, embed_dim=16, num_heads=2,
+                   num_blocks=1, ffn_dim=32, dropout=0.0)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((2, 8), jnp.int32))["params"]
+    rng = np.random.default_rng(3)
+    eng = ServingEngine(
+        [RetrievalHead("sasrec", model, top_k=5)], params,
+        ladder=BucketLadder((1, 2), (8,)), max_batch=2, max_wait_ms=1.0,
+        handle_signals=False,
+        slo_targets=SLOTarget(max_queue_depth=2, window_s=1.0,
+                              breach_s=0.0, recover_s=0.05),
+        slo_poll_secs=0.005,
+    ).start()
+    try:
+        accepted, shed = [], False
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            try:
+                accepted.append(eng.submit(
+                    Request(head="sasrec", history=rng.integers(1, 31, 5))
+                ))
+            except OverloadError:
+                shed = True
+                break
+        if not shed:
+            raise AssertionError("synthetic overload never shed")
+        resps = [f.result(120) for f in accepted]
+        if len(resps) != len(accepted):
+            raise AssertionError("accepted requests dropped during shed")
+        recovered = False
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            try:
+                eng.submit(Request(head="sasrec",
+                                   history=rng.integers(1, 31, 5))).result(60)
+                recovered = True
+                break
+            except OverloadError:
+                time.sleep(0.01)
+        if not recovered:
+            raise AssertionError("shed never recovered after the queue drained")
+        st = eng.stats()
+        if st["overload_rejected"] < 1:
+            raise AssertionError("no overload rejection counted")
+        if st["recompilations"] != 0:
+            raise AssertionError(
+                f"SLO shedding recompiled: {st['recompilations']}")
+        breaches = st["slo"]["heads"]["sasrec"]["breaches"]
+        flight = [e for e in get_flight_recorder().events("slo_breach")]
+        if not flight:
+            raise AssertionError("no slo_breach flight event recorded")
+    finally:
+        eng.stop()
+    log(f"slo OK: shed after {len(accepted)} accepted, all completed, "
+        f"recovered; {st['overload_rejected']} overload rejections, "
+        f"{breaches} breach(es)")
+    return {
+        "shed": True,
+        "accepted_completed": len(resps),
+        "recovered": True,
+        "overload_rejected": st["overload_rejected"],
+        "breaches": breaches,
+        "recompilations": st["recompilations"],
     }
 
 
@@ -254,7 +400,18 @@ def main(argv=None) -> int:
             serve = check_serve_trace(tmp)
             train = check_train_goodput(os.path.join(tmp, "train"))
             overhead = check_disabled_overhead(serve["mean_latency_s"])
-        verdict.update(ok=True, serve=serve, train=train, overhead=overhead)
+            # GENREC_CI_SKIP_SLO=1 skips the synthetic-overload section
+            # for callers whose pytest pass already runs the SLO tests
+            # (tests/test_obs.py) directly — same contract as the
+            # GENREC_CI_SKIP_* knobs in ci_checks.sh.
+            if os.environ.get("GENREC_CI_SKIP_SLO"):
+                slo = {"skipped": True}
+                log("slo section skipped (GENREC_CI_SKIP_SLO)")
+            else:
+                slo = check_slo_shed()
+        memory = serve.pop("memory")
+        verdict.update(ok=True, serve=serve, train=train, overhead=overhead,
+                       memory=memory, slo=slo)
     except AssertionError as e:
         verdict["error"] = str(e)
         log(f"FAILED: {e}")

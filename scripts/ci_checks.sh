@@ -17,8 +17,19 @@
 #                            complete per-request span tree + valid
 #                            Chrome-trace JSON, a traced train loop's
 #                            goodput buckets sum to wall time with
-#                            strict-JSON metrics.jsonl, and tracing-off
-#                            overhead stays under the 2% budget
+#                            strict-JSON metrics.jsonl, tracing-off
+#                            overhead stays under the 2% budget, the
+#                            memory ledger accounts every warmed
+#                            executable with consistent sums, and the
+#                            SLO monitor sheds/recovers under synthetic
+#                            overload (GENREC_CI_SKIP_SLO=1 skips the
+#                            overload section)
+#   bench_gate.py          — perf regression gate: fixture self-test
+#                            (an injected ~10% regression must be
+#                            flagged, an identical run must pass), and
+#                            in full mode the newest BENCH_r*.json is
+#                            gated against results/bench_baseline.json
+#                            (direction-aware, noise-band tolerant)
 #   graftlint.py           — repo-wide static analysis (ISSUE 8): AST
 #                            layering/trace-purity/lock-discipline +
 #                            IR rules (constant bake, donation audit,
@@ -103,12 +114,18 @@ if [ "$MODE" = "--smoke" ]; then
         run python scripts/check_catalog_hlo.py --small --platform cpu
     fi
     # Obs smoke (traced serve span tree + goodput schema + overhead
-    # budget). GENREC_CI_SKIP_OBS=1 skips it for callers whose pytest
-    # pass already runs tests/test_obs.py directly (same contract as
-    # GENREC_CI_SKIP_CHAOS below).
+    # budget + memory ledger + SLO shed). GENREC_CI_SKIP_OBS=1 skips it
+    # for callers whose pytest pass already runs tests/test_obs.py
+    # directly (same contract as GENREC_CI_SKIP_CHAOS below);
+    # GENREC_CI_SKIP_SLO=1 skips only the synthetic-overload section
+    # inside the check.
     if [ -z "${GENREC_CI_SKIP_OBS:-}" ]; then
         run python scripts/check_obs.py --small --platform cpu
     fi
+    # Perf-gate self-test (jax-free, sub-second): the gate must flag an
+    # injected ~10% regression on its fixture baseline and pass an
+    # identical run — a gate that stopped biting is a green-CI lie.
+    run python scripts/bench_gate.py --self-test
     # graftlint (AST + IR over the compile manifest). GENREC_CI_SKIP_LINT=1
     # skips it for callers whose pytest pass already runs
     # tests/test_analysis.py directly (same contract as the obs/chaos
@@ -156,6 +173,10 @@ else
     run python scripts/check_catalog_hlo.py --write-note
     run python scripts/check_obs.py
     run python scripts/graftlint.py
+    # Perf regression gate: self-test, then the newest committed
+    # BENCH_r*.json against results/bench_baseline.json (rc=2 tolerated:
+    # no run file yet, or a backend-mismatched fallback line).
+    run python scripts/bench_gate.py
     # Full serving suite (incl. the slow all-four-heads drain test, the
     # slow COBRA trie-constraint pins, and the full paged-parity matrix).
     run_strict env JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py \

@@ -5,6 +5,7 @@ Usage:
     python scripts/trace_report.py out/serve/trace.json
     python scripts/trace_report.py trace.json --json      # machine-readable
     python scripts/trace_report.py trace.json --phase decode_step
+    python scripts/trace_report.py --compare A.json B.json
 
 Per-phase (span-name) latency summary — count, total, p50/p95/p99/max —
 plus the number of distinct traces (requests / epochs), the slow-request
@@ -13,6 +14,11 @@ carries a goodput section (scripts/check_obs.py and the packed loop's
 dumps embed one), the goodput breakdown. The same file opens in Perfetto
 (https://ui.perfetto.dev) or chrome://tracing for the visual view; this
 CLI is the grep-speed alternative.
+
+``--compare A.json B.json`` diffs two trace files per phase — p50/p95/
+p99 deltas (ms and %) from A to B — so "what did this change do to
+serving latency" is one command against two span dumps instead of
+eyeballing two Perfetto tabs.
 
 Exit codes: 0 ok, 1 unreadable/invalid trace file.
 """
@@ -99,14 +105,78 @@ def print_report(report: dict) -> None:
                 print(f"  {k:<18} {v:>9.3f}s  {100 * v / wall:>5.1f}%")
 
 
+def compare_reports(rep_a: dict, rep_b: dict) -> dict:
+    """Per-phase p50/p95/p99 deltas from A to B (positive = B slower)."""
+    phases_a, phases_b = rep_a["phases"], rep_b["phases"]
+    out: dict = {"phases": {}, "only_in_a": [], "only_in_b": []}
+    for name in sorted(set(phases_a) | set(phases_b)):
+        a, b = phases_a.get(name), phases_b.get(name)
+        if a is None:
+            out["only_in_b"].append(name)
+            continue
+        if b is None:
+            out["only_in_a"].append(name)
+            continue
+        row = {"count_a": a["count"], "count_b": b["count"]}
+        for q in ("p50_ms", "p95_ms", "p99_ms"):
+            row[f"{q}_a"] = a[q]
+            row[f"{q}_b"] = b[q]
+            row[f"{q}_delta"] = round(b[q] - a[q], 3)
+            row[f"{q}_delta_pct"] = (
+                round(100.0 * (b[q] - a[q]) / a[q], 1) if a[q] else None
+            )
+        out["phases"][name] = row
+    return out
+
+
+def print_compare(cmp: dict, path_a: str, path_b: str) -> None:
+    print(f"A = {path_a}\nB = {path_b}")
+    if cmp["phases"]:
+        w = max(len(n) for n in cmp["phases"])
+        print(f"{'phase':<{w}}  {'p50 A':>8} {'p50 B':>8} {'Δ%':>7}  "
+              f"{'p95 A':>8} {'p95 B':>8} {'Δ%':>7}  "
+              f"{'p99 A':>8} {'p99 B':>8} {'Δ%':>7}  (ms; +Δ = B slower)")
+        for name, r in cmp["phases"].items():
+            cells = []
+            for q in ("p50_ms", "p95_ms", "p99_ms"):
+                pct = r[f"{q}_delta_pct"]
+                cells.append(f"{r[f'{q}_a']:>8.2f} {r[f'{q}_b']:>8.2f} "
+                             f"{(f'{pct:+.1f}' if pct is not None else 'n/a'):>7}")
+            print(f"{name:<{w}}  " + "  ".join(cells))
+    else:
+        print("no phases present in both traces")
+    if cmp["only_in_a"]:
+        print(f"phases only in A: {', '.join(cmp['only_in_a'])}")
+    if cmp["only_in_b"]:
+        print(f"phases only in B: {', '.join(cmp['only_in_b'])}")
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("trace", help="Chrome-trace JSON file (obs span dump)")
+    ap.add_argument("trace", nargs="?", default=None,
+                    help="Chrome-trace JSON file (obs span dump)")
     ap.add_argument("--json", action="store_true", help="print JSON report")
     ap.add_argument("--phase", default=None,
                     help="restrict the summary to one span name")
+    ap.add_argument("--compare", nargs=2, metavar=("A", "B"), default=None,
+                    help="diff two trace files per phase (p50/p95/p99 "
+                         "deltas A -> B)")
     args = ap.parse_args(argv)
+    if (args.trace is None) == (args.compare is None):
+        ap.error("pass one trace file, or --compare A.json B.json")
     try:
+        if args.compare is not None:
+            path_a, path_b = args.compare
+            cmp = compare_reports(
+                summarize(load_trace(path_a), phase=args.phase),
+                summarize(load_trace(path_b), phase=args.phase),
+            )
+            if args.json:
+                json.dump(cmp, sys.stdout, indent=2)
+                print()
+            else:
+                print_compare(cmp, path_a, path_b)
+            return 0
         data = load_trace(args.trace)
     except (OSError, ValueError, json.JSONDecodeError) as e:
         print(f"trace_report: {e}", file=sys.stderr)

@@ -28,6 +28,13 @@ FUSED_KEYS = {"backend", "devices", "conclusive", "mosaic_custom_calls",
               "collectives_in_module", "all_gather_feeding_custom_call",
               "global_sized_custom_call_operands", "ok"}
 SERVING_KEYS = {"backend", "dense", "paged", "recompilations", "ok"}
+# bench_gate is the new perf regression gate (one verdict line,
+# graftlint mold); check_obs's grown verdict (memory + slo sections) is
+# exercised by its own full run in ci_checks, not re-run here.
+BENCH_GATE_KEYS = {"check", "ok", "self_test", "compared", "regressions",
+                   "improvements", "within_band", "missing",
+                   "backend_skipped", "skipped", "baseline", "run",
+                   "updated"}
 
 
 def _load(name):
@@ -88,7 +95,7 @@ def test_ci_checks_smoke_entrypoint():
     # tests/test_fault_tolerance.py, tests/test_obs.py,
     # tests/test_analysis.py and tests/test_catalog.py directly, and
     # nesting them would double-pay their cold-start (~30s each) for no
-    # coverage.
+    # coverage. The (jax-free, sub-second) bench_gate self-test stays.
     proc = subprocess.run(
         ["bash", os.path.join(REPO, "scripts", "ci_checks.sh"), "--smoke"],
         capture_output=True, text=True, timeout=600,
@@ -98,11 +105,109 @@ def test_ci_checks_smoke_entrypoint():
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     # One verdict JSON per check on stdout (decode, fused-ce, packed,
-    # serving).
+    # serving, bench-gate self-test).
     verdicts = [json.loads(l) for l in proc.stdout.splitlines() if l.strip()]
-    assert len(verdicts) == 4
+    assert len(verdicts) == 5
     serving = [v for v in verdicts if "recompilations" in v]
     assert len(serving) == 1 and serving[0]["recompilations"] == 0
     assert set(serving[0]) == SERVING_KEYS  # harness migration parity
     decode = [v for v in verdicts if "cached_broadcast_hits" in v]
     assert len(decode) == 1 and set(decode[0]) == DECODE_KEYS
+    gate = [v for v in verdicts if v.get("check") == "bench_gate"]
+    assert len(gate) == 1 and set(gate[0]) == BENCH_GATE_KEYS
+    assert gate[0]["self_test"]["ok"] and gate[0]["ok"]
+
+
+# ---------------------------------------------------------------------------
+# bench_gate fixtures (jax-free: direction, tolerance, partial refusal)
+# ---------------------------------------------------------------------------
+
+
+def _fixture_run(**overrides):
+    run = {
+        "metric": "tiger_train_seq_per_sec_per_chip", "value": 1000.0,
+        "step_ms": 10.0, "backend": "tpu", "packed_vs_padded": 1.9,
+        "serve": {"p99_ms": 20.0}, "meta": {"schema": 1, "backend": "tpu"},
+    }
+    run.update(overrides)
+    return run
+
+
+def test_bench_gate_flags_injected_regression(tmp_path, capsys):
+    """ISSUE-10 acceptance: an injected ~10%+ regression on a fixture
+    baseline is flagged (rc 1), an identical run passes (rc 0), and an
+    improvement is reported without failing."""
+    gate = _load("bench_gate")
+    base = tmp_path / "baseline.json"
+    run = tmp_path / "run.json"
+    run.write_text(json.dumps(_fixture_run()))
+    assert gate.main([str(run), "--baseline", str(base),
+                      "--update-baseline"]) == 0
+    capsys.readouterr()
+
+    # identical run passes
+    assert gate.main([str(run), "--baseline", str(base)]) == 0
+    v = json.loads(capsys.readouterr().out)
+    assert set(v) == BENCH_GATE_KEYS
+    assert v["ok"] and not v["regressions"] and v["compared"] >= 3
+
+    # ~12% headline drop (10% band) + ~35% p99 rise (30% band) -> rc 1
+    run.write_text(json.dumps(_fixture_run(
+        value=880.0, serve={"p99_ms": 27.0})))
+    assert gate.main([str(run), "--baseline", str(base)]) == 1
+    v = json.loads(capsys.readouterr().out)
+    flagged = {e["metric"] for e in v["regressions"]}
+    assert flagged == {"value", "serve/p99_ms"}, v["regressions"]
+
+    # an improvement passes and is reported as such
+    run.write_text(json.dumps(_fixture_run(value=1300.0)))
+    assert gate.main([str(run), "--baseline", str(base)]) == 0
+    v = json.loads(capsys.readouterr().out)
+    assert {e["metric"] for e in v["improvements"]} == {"value"}
+
+
+def test_bench_gate_refuses_partial_update_and_skips_backend_mismatch(
+        tmp_path, capsys):
+    gate = _load("bench_gate")
+    base = tmp_path / "baseline.json"
+    run = tmp_path / "run.json"
+    run.write_text(json.dumps(_fixture_run()))
+    assert gate.main([str(run), "--baseline", str(base),
+                      "--update-baseline"]) == 0
+    capsys.readouterr()
+    # partial run (headline metric gone) must refuse the update
+    partial = {k: v for k, v in _fixture_run().items() if k != "value"}
+    run.write_text(json.dumps(partial))
+    assert gate.main([str(run), "--baseline", str(base),
+                      "--update-baseline"]) == 1
+    v = json.loads(capsys.readouterr().out)
+    assert not v["updated"] and "partial" in v["skipped"]
+    # a cpu-fallback line against a tpu baseline is SKIPPED (rc 2), not
+    # flagged as a hardware regression
+    run.write_text(json.dumps(_fixture_run(
+        value=500.0, backend="cpu", meta={"schema": 1, "backend": "cpu"})))
+    assert gate.main([str(run), "--baseline", str(base)]) == 2
+    v = json.loads(capsys.readouterr().out)
+    assert v["ok"] and "backend mismatch" in v["skipped"]
+    assert not v["regressions"]
+    # ...and it must not be able to REWRITE the tpu baseline either, or
+    # every later hardware comparison would rc-2-skip forever
+    assert gate.main([str(run), "--baseline", str(base),
+                      "--update-baseline"]) == 1
+    v = json.loads(capsys.readouterr().out)
+    assert not v["updated"] and "across backends" in v["skipped"]
+    assert json.loads(base.read_text())["meta"]["backend"] == "tpu"
+
+
+def test_bench_gate_committed_baseline_is_loadable():
+    """The seeded results/bench_baseline.json stays schema-valid and
+    gates at least the headline metric with a direction."""
+    path = os.path.join(REPO, "results", "bench_baseline.json")
+    with open(path) as fh:
+        base = json.load(fh)
+    assert base["schema"] == 1
+    assert "value" in base["metrics"]
+    for spec in base["metrics"].values():
+        assert spec["direction"] in ("higher", "lower")
+        assert spec["tolerance_pct"] > 0
+        assert isinstance(spec["value"], (int, float))

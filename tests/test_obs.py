@@ -33,9 +33,14 @@ from genrec_tpu.obs import (
     CompileEvents,
     FlightRecorder,
     GoodputMeter,
+    MemoryLedger,
+    SLOMonitor,
+    SLOTarget,
     SpanTracer,
+    device_memory_stats,
     get_flight_recorder,
     prometheus_text,
+    tree_nbytes,
 )
 from genrec_tpu.obs.spans import NULL_TRACER
 from genrec_tpu.parallel import get_mesh, replicate
@@ -316,6 +321,312 @@ def test_flight_recorder_excepthook_chains(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# memory ledger (ISSUE 10 tentpole)
+# ---------------------------------------------------------------------------
+
+
+def test_tree_nbytes_counts_leaves():
+    tree = {"a": np.zeros((4, 8), np.float32),
+            "b": (np.zeros(16, np.int32), jnp.zeros((2, 2), jnp.float32)),
+            "c": "not an array"}
+    assert tree_nbytes(tree) == 4 * 8 * 4 + 16 * 4 + 2 * 2 * 4
+
+
+def test_memory_ledger_budget_model():
+    led = MemoryLedger()
+    led.record_operand("tiger", "params", 1000)
+    led.record_operand("tiger", "kv_page_pool", 4000)
+    led.record_executable("tiger", "decode/S8",
+                          stats={"temp": 300, "output": 200, "argument": 5000,
+                                 "alias": 0, "code": 50})
+    led.record_executable("tiger", "prefill/B2/L8",
+                          stats={"temp": 100, "output": 100, "argument": 5000,
+                                 "alias": 0, "code": 40})
+    led.record_executable("tiger", "broken", stats=None)  # still counted
+    h = led.group_summary("tiger")
+    assert h["operand_bytes"] == 5000
+    assert h["n_executables"] == 3 and h["n_executables_analyzed"] == 2
+    # transient peak = worst single executable's temp+output
+    assert h["transient_peak_bytes"] == 500
+    assert h["transient_peak_executable"] == "decode/S8"
+    assert h["total_bytes"] == 5500  # operands + transient peak
+
+    s = led.summary(budget_bytes=10_000)
+    assert s["total_bytes"] == 5500 and not s["over_budget"]
+    assert s["headroom_pct"] == pytest.approx(45.0)
+    s = led.summary(budget_bytes=5000)
+    assert s["over_budget"]
+
+    # Engine total across groups: ALL operands resident together, but
+    # only the single largest transient (one executable runs at a time)
+    # — summing per-group peaks would refuse configs that fit.
+    led.record_operand("cobra", "params", 2000)
+    led.record_executable("cobra", "decode/S4",
+                          stats={"temp": 100, "output": 50, "argument": 0,
+                                 "alias": 0, "code": 0})
+    s = led.summary()
+    assert s["heads"]["cobra"]["total_bytes"] == 2150
+    assert s["total_bytes"] == (5000 + 2000) + max(500, 150)
+    led.reset_group("cobra")
+    text = led.breakdown_text(budget_bytes=5000)
+    # actionable: every component named with its bytes
+    assert "kv_page_pool" in text and "decode/S8" in text
+    assert "budget" in text
+
+    led.reset_group("tiger")
+    assert led.summary()["total_bytes"] == 0
+
+
+def test_device_memory_stats_graceful_without_allocator_stats():
+    """CPU exposes no allocator counters: the helper returns {} and the
+    packed loop's peak-bytes fold stays a no-op instead of crashing."""
+    stats = device_memory_stats()
+    assert isinstance(stats, dict)
+    for v in stats.values():
+        assert isinstance(v, int)
+
+
+def _tiny_tiger_engine(**kwargs):
+    """Paged TIGER engine with a deliberately SMALL compile surface
+    (one-bucket ladder, max_slots == max_batch): 2 prefill + 1 decode
+    executables, so the ledger tests stay inside the tier-1 budget."""
+    from genrec_tpu.models.tiger import Tiger
+    from genrec_tpu.serving import (
+        BucketLadder, PagedConfig, ServingEngine, TigerGenerativeHead,
+    )
+
+    rng = np.random.default_rng(7)
+    valid = np.unique(rng.integers(0, 8, (20, 3)), axis=0)
+    tiger = Tiger(embedding_dim=16, attn_dim=32, dropout=0.0, num_heads=4,
+                  n_layers=2, num_item_embeddings=8, num_user_embeddings=20,
+                  sem_id_dim=3, max_pos=64)
+    params = tiger.init(
+        jax.random.key(0), jnp.zeros((2,), jnp.int32),
+        jnp.zeros((2, 6), jnp.int32), jnp.zeros((2, 6), jnp.int32),
+        jnp.zeros((2, 3), jnp.int32), jnp.zeros((2, 3), jnp.int32),
+        jnp.ones((2, 6), jnp.int32),
+    )["params"]
+    head = TigerGenerativeHead(tiger, valid, top_k=4, name="tiger")
+    eng = ServingEngine(
+        [head], params, ladder=BucketLadder((1, 2), (8,)), max_batch=2,
+        max_wait_ms=1.0, handle_signals=False,
+        paged_config=PagedConfig(max_slots=2, page_size=8, pages_per_slot=4),
+        **kwargs,
+    )
+    return eng, valid
+
+
+def test_engine_ledger_accounts_refuses_over_budget_and_exports(rng, tmp_path):
+    """ISSUE-10 acceptance + the Prometheus satellite, on ONE warmed
+    engine: a synthetic over-budget config is refused at warmup with an
+    actionable per-component breakdown; within budget, every warmed
+    executable + runtime operand is accounted with consistent sums; and
+    the pool/catalog/ledger gauges survive engine snapshot ->
+    write_prometheus -> parse-back."""
+    from genrec_tpu.obs import write_prometheus
+    from genrec_tpu.serving import HBMBudgetError, Request
+
+    # Over-budget: REFUSED at warmup (predict the OOM, don't serve into
+    # it), with every component named in the breakdown.
+    eng, _ = _tiny_tiger_engine(hbm_budget_bytes=10_000)
+    with pytest.raises(HBMBudgetError) as exc:
+        eng.start()
+    msg = str(exc.value)
+    for component in ("params", "kv_page_pool", "paged_slot_state",
+                      "catalog_operands", "budget"):
+        assert component in msg, (component, msg)
+
+    # Within budget: accounted, consistent, exported.
+    eng, valid = _tiny_tiger_engine(hbm_budget_bytes=10**10)
+    eng.start()
+    try:
+        for _ in range(3):
+            eng.serve(Request(head="tiger",
+                              history=rng.integers(0, len(valid), 5)),
+                      timeout=120)
+        st = eng.stats()
+        h = st["hbm"]["heads"]["tiger"]
+        assert h["n_executables"] == st["warmup_compiles"]
+        assert set(h["operands"]) == {"params", "catalog_operands",
+                                      "kv_page_pool", "paged_slot_state"}
+        assert all(v > 0 for v in h["operands"].values())
+        assert h["total_bytes"] == h["operand_bytes"] + h["transient_peak_bytes"]
+        assert st["hbm"]["budget_bytes"] == 10**10
+        assert not st["hbm"]["over_budget"]
+        path = write_prometheus(str(tmp_path / "metrics.prom"), st)
+    finally:
+        eng.stop()
+    lines = open(path).read().splitlines()
+    # parse back: alternating "# TYPE name kind" / "name value" pairs
+    metrics, kinds = {}, {}
+    for i in range(0, len(lines), 2):
+        assert lines[i].startswith("# TYPE ")
+        _, _, name, kind = lines[i].split()
+        val_name, val = lines[i + 1].split()
+        assert val_name == name
+        metrics[name] = float(val)
+        kinds[name] = kind
+    # pool gauges
+    assert "genrec_kv_pool_tiger_pages_in_use" in metrics
+    assert kinds["genrec_kv_pool_tiger_pages_in_use"] == "gauge"
+    # catalog counters
+    assert metrics["genrec_catalog_swaps"] == 0
+    assert kinds["genrec_catalog_swaps"] == "counter"
+    # ledger gauges
+    assert metrics["genrec_hbm_heads_tiger_total_bytes"] > 0
+    assert metrics["genrec_hbm_heads_tiger_operands_kv_page_pool"] > 0
+    assert kinds["genrec_hbm_heads_tiger_total_bytes"] == "gauge"
+    assert metrics["genrec_hbm_total_bytes"] == \
+        metrics["genrec_hbm_heads_tiger_total_bytes"]
+    # request counters really counted
+    assert metrics["genrec_completed"] == 3
+    assert kinds["genrec_completed"] == "counter"
+
+
+# ---------------------------------------------------------------------------
+# SLO monitor (ISSUE 10 tentpole)
+# ---------------------------------------------------------------------------
+
+
+def test_slo_monitor_breach_hysteresis_and_recovery():
+    fr = FlightRecorder()
+    target = SLOTarget(p99_ms=50.0, max_queue_depth=4, window_s=10.0,
+                       breach_s=1.0, recover_s=2.0)
+    mon = SLOMonitor({"tiger": target}, flight=fr)
+    t = 100.0
+    # healthy: no shed
+    assert mon.observe("tiger", p99_ms=20.0, queue_depth=1, now=t) is False
+    # breach starts but has not been sustained for breach_s yet
+    assert mon.observe("tiger", p99_ms=80.0, queue_depth=1, now=t + 0.1) is False
+    # a blip back to OK resets the breach clock
+    assert mon.observe("tiger", p99_ms=20.0, queue_depth=0, now=t + 0.5) is False
+    assert mon.observe("tiger", p99_ms=80.0, queue_depth=1, now=t + 1.0) is False
+    # sustained past breach_s -> shed + flight event
+    assert mon.observe("tiger", p99_ms=80.0, queue_depth=1, now=t + 2.1) is True
+    assert mon.is_shedding("tiger")
+    assert "p99_ms" in mon.shed_reason("tiger")
+    assert [e["head"] for e in fr.events("slo_breach")] == ["tiger"]
+    # recovery needs recover_s of sustained OK (hysteresis): a brief OK
+    # window does NOT un-shed
+    assert mon.observe("tiger", p99_ms=10.0, queue_depth=0, now=t + 3.0) is True
+    assert mon.observe("tiger", p99_ms=10.0, queue_depth=0, now=t + 4.0) is True
+    # ...and a breach inside the recovery window resets it
+    assert mon.observe("tiger", p99_ms=90.0, queue_depth=0, now=t + 4.5) is True
+    assert mon.observe("tiger", p99_ms=10.0, queue_depth=0, now=t + 5.0) is True
+    assert mon.observe("tiger", p99_ms=10.0, queue_depth=0, now=t + 7.1) is False
+    assert not mon.is_shedding("tiger")
+    assert len(fr.events("slo_recovered")) == 1
+    snap = mon.snapshot()
+    assert snap["heads"]["tiger"]["breaches"] == 1
+    assert not snap["shedding"]
+    # None p99 (not enough samples) skips the dimension, not a breach
+    assert mon.observe("tiger", p99_ms=None, queue_depth=0, now=t + 8.0) is False
+
+
+def test_slo_monitor_deferral_rate_window():
+    mon = SLOMonitor({"h": SLOTarget(max_deferral_rate=0.25, window_s=5.0,
+                                     breach_s=0.0, recover_s=0.0)})
+    t = 10.0
+    mon.observe("h", oom_deferred_total=0, submitted_total=0, now=t)
+    # 10 submits, 1 deferral in-window: rate 0.1 -> fine
+    assert mon.observe("h", oom_deferred_total=1, submitted_total=10,
+                       now=t + 1) is False
+    # 10 more submits, 9 more deferrals: windowed rate ~0.5 -> shed
+    assert mon.observe("h", oom_deferred_total=10, submitted_total=20,
+                       now=t + 2) is True
+    assert mon.snapshot()["heads"]["h"]["deferral_rate"] > 0.25
+    # window slides past the burst; idle (no new submits) must recover,
+    # not pin the stale rate forever
+    assert mon.observe("h", oom_deferred_total=10, submitted_total=20,
+                       now=t + 20) is False
+
+
+def test_recent_p99_is_per_head_windowed():
+    """One slow co-hosted head must not read as a latency breach on a
+    healthy head: the sliding-window p99 attributes per head."""
+    from genrec_tpu.serving import ServingMetrics
+
+    m = ServingMetrics()
+    for _ in range(30):
+        m.record_response(0.0, 0.0, 0.001, head="fast")
+        m.record_response(0.0, 0.0, 0.5, head="slow")
+    assert m.recent_p99_ms(60.0, head="fast") < 10.0
+    assert m.recent_p99_ms(60.0, head="slow") > 400.0
+    assert m.recent_p99_ms(60.0) > 400.0  # engine-wide view still pools
+    assert m.recent_p99_ms(60.0, head="absent") is None  # below min_count
+
+
+def test_slo_target_validation():
+    with pytest.raises(ValueError):
+        SLOTarget()  # no objective declared
+    with pytest.raises(ValueError):
+        SLOTarget(p99_ms=10.0, window_s=0.0)
+    with pytest.raises(ValueError):
+        SLOMonitor({})
+
+
+def test_engine_sheds_under_synthetic_overload_and_recovers(rng):
+    """ISSUE-10 acceptance: sustained queue breach -> OverloadError for
+    new submissions while every ACCEPTED request completes; hysteresis
+    un-sheds after the queue drains; zero steady-state recompiles."""
+    from genrec_tpu.models.sasrec import SASRec
+    from genrec_tpu.serving import (
+        BucketLadder, OverloadError, Request, RetrievalHead, ServingEngine,
+        SLOTarget as ServingSLOTarget,
+    )
+
+    model = SASRec(num_items=30, max_seq_len=8, embed_dim=16, num_heads=2,
+                   num_blocks=1, ffn_dim=32, dropout=0.0)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((2, 8), jnp.int32))["params"]
+    eng = ServingEngine(
+        [RetrievalHead("sasrec", model, top_k=5)], params,
+        ladder=BucketLadder((1, 2), (8,)), max_batch=2, max_wait_ms=1.0,
+        handle_signals=False,
+        slo_targets=ServingSLOTarget(max_queue_depth=2, window_s=1.0,
+                                     breach_s=0.0, recover_s=0.05),
+        slo_poll_secs=0.005,
+    ).start()
+    try:
+        accepted, shed = [], False
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            try:
+                accepted.append(eng.submit(
+                    Request(head="sasrec", history=rng.integers(1, 31, 5))))
+            except OverloadError as e:
+                shed = True
+                assert "sasrec" in str(e) and "queue_depth" in str(e)
+                break
+        assert shed, "synthetic overload never shed"
+        # in-flight and queued work completes while shedding (the drain
+        # discipline, recoverable)
+        resps = [f.result(120) for f in accepted]
+        assert len(resps) == len(accepted)
+        # hysteresis un-sheds once the targets hold again
+        recovered = False
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            try:
+                eng.submit(Request(head="sasrec",
+                                   history=rng.integers(1, 31, 5))).result(60)
+                recovered = True
+                break
+            except OverloadError:
+                time.sleep(0.01)
+        assert recovered, "shed never recovered"
+        st = eng.stats()
+        assert st["overload_rejected"] >= 1
+        assert st["overload_by_head"].get("sasrec", 0) >= 1
+        assert st["recompilations"] == 0
+        assert st["slo"]["heads"]["sasrec"]["breaches"] >= 1
+        # overload rejections are NOT drain rejections
+        assert st["rejected"] == 0
+    finally:
+        eng.stop()
+
+
+# ---------------------------------------------------------------------------
 # tracker / logging satellites
 # ---------------------------------------------------------------------------
 
@@ -431,6 +742,90 @@ def test_trace_report_cli_summarizes(tmp_path, capsys):
     bad = tmp_path / "bad.json"
     bad.write_text("{}")
     assert trace_report.main([str(bad)]) == 1
+
+
+def test_trace_report_compare_two_traces(tmp_path, capsys):
+    """Satellite: --compare A.json B.json prints per-phase p50/p95/p99
+    deltas — a serving perf diff in one command."""
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+    import trace_report
+
+    a, b = SpanTracer(), SpanTracer()
+    for i in range(10):
+        a.record_span("decode_step", f"req-{i}", 0.0, 0.010)
+        b.record_span("decode_step", f"req-{i}", 0.0, 0.015)  # 50% slower
+        a.record_span("prefill", f"req-{i}", 0.0, 0.020)
+        b.record_span("prefill", f"req-{i}", 0.0, 0.010)      # 50% faster
+    a.record_span("only_a", "req-0", 0.0, 0.001)
+    pa = a.dump(str(tmp_path / "a.json"))
+    pb = b.dump(str(tmp_path / "b.json"))
+    assert trace_report.main(["--compare", pa, pb]) == 0
+    out = capsys.readouterr().out
+    assert "decode_step" in out and "+50.0" in out
+    assert "prefill" in out and "-50.0" in out
+    assert "only in A: only_a" in out
+    cmp = trace_report.compare_reports(
+        trace_report.summarize(trace_report.load_trace(pa)),
+        trace_report.summarize(trace_report.load_trace(pb)),
+    )
+    d = cmp["phases"]["decode_step"]
+    assert d["p50_ms_a"] == pytest.approx(10.0)
+    assert d["p50_ms_b"] == pytest.approx(15.0)
+    assert d["p50_ms_delta_pct"] == pytest.approx(50.0)
+    assert d["p99_ms_delta_pct"] == pytest.approx(50.0)
+    assert cmp["only_in_a"] == ["only_a"]
+    # one trace and --compare together is a usage error; neither too
+    with pytest.raises(SystemExit):
+        trace_report.main([pa, "--compare", pa, pb])
+    with pytest.raises(SystemExit):
+        trace_report.main([])
+
+
+def test_log_serving_stats_hbm_line_per_head():
+    """Satellite: one HBM line per head (ledger total vs budget,
+    headroom %) beside the pool gauges."""
+    import logging
+
+    class _Capture(logging.Handler):
+        def __init__(self):
+            super().__init__()
+            self.records = []
+
+        def emit(self, record):
+            self.records.append(record)
+
+    logger = setup_logger(None)
+    cap = _Capture()
+    logger.addHandler(cap)
+    stats = {
+        "qps": 1.0, "completed": 2, "total_ms": {"p50": 1.0},
+        "hbm": {
+            "heads": {
+                "tiger": {"operands": {"params": 2 * 2**20},
+                          "operand_bytes": 2 * 2**20,
+                          "transient_peak_bytes": 2**20,
+                          "n_executables": 5,
+                          "total_bytes": 3 * 2**20},
+            },
+            "total_bytes": 3 * 2**20,
+            "budget_bytes": 6 * 2**20,
+            "headroom_pct": 50.0,
+            "over_budget": False,
+        },
+    }
+    try:
+        log_serving_stats(logger, Tracker(), stats)
+    finally:
+        logger.removeHandler(cap)
+    messages = [r.getMessage() for r in cap.records]
+    hbm_lines = [m for m in messages if "hbm[tiger]" in m]
+    assert len(hbm_lines) == 1
+    line = hbm_lines[0]
+    assert "3.00 MB" in line         # ledger total
+    assert "budget 6.0 MB" in line   # vs budget
+    assert "headroom 50.0%" in line  # headroom %
+    assert "5 executables" in line
 
 
 # ---------------------------------------------------------------------------
