@@ -108,6 +108,23 @@ def log_serving_stats(logger, tracker, stats: Mapping[str, Any]) -> None:
             f"slots {g.get('slots_active', 0)}/{g.get('slots_total', 0)}, "
             f"kv_tokens={g.get('kv_tokens_resident', 0)}"
         )
+    # Cross-request prefix cache: one line per head — warm-hit rate, KV
+    # tokens served without prefill, index size, and retained-page HBM —
+    # so "is repeat traffic actually landing warm" reads off the same
+    # interval line as the pool gauges.
+    for head, g in (stats.get("prefix_cache") or {}).items():
+        lookups = g.get("lookups", 0)
+        hits = g.get("hits", 0)
+        rate = 100.0 * hits / lookups if lookups else 0.0
+        logger.info(
+            f"serving prefix-cache[{head}]: {hits}/{lookups} warm hits "
+            f"({rate:.1f}%), warm_tokens={g.get('warm_tokens', 0)}, "
+            f"entries={g.get('entries', 0)}, retained "
+            f"{g.get('retained_pages', 0)} pages "
+            f"({g.get('retained_bytes', 0) / 2**20:.2f} MB), "
+            f"evictions={g.get('evictions', 0)} "
+            f"invalidations={g.get('invalidations', 0)}"
+        )
     # Device-memory ledger (obs/memory.py): one HBM line per head —
     # ledger total vs the declared budget with headroom %, so "how close
     # to OOM is this replica" reads off the same interval line as the

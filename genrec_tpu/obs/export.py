@@ -28,6 +28,10 @@ _COUNTER_LEAVES = frozenset({
     "warmup_compiles", "recompilations", "params_swaps", "admits",
     "evictions", "oom_deferred_admits", "decode_steps", "count", "steps",
     "catalog_swaps", "catalog_compiles", "overload_rejected", "breaches",
+    # Prefix-cache lifetime totals (genrec_prefix_cache_<head>_*); the
+    # entries/retained_pages/retained_bytes leaves stay gauges.
+    "lookups", "hits", "partial_hits", "misses", "warm_tokens",
+    "insertions", "invalidations",
 })
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_]")

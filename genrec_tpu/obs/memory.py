@@ -128,7 +128,7 @@ class MemoryLedger:
 
     def _group(self, group: str) -> dict:
         return self._groups.setdefault(
-            group, {"operands": {}, "executables": {}}
+            group, {"operands": {}, "executables": {}, "reclaimable": {}}
         )
 
     def reset_group(self, group: str) -> None:
@@ -141,6 +141,16 @@ class MemoryLedger:
         """One resident runtime operand (params, pool, trie, slot state)."""
         with self._lock:
             self._group(group)["operands"][name] = int(n_bytes)
+
+    def record_reclaimable(self, group: str, name: str, n_bytes: int) -> None:
+        """Bytes held INSIDE an already-recorded operand that the owner
+        can release on demand (the serving prefix cache's retained KV
+        pages live inside the fixed page-pool tensor). Tracked as its own
+        breakdown component — budget math must see cached bytes as
+        reclaimable rather than leaked — but NOT added to the group
+        total: the containing operand already counts them."""
+        with self._lock:
+            self._group(group)["reclaimable"][name] = int(n_bytes)
 
     def record_executable(self, group: str, name: str, compiled: Any = None,
                           *, stats: Optional[Mapping] = None) -> None:
@@ -161,6 +171,7 @@ class MemoryLedger:
         with self._lock:
             g = self._groups.get(group, {"operands": {}, "executables": {}})
             operands = dict(g["operands"])
+            reclaimable = dict(g.get("reclaimable") or {})
             execs = {k: (dict(v) if v else None)
                      for k, v in g["executables"].items()}
         operand_bytes = sum(operands.values())
@@ -176,6 +187,8 @@ class MemoryLedger:
         return {
             "operands": operands,
             "operand_bytes": operand_bytes,
+            "reclaimable": reclaimable,
+            "reclaimable_bytes": sum(reclaimable.values()),
             "n_executables": len(execs),
             "n_executables_analyzed": analyzed,
             "transient_peak_bytes": peak_bytes,
@@ -211,7 +224,15 @@ class MemoryLedger:
             + max((h["transient_peak_bytes"] for h in heads.values()),
                   default=0)
         )
-        out: dict[str, Any] = {"heads": heads, "total_bytes": total}
+        out: dict[str, Any] = {
+            "heads": heads,
+            "total_bytes": total,
+            # Bytes the owners can release on demand (prefix-cache pages):
+            # under pressure the EFFECTIVE floor is total - reclaimable.
+            "reclaimable_bytes": sum(
+                h["reclaimable_bytes"] for h in heads.values()
+            ),
+        }
         if budget_bytes is not None:
             out["budget_bytes"] = int(budget_bytes)
             out["headroom_pct"] = round(
@@ -240,6 +261,16 @@ class MemoryLedger:
                 f"({h['transient_peak_executable'] or 'n/a'}; "
                 f"{h['n_executables']} executables)"
             )
+            if h.get("reclaimable_bytes"):
+                rec = ", ".join(
+                    f"{k}={v * mb:.2f}MB"
+                    for k, v in sorted(h["reclaimable"].items(),
+                                       key=lambda kv: -kv[1])
+                )
+                lines.append(
+                    f"    reclaimable (inside the above, releasable on "
+                    f"demand): {h['reclaimable_bytes'] * mb:.2f}MB ({rec})"
+                )
             execs = [
                 (name, st.get("temp", 0) + st.get("output", 0))
                 for name, st in self.executables(group).items() if st

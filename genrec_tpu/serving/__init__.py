@@ -12,6 +12,7 @@ from genrec_tpu.serving.kv_pool import (
     PageAllocator,
     PagedConfig,
     PoolExhausted,
+    PrefixIndex,
 )
 from genrec_tpu.serving.heads import (
     CobraGenerativeHead,
@@ -45,6 +46,7 @@ __all__ = [
     "PageAllocator",
     "PagedConfig",
     "PoolExhausted",
+    "PrefixIndex",
     "Request",
     "Response",
     "RetrievalHead",

@@ -76,6 +76,18 @@ config is REFUSED at warmup with a per-component breakdown
 (`HBMBudgetError`) instead of OOMing on hardware; the gauges ride every
 stats() snapshot into Prometheus/operator lines.
 
+Cross-request KV prefix cache (serving/kv_pool.PrefixIndex,
+docs/SERVING.md "Prefix cache"): every cold prefill retains its page
+run (COW ref) in a per-head radix index keyed by the token-aligned
+history; a repeat request whose FULL key matches shares those pages and
+restores the donor's post-prefill slot state — admission straight into
+decode, no prefill executable call, zero compile-surface change.
+Retained pages are an LRU pool reclaimed before any admission defers,
+appear as the ledger's reclaimable component, and the index empties on
+params swap, catalog swap, and drain (a cached prefix from an old
+version must never serve the new one). ``prefix_cache=False`` restores
+the always-cold PR-6 behavior.
+
 SLO guard (obs/slo.py): ``slo_targets=`` declares per-head p99 /
 queue-depth / OOM-deferral-rate objectives. The batcher polls the
 monitor off the hot path; a SUSTAINED breach sheds load — new
@@ -104,7 +116,12 @@ from genrec_tpu.obs.memory import MemoryLedger, tree_nbytes
 from genrec_tpu.obs.slo import SLOMonitor, SLOTarget
 from genrec_tpu.obs.spans import NULL_TRACER, SpanTracer
 from genrec_tpu.serving.buckets import BucketLadder, default_ladder
-from genrec_tpu.serving.kv_pool import KVPagePool, PagedConfig, PoolExhausted
+from genrec_tpu.serving.kv_pool import (
+    KVPagePool,
+    PagedConfig,
+    PoolExhausted,
+    PrefixIndex,
+)
 from genrec_tpu.serving.metrics import ServingMetrics
 from genrec_tpu.serving.types import (
     DrainingError,
@@ -193,6 +210,25 @@ class _PagedRunner:
         # Futures already counted as OOM-deferred: the gauge counts
         # REQUESTS deferred, not per-batcher-iteration retries.
         self._oom_counted: set[int] = set()
+        # Cross-request prefix cache (docs/SERVING.md "Prefix cache"):
+        # finished requests retain their prefilled page runs (COW ref)
+        # in a radix index keyed by the head's token-aligned history
+        # key; a repeat request with a FULL-key match shares those pages
+        # (admit_shared) and restores the donor's post-prefill state —
+        # no prefill executable call, zero compile-surface change.
+        # Retained pages are an LRU pool reclaimed before any admission
+        # defers, and the index empties on params/catalog swap + drain.
+        self.prefix: PrefixIndex | None = (
+            PrefixIndex(self.pool.allocator,
+                        max_entries=engine._prefix_cache_entries)
+            if engine._prefix_cache else None
+        )
+        # Device bytes one page pins across layers and K+V pools — the
+        # retained-bytes gauge + ledger reclaimable component.
+        self._page_nbytes = (
+            tree_nbytes((self.pool.k_pools, self.pool.v_pools))
+            // cfg.num_pages
+        )
 
     @property
     def idle(self) -> bool:
@@ -263,8 +299,12 @@ class _PagedRunner:
 
     def admit(self) -> bool:
         """Drain the head's queue into free slots, one bucketed prefill
-        micro-batch at a time. Requests that don't fit (no free slot or
-        no free pages) STAY QUEUED — they retry as evictions free pages —
+        micro-batch at a time. Each popped request is first looked up in
+        the prefix index: a warm FULL-history hit shares the retained
+        pages (admit_shared) and skips prefill entirely; the rest go
+        through the bucketed prefill as before. Requests that don't fit
+        (no free slot or no free pages even after reclaiming retained
+        prefix pages) STAY QUEUED — they retry as evictions free pages —
         and the deferral is counted (metrics.oom_deferred_admits)."""
         eng = self.engine
         progressed = False
@@ -288,18 +328,38 @@ class _PagedRunner:
                 ):
                     return progressed
                 entries = [q.popleft() for _ in range(min(len(q), budget))]
+            warm, cold, holdback = self._split_warm(entries)
+            if holdback:
+                # Duplicate-key holdback (in-flight prefix matching): an
+                # identical request co-popped with its donor would miss
+                # and prefill redundantly; requeued at the front, it
+                # returns NEXT iteration — after the donor's prefill has
+                # retained the run — and admits warm. Strictly less work
+                # than prefilling, one batcher iteration of extra wait.
+                with eng._lock:
+                    eng._queues[self.head.name].extendleft(
+                        reversed(holdback)
+                    )
+            for e, centry, own_L in warm:
+                # Slot availability is guaranteed (popped <= budget <=
+                # free slots) and a warm admit allocates NO pages.
+                self._warm_admit(e, centry, own_L, t_pop=now)
+                progressed = True
+            if warm:
+                self._publish_prefix_gauges()
+                self._sweep_finished()  # init step == total finishes here
             slots, admitted = [], []
             L = eng._ladder.history_bucket(
-                max(max(self.head.natural_len(e[0]) for e in entries), 1)
+                max(max((self.head.natural_len(e[0]) for e, _k, _n in cold),
+                        default=1), 1)
             )
-            for e in entries:
+            for e, key, n_tok in cold:
                 try:
-                    n_tok = self.head.paged_kv_tokens(self.head.natural_len(e[0]), L)
-                    slots.append(self.pool.admit(n_tok))
-                    admitted.append(e)
+                    slots.append(self._admit_pages(n_tok))
+                    admitted.append((e, key))
                 except PoolExhausted:
                     break
-            leftover = entries[len(admitted):]
+            leftover = [e for e, _k, _n in cold[len(admitted):]]
             if leftover:  # out of pages: requeue at the FRONT (FIFO order)
                 with eng._lock:
                     eng._queues[self.head.name].extendleft(reversed(leftover))
@@ -313,14 +373,21 @@ class _PagedRunner:
                         n=len(fresh), pages_free=self.pool.stats().get("pages_free"),
                     )
             if admitted:
-                self._oom_counted.difference_update(id(e[1]) for e in admitted)
+                self._oom_counted.difference_update(
+                    id(e[1]) for e, _k in admitted
+                )
                 try:
-                    self._run_prefill(admitted, slots, L, t_pop=now)
+                    self._run_prefill(
+                        [e for e, _k in admitted], slots, L, t_pop=now,
+                        keys=[k for _e, k in admitted],
+                    )
                 except Exception as e:  # noqa: BLE001 — fail THESE futures only
                     eng._log.exception(
                         f"serving: paged prefill on head {self.head.name} failed"
                     )
-                    for slot, (_req, fut, _t, _tr) in zip(slots, admitted):
+                    for slot, (_req, fut, _t, _tr) in zip(
+                        slots, (e for e, _k in admitted)
+                    ):
                         self.pool.evict(slot)
                         # Undo any slot bookkeeping a partial prefill set,
                         # or step() would decode an entry-less slot.
@@ -334,8 +401,172 @@ class _PagedRunner:
             if leftover:
                 return progressed
 
+    # -- cross-request prefix cache ------------------------------------------
+
+    def _split_warm(self, entries):
+        """Partition popped queue entries into warm full-history hits
+        and cold admissions. Warm/cold membership is decided per request
+        against the request's OWN history bucket (what a cold engine
+        serving it solo would compile against), so a hit reproduces the
+        solo cold answer bit-for-bit."""
+        eng = self.engine
+        head = self.head
+        warm, cold, holdback = [], [], []
+        group_cold_keys: set = set()
+        max_hist = eng._ladder.history_buckets[-1]
+        for e in entries:
+            req = e[0]
+            own_L = eng._ladder.history_bucket(max(head.natural_len(req), 1))
+            n_tok = head.paged_kv_tokens(head.natural_len(req), own_L)
+            key = (
+                head.prefix_key_tokens(req, max_hist)
+                if self.prefix is not None else None
+            )
+            if key is None:
+                cold.append((e, None, n_tok))
+                continue
+            if key in group_cold_keys:
+                # An identical request is already going COLD in this
+                # group: hold this one back one iteration so it lands
+                # warm on the donor's freshly retained run (no lookup
+                # counted — it will be looked up for real next pass).
+                holdback.append(e)
+                continue
+            t0 = time.monotonic()
+            centry, matched = self.prefix.lookup(key)
+            if centry is not None and centry.n_tokens != n_tok:
+                # Same key but a different KV footprint (dead ids dropped
+                # from the key while natural_len still counts them): the
+                # retained run is not this request's prefill. Cold.
+                centry = None
+            outcome = (
+                "hit" if centry is not None
+                else ("partial" if matched else "miss")
+            )
+            # An OOM-deferred request is re-popped (and re-looked-up)
+            # every batcher retry: record its lookup outcome ONCE, or a
+            # pressure episode would spam misses into the warm-hit rate
+            # the bench gate pins (hits from a retry stay silent too —
+            # its one recorded outcome was the miss that deferred it).
+            if id(e[1]) not in self._oom_counted:
+                eng.metrics.record_prefix_lookup(
+                    head.name, outcome,
+                    tokens=centry.n_tokens if centry is not None else 0,
+                )
+                tr = e[3]
+                if tr is not None:
+                    eng._tracer.record_span(
+                        "prefix_lookup", tr[0], t0, time.monotonic(),
+                        parent_id=tr[1], outcome=outcome,
+                        matched_tokens=int(matched),
+                    )
+            if centry is not None:
+                warm.append((e, centry, own_L))
+            else:
+                group_cold_keys.add(key)
+                cold.append((e, key, n_tok))
+        return warm, cold, holdback
+
+    def _warm_admit(self, e, centry, own_L: int, t_pop: float) -> None:
+        """Admit one request onto a retained page run: COW-share the
+        pages, restore the donor's post-prefill state rows, enter decode
+        at the head's init step. The prefill executable never runs —
+        that is the whole win."""
+        eng = self.engine
+        head = self.head
+        # A previously deferred request can admit WARM once a donor's
+        # run lands: clear its deferral marker or the stale id would
+        # leak (and could suppress a later request's deferral count
+        # after CPython reuses the id).
+        self._oom_counted.discard(id(e[1]))
+        t0 = time.monotonic()
+        slot = self.pool.admit_shared(centry.pages, centry.n_tokens)
+        self.prefix.touch(centry.key)
+        centry.hits += 1
+        for key in self.state:
+            self.state[key][slot] = 0
+        if centry.init is not None:
+            init = head.paged_warm_state(centry.init, centry.n_tokens, own_L)
+            for key, val in init.items():
+                self.state[key][slot] = val
+        t_admit = time.monotonic()
+        self.steps[slot] = head.paged_init_step
+        self.active[slot] = True
+        self.entries[slot] = (*e, t_admit)
+        self.buckets[slot] = centry.bucket
+        tr = e[3]
+        if tr is not None:
+            # Same span tree as the cold path, with `warm_admit` where
+            # `prefill` would be — trace_report shows warm-vs-cold
+            # prefill phases side by side.
+            tid, root = tr
+            tracer = eng._tracer
+            tracer.record_span("queue_wait", tid, e[2], t_pop, parent_id=root)
+            tracer.record_span("admission", tid, t_pop, t0,
+                               parent_id=root, slot=int(slot))
+            tracer.record_span("warm_admit", tid, t0, t_admit,
+                               parent_id=root,
+                               warm_tokens=int(centry.n_tokens))
+        eng.metrics.record_admit(1)
+
+    def _admit_pages(self, n_tok: int) -> int:
+        """pool.admit with the reclaim ladder: when the allocator cannot
+        satisfy the demand, retained prefix pages are evicted LRU-first
+        and the admit retried — an admission is DEFERRED only when even
+        an empty cache could not fit it (pages pinned by live slots)."""
+        try:
+            return self.pool.admit(n_tok)
+        except PoolExhausted:
+            if self.prefix is None or not len(self.prefix):
+                raise
+            evicted = self.prefix.reclaim(self.cfg.pages_for(n_tok))
+            if evicted:
+                self.engine.metrics.record_prefix_evict(
+                    self.head.name, evicted
+                )
+                self._publish_prefix_gauges()
+            return self.pool.admit(n_tok)  # may still raise: defer
+
+    def prefix_stats(self) -> dict:
+        if self.prefix is None:
+            return {}
+        s = self.prefix.stats()
+        s["retained_bytes"] = s["retained_pages"] * self._page_nbytes
+        return s
+
+    def _publish_prefix_gauges(self) -> None:
+        if self.prefix is None:
+            return
+        s = self.prefix_stats()
+        self.engine.metrics.set_prefix_gauges(self.head.name, s)
+        # The retained pages live INSIDE the kv_page_pool operand the
+        # ledger already counts — recorded as the reclaimable component,
+        # so budget math sees cached bytes as releasable, not leaked.
+        self.engine.memory.record_reclaimable(
+            self.head.name, "prefix_cache_pages", s["retained_bytes"]
+        )
+
+    def clear_prefix_cache(self, reason: str) -> int:
+        """Invalidate every retained entry (params/catalog hot swap,
+        drain): a cached prefix from old params or an old catalog must
+        never serve the new version."""
+        if self.prefix is None:
+            return 0
+        n = self.prefix.clear()
+        if n:
+            eng = self.engine
+            eng.metrics.record_prefix_evict(self.head.name, n,
+                                            invalidation=True)
+            eng._flight.record(
+                "prefix_cache_invalidated", head=self.head.name,
+                reason=reason, entries=n,
+            )
+            eng.metrics.set_pool_gauges(self.head.name, self.pool.stats())
+        self._publish_prefix_gauges()
+        return n
+
     def _run_prefill(self, entries, slots, L: int,
-                     t_pop: float | None = None) -> None:
+                     t_pop: float | None = None, keys=None) -> None:
         eng = self.engine
         head = self.head
         t_admit = time.monotonic()
@@ -358,6 +589,27 @@ class _PagedRunner:
         for key, val in init.items():
             self.state[key][slots] = np.asarray(val)[:n]
         t_prefilled = time.monotonic()
+        if self.prefix is not None and keys is not None:
+            # Retain every freshly prefilled run under its history key:
+            # the entry addrefs the slot's pages (COW) and snapshots the
+            # post-prefill state rows (only the keys prefill initialized
+            # — the rest are zeroed again at warm admit), so the run
+            # outlives its donor slot and a repeat request skips
+            # prefill. Replacing a same-key entry drops the old refs.
+            for key, slot in zip(keys, slots):
+                if key is None:
+                    continue
+                snapshot = (
+                    {k: np.array(self.state[k][slot]) for k in init}
+                    if init else None
+                )
+                self.prefix.insert(
+                    key, n_tokens=int(self.pool.seq_lens[slot]),
+                    pages=self.pool.slot_pages(slot),
+                    init=snapshot, bucket=(B, L),
+                )
+                eng.metrics.record_prefix_insert(head.name)
+            self._publish_prefix_gauges()
         self.steps[slots] = head.paged_init_step
         self.active[slots] = True
         for e, slot in zip(entries, slots):
@@ -492,6 +744,7 @@ class _PagedRunner:
             self.buckets[slot] = None
             eng.metrics.record_evict(1)
         eng.metrics.set_pool_gauges(head.name, self.pool.stats())
+        self._publish_prefix_gauges()
 
 
 class ServingEngine:
@@ -514,6 +767,8 @@ class ServingEngine:
         logger: Optional[logging.Logger] = None,
         paged: bool = True,
         paged_config: Optional[PagedConfig] = None,
+        prefix_cache: bool = True,
+        prefix_cache_entries: int = 4096,
         tracer: Optional[SpanTracer] = None,
         hbm_budget_bytes: Optional[int] = None,
         slo_targets=None,
@@ -548,6 +803,13 @@ class ServingEngine:
         # baseline bench.py measures against).
         self._paged = paged
         self._paged_config = paged_config
+        # Cross-request KV prefix cache over the COW page pool (paged
+        # heads only): finished requests retain their prefilled pages in
+        # a radix index; a repeat request with the same token-aligned
+        # history admits straight into decode. prefix_cache=False is the
+        # cold baseline bench.py measures against.
+        self._prefix_cache = bool(prefix_cache)
+        self._prefix_cache_entries = int(prefix_cache_entries)
         self._runners: dict[str, _PagedRunner] = {}
         self._ckpt_dir = ckpt_dir
         self._ckpt_poll_secs = ckpt_poll_secs
@@ -729,6 +991,13 @@ class ServingEngine:
             led.record_operand(
                 head.name, "kv_page_pool",
                 tree_nbytes((runner.pool.k_pools, runner.pool.v_pools)),
+            )
+            # Retained prefix pages: a distinct, reclaimable component
+            # INSIDE the pool bytes above (released under pool pressure
+            # before any admission defers — never leaked growth).
+            led.record_reclaimable(
+                head.name, "prefix_cache_pages",
+                runner.prefix_stats().get("retained_bytes", 0),
             )
             # Slot state is host-resident numpy between steps but lives
             # on device during every decode call (and the decode
@@ -951,17 +1220,24 @@ class ServingEngine:
                     with self._lock:
                         empty = all(not q for q in self._queues.values())
                         runners_idle = all(r.idle for r in self._runners.values())
-                        if self._draining and empty and runners_idle:
-                            break
-                        # Wake on submit/stop notify; when requests are
-                        # queued, cap the wait so deadline flushes stay
-                        # responsive — when idle, back off (guard/drain
-                        # polls tolerate 50ms; a 1 kHz idle spin does not).
-                        self._work.wait(
-                            timeout=max(self._max_wait_s / 4, 1e-3)
-                            if not (empty and runners_idle)
-                            else 0.05
-                        )
+                        done = self._draining and empty and runners_idle
+                        if not done:
+                            # Wake on submit/stop notify; when requests are
+                            # queued, cap the wait so deadline flushes stay
+                            # responsive — when idle, back off (guard/drain
+                            # polls tolerate 50ms; a 1 kHz idle spin does not).
+                            self._work.wait(
+                                timeout=max(self._max_wait_s / 4, 1e-3)
+                                if not (empty and runners_idle)
+                                else 0.05
+                            )
+                    if done:
+                        # Drained: release every retained prefix page so
+                        # the pool accounts clean at shutdown ("all pages
+                        # released after drain", check_serving_hlo).
+                        for runner in self._runners.values():
+                            runner.clear_prefix_cache("drain")
+                        break
                 except Exception:  # noqa: BLE001 — the batcher must survive
                     # Anything escaping _run_batch's own guard (params
                     # refresh, metrics, future bookkeeping) would otherwise
@@ -1187,6 +1463,11 @@ class ServingEngine:
         self._flight.record("hot_reload_swapped", step=step)
         for head in self._heads.values():
             head.on_params(self._select(head, restored))
+        # A retained prefix was prefilled by the OLD params: serving it
+        # under the new step would silently mix versions. Empty every
+        # head's index (pinned by tests/test_prefix_cache.py).
+        for runner in self._runners.values():
+            runner.clear_prefix_cache("params_swap")
         self._log.info(f"serving: now serving checkpoint step {step}")
         return False
 
@@ -1313,6 +1594,13 @@ class ServingEngine:
             pending, self._pending_catalog = self._pending_catalog, {}
         for name, (snapshot, dense_exec, runner_exec) in pending.items():
             head = self._heads[name]
+            runner_pre = self._runners.get(name)
+            if runner_pre is not None:
+                # Invalidate BEFORE the head swaps: retained runs (and
+                # their state snapshots — COBRA's codebook-0 beam was
+                # trie-masked, its dense vecs tower-encoded) belong to
+                # the outgoing catalog version.
+                runner_pre.clear_prefix_cache("catalog_swap")
             head.set_catalog(snapshot)
             if dense_exec is not None:
                 self._exec.update(dense_exec)

@@ -128,6 +128,30 @@ class Head:
     def natural_len(self, req) -> int:
         return len(req.history)
 
+    # ---- cross-request prefix cache (paged heads; engine._PagedRunner) ----
+
+    def prefix_key_tokens(self, req, max_history: int):
+        """Token-aligned key of the request's EFFECTIVE history — exactly
+        what this head's prefill would encode (bucket-clipped to the
+        newest ``max_history`` items, dead ids dropped the same way
+        make_batch drops them, plus any per-request conditioning like
+        TIGER's user token). Two requests with equal keys are guaranteed
+        to prefill IDENTICAL page content, which is what makes a
+        full-key prefix-cache hit numerically exact. None = this head
+        does not participate in the prefix cache."""
+        del req, max_history
+        return None
+
+    def paged_warm_state(self, init, n_tokens: int, L_bucket: int):
+        """Slot-state rows a warm (prefix-cache) admission restores in
+        place of running the prefill executable. ``init`` is the donor's
+        post-prefill row snapshot (None when prefill leaves state
+        zeroed); heads override to patch the few fields that depend on
+        the admission-time bucket rather than the history (COBRA's
+        ``full`` flag)."""
+        del n_tokens, L_bucket
+        return init
+
     def dummy_request(self, length: int = 1):
         from genrec_tpu.serving.types import Request
 
@@ -352,6 +376,17 @@ class TigerGenerativeHead(Head):
         sem = np.asarray(row["beam_seqs"])
         return dict(items=self._lookup(sem), scores=np.asarray(row["beam_logps"]),
                     sem_ids=sem)
+
+    def prefix_key_tokens(self, req, max_history: int):
+        """TIGER's prefill is user-conditioned (the user token is encoder
+        position 0) and the encoder is BIDIRECTIONAL — the cross-attention
+        K/V of a history prefix changes when items are appended — so the
+        key carries the user id and only a FULL-key match is reusable
+        (the engine's one admissible tier anyway)."""
+        h = _clip_history(req.history, max_history)
+        h = h[h < len(self.item_sem_ids)]  # same drop rule as make_batch
+        return (int(req.user_id) % self.model.num_user_embeddings,
+                *(int(x) for x in h))
 
 
 class CobraGenerativeHead(Head):
@@ -604,6 +639,34 @@ class CobraGenerativeHead(Head):
         sem = np.asarray(row["beam_tokens"])
         return dict(items=self._lookup(sem), scores=np.asarray(row["beam_scores"]),
                     sem_ids=sem)
+
+    def prefix_key_tokens(self, req, max_history: int):
+        """COBRA keys on the effective item history alone (no user
+        conditioning in the decoder input). The decoder is causal, but
+        prefill ALSO resolves the codebook-0 beam from the last dense
+        position — a grown history needs that head re-run — so, like
+        TIGER, only a full-key match is admissible."""
+        h = _clip_history(req.history, max_history)
+        h = h[h < len(self.item_sem_ids)]  # same drop rule as make_batch
+        return tuple(int(x) for x in h)
+
+    def paged_warm_state(self, init, n_tokens: int, L_bucket: int):
+        """Everything cobra_prefill_paged returns is bucket-independent
+        for the valid positions (causal decoder + pad masking) EXCEPT
+        ``full`` — "did the row fill its prefill bucket" — which must be
+        judged against the ADMISSION-time bucket (what a cold engine
+        serving this request solo would use), not the donor's possibly
+        larger co-batched one. The length side comes from the donor's
+        ``base_pos`` (prefill's pad-masked n_valid), NOT from
+        ``n_tokens``: natural_len counts history ids that make_batch
+        DROPS (dead ids after a shrinking catalog swap), and prefill's
+        own full flag compared the effective length."""
+        del n_tokens
+        patched = dict(init)
+        patched["full"] = np.asarray(
+            int(init["base_pos"]) == L_bucket * (self.model.n_codebooks + 1)
+        )
+        return patched
 
 
 class RetrievalHead(Head):

@@ -9,7 +9,7 @@ arxiv 2604.15464): HBM is a fixed budget, occupancy tracks the tokens
 actually resident, and admission is denied (never over-allocated) when
 the pool is out of pages.
 
-Three layers, separable for testing:
+Four layers, separable for testing:
 
 - ``PageAllocator``: host-side free list with per-page REFCOUNTS. Plain
   admits hold one ref per page; ``addref`` lets two holders share pages
@@ -26,6 +26,12 @@ Three layers, separable for testing:
 - ``PagedConfig``: the handful of static shapes the decode side compiles
   against — (max_slots, pages_per_slot) replaces the whole decode-side
   bucket ladder.
+- ``PrefixIndex``: the cross-request prefix cache (vLLM/SGLang-style
+  radix index, docs/SERVING.md "Prefix cache") — a token radix trie
+  whose entries hold a COW ref on a finished request's page run, so a
+  returning user's next request shares those pages instead of re-paying
+  prefill. Entries are an LRU pool reclaimed FIRST under PoolExhausted
+  pressure (the engine reclaims before it ever defers an admission).
 
 Host-side bookkeeping is intentionally NOT thread-safe on its own: the
 engine's batcher thread is the only caller (same discipline as the
@@ -34,6 +40,7 @@ executable cache).
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import heapq
 
@@ -237,24 +244,49 @@ class KVPagePool:
         self.seq_lens[slot] = 0
         heapq.heappush(self._free_slots, slot)
 
+    def slot_pages(self, slot: int) -> list[int]:
+        """The page run a live slot is bound to (copy — callers must not
+        mutate pool bookkeeping)."""
+        pages = self._slot_pages[slot]
+        if pages is None:
+            raise ValueError(f"pages of inactive slot {slot}")
+        return list(pages)
+
     def share_into(self, src_slot: int, dst_slot_tokens: int) -> int:
         """Admit a NEW slot that shares the source slot's pages (COW ref,
         no copy) — the page-remapping hand-off primitive. The new slot
-        sees the first ``dst_slot_tokens`` of the shared history."""
+        sees the first ``dst_slot_tokens`` of the shared history, and
+        shares (and refs) ONLY the pages that view covers: sharing the
+        donor's whole run would pin its tail pages for the new slot's
+        entire lifetime even though the view never reads them."""
         pages = self._slot_pages[src_slot]
         if pages is None:
             raise ValueError(f"share from inactive slot {src_slot}")
-        if not self._free_slots:
-            raise PoolExhausted("no free decode slots")
         if dst_slot_tokens > len(pages) * self.cfg.page_size:
             raise ValueError("shared view exceeds the source slot's pages")
-        self.allocator.addref(pages)
+        return self._bind_shared(pages, dst_slot_tokens)
+
+    def admit_shared(self, pages, n_tokens: int) -> int:
+        """Admit a NEW slot onto an already-live page run (the prefix
+        cache's warm admit: the run is a PrefixIndex entry, not a slot).
+        Refs only the pages the ``n_tokens`` view covers, exactly like
+        share_into."""
+        pages = list(pages)
+        if n_tokens > len(pages) * self.cfg.page_size:
+            raise ValueError("shared view exceeds the retained page run")
+        return self._bind_shared(pages, n_tokens)
+
+    def _bind_shared(self, pages: list[int], n_tokens: int) -> int:
+        if not self._free_slots:
+            raise PoolExhausted("no free decode slots")
+        cover = pages[: self.cfg.pages_for(n_tokens)]
+        self.allocator.addref(cover)  # may raise; slot state untouched
         slot = heapq.heappop(self._free_slots)
-        self._slot_pages[slot] = list(pages)
+        self._slot_pages[slot] = list(cover)
         row = np.zeros(self.cfg.pages_per_slot, np.int32)
-        row[: len(pages)] = pages
+        row[: len(cover)] = cover
         self.block_tables[slot] = row
-        self.seq_lens[slot] = dst_slot_tokens
+        self.seq_lens[slot] = n_tokens
         return slot
 
     def check_invariants(self) -> None:
@@ -281,4 +313,192 @@ class KVPagePool:
             "slots_active": self.active_slot_count,
             "slots_total": self.cfg.max_slots,
             "kv_tokens_resident": int(self.seq_lens.sum()),
+        }
+
+
+# ---------------------------------------------------------------------------
+# Cross-request prefix cache (the warm-prefix store over the COW pool)
+# ---------------------------------------------------------------------------
+
+
+class PrefixEntry:
+    """One retained page run: the KV a finished request prefilled, kept
+    alive by a COW ref so the SAME token-aligned history can be admitted
+    again without paying prefill.
+
+    ``init`` is the donor's post-prefill slot-state rows (host numpy) —
+    what a warm admission restores instead of running the prefill
+    executable; None for heads whose prefill leaves the state zeroed
+    (TIGER). ``bucket`` records the donor's prefill (B, L) for the
+    response's provenance field."""
+
+    __slots__ = ("key", "n_tokens", "pages", "init", "bucket", "hits")
+
+    def __init__(self, key, n_tokens, pages, init=None, bucket=None):
+        self.key = tuple(key)
+        self.n_tokens = int(n_tokens)
+        self.pages = list(pages)
+        self.init = init
+        self.bucket = bucket
+        self.hits = 0
+
+
+class _RadixNode:
+    __slots__ = ("children", "entry")
+
+    def __init__(self):
+        self.children: dict = {}
+        self.entry: PrefixEntry | None = None
+
+
+class PrefixIndex:
+    """Radix (token-trie) index of retained page runs, LRU-ordered.
+
+    Keys are token-aligned history tuples (the head's
+    ``prefix_key_tokens``); the trie rolls the key one token per level —
+    the incremental-hash structure of the vLLM/SGLang radix caches — so
+    ``lookup`` reports both the exact entry (admissible: full-history
+    match, the only reuse tier that is numerically exact for BOTH
+    serving head families — see docs/SERVING.md "Prefix cache") and the
+    longest retained prefix depth (observability: how warm the traffic
+    WOULD be at page-granularity suffix reuse).
+
+    The index owns one allocator ref per retained page (taken at
+    ``insert``, dropped at eviction), so a retained run survives its
+    donor slot's eviction and is freed the moment the last holder lets
+    go — the same COW discipline beams use. Retained entries are a
+    reclaimable pool: ``reclaim`` drops LRU entries until the allocator
+    can satisfy a demand, which the engine runs BEFORE deferring any
+    admission. Single-threaded by contract (batcher thread), like the
+    pool it fronts."""
+
+    def __init__(self, allocator: PageAllocator, max_entries: int = 4096):
+        if max_entries <= 0:
+            raise ValueError(f"max_entries {max_entries} must be positive")
+        self._alloc = allocator
+        self._max_entries = int(max_entries)
+        self._root = _RadixNode()
+        # LRU: key -> entry, oldest first. Python's dict preserves
+        # insertion order; move-to-end on touch keeps it an LRU list.
+        self._lru: collections.OrderedDict[tuple, PrefixEntry] = (
+            collections.OrderedDict()
+        )
+        self._retained_pages = 0
+
+    def __len__(self) -> int:
+        return len(self._lru)
+
+    @property
+    def retained_pages(self) -> int:
+        """Page refs the index holds (entries never share pages with
+        each other: each run came from one donor prefill)."""
+        return self._retained_pages
+
+    def lookup(self, key) -> tuple[PrefixEntry | None, int]:
+        """(exact entry or None, matched token depth). Only a FULL-key
+        match returns an entry; a proper-prefix match reports its depth
+        so hit-rate telemetry can show near-miss warmth."""
+        key = tuple(key)
+        node, path = self._root, [self._root]
+        for tok in key:
+            node = node.children.get(tok)
+            if node is None:
+                break
+            path.append(node)
+        if len(path) - 1 == len(key) and path[-1].entry is not None:
+            return path[-1].entry, len(key)
+        # Deepest RETAINED prefix at or above where the walk ended.
+        for depth in range(len(path) - 1, 0, -1):
+            if path[depth].entry is not None:
+                return None, depth
+        return None, 0
+
+    def touch(self, key) -> None:
+        """Refresh an entry's LRU position (called on every warm hit)."""
+        self._lru.move_to_end(tuple(key))
+
+    def insert(self, key, n_tokens: int, pages, *, init=None,
+               bucket=None) -> PrefixEntry:
+        """Retain a page run under ``key`` (one allocator ref per page —
+        the pages must be live, i.e. still bound by the donor slot). An
+        existing entry for the key is REPLACED (its refs dropped): the
+        fresh run supersedes it. Over ``max_entries`` the LRU entry is
+        evicted first, so host-side index memory stays bounded."""
+        key = tuple(key)
+        existing = self._lru.get(key)
+        if existing is not None:
+            self.remove(key)
+        while len(self._lru) >= self._max_entries:
+            self._evict_lru()
+        entry = PrefixEntry(key, n_tokens, pages, init=init, bucket=bucket)
+        self._alloc.addref(entry.pages)
+        node = self._root
+        for tok in key:
+            node = node.children.setdefault(tok, _RadixNode())
+        node.entry = entry
+        self._lru[key] = entry
+        self._retained_pages += len(entry.pages)
+        return entry
+
+    def remove(self, key) -> PrefixEntry | None:
+        """Drop one entry (and its page refs); prunes emptied trie nodes."""
+        key = tuple(key)
+        entry = self._lru.pop(key, None)
+        if entry is None:
+            return None
+        self._release(entry)
+        path = [self._root]
+        for tok in key:
+            path.append(path[-1].children[tok])
+        path[-1].entry = None
+        for i in range(len(key), 0, -1):  # prune childless, entry-less tail
+            node, parent = path[i], path[i - 1]
+            if node.children or node.entry is not None:
+                break
+            del parent.children[key[i - 1]]
+        return entry
+
+    def _release(self, entry: PrefixEntry) -> None:
+        self._alloc.free(entry.pages)
+        self._retained_pages -= len(entry.pages)
+
+    def _evict_lru(self) -> PrefixEntry:
+        key = next(iter(self._lru))
+        return self.remove(key)
+
+    def reclaim(self, pages_needed: int) -> int:
+        """Evict entries (LRU-first) until the allocator has
+        ``pages_needed`` free pages or nothing evictable remains.
+        Returns entries evicted. Entries whose pages are ALL still bound
+        elsewhere (a live decode slot holds another ref) are SKIPPED,
+        not sacrificed: evicting them frees no pages now, so dropping
+        them would wipe warm state for zero relief — they stay retained
+        and become evictable once their donors finish."""
+        evicted = 0
+        while self._alloc.pages_free < pages_needed:
+            victim = next(
+                (key for key, e in self._lru.items()
+                 if any(self._alloc._refs[p] == 1 for p in e.pages)),
+                None,
+            )
+            if victim is None:
+                break
+            self.remove(victim)
+            evicted += 1
+        return evicted
+
+    def clear(self) -> int:
+        """Drop every entry (params/catalog swap invalidation, drain)."""
+        n = len(self._lru)
+        for entry in self._lru.values():
+            self._release(entry)
+        self._lru.clear()
+        self._root = _RadixNode()
+        return n
+
+    def stats(self) -> dict:
+        """Index gauges (the runner adds byte figures from pool geometry)."""
+        return {
+            "entries": len(self._lru),
+            "retained_pages": self._retained_pages,
         }

@@ -99,6 +99,22 @@ class ServingMetrics:
         self.submitted_by_head: collections.Counter = collections.Counter()
         self.oom_deferred_by_head: collections.Counter = collections.Counter()
         self.pool_gauges: dict[str, dict] = {}
+        # Cross-request prefix cache (serving/kv_pool.PrefixIndex via the
+        # paged runner): lookup outcomes, KV tokens served warm (the
+        # prefill FLOPs NOT paid), index churn, and per-head gauges
+        # (entries / retained pages / retained bytes). partial_hits are
+        # near-misses — a shorter retained prefix matched, admitted COLD
+        # (only full-history reuse is numerically exact for both head
+        # families; docs/SERVING.md "Prefix cache").
+        self.prefix_lookups: collections.Counter = collections.Counter()
+        self.prefix_hits: collections.Counter = collections.Counter()
+        self.prefix_partial_hits: collections.Counter = collections.Counter()
+        self.prefix_misses: collections.Counter = collections.Counter()
+        self.prefix_warm_tokens: collections.Counter = collections.Counter()
+        self.prefix_insertions: collections.Counter = collections.Counter()
+        self.prefix_evictions: collections.Counter = collections.Counter()
+        self.prefix_invalidations: collections.Counter = collections.Counter()
+        self.prefix_gauges: dict[str, dict] = {}
         # SLO load shedding (obs/slo.py via the engine): submissions
         # rejected with the typed OverloadError while a head sheds.
         # Separate from `rejected` — that one means draining (terminal);
@@ -175,6 +191,40 @@ class ServingMetrics:
     def record_decode_step(self) -> None:
         with self._lock:
             self.decode_steps += 1
+
+    def record_prefix_lookup(self, head: str, outcome: str,
+                             tokens: int = 0) -> None:
+        """One prefix-cache lookup: outcome in {"hit", "partial", "miss"}.
+        ``tokens`` is the KV tokens the matched run covers — for a hit,
+        the prefill work NOT paid (warm tokens)."""
+        with self._lock:
+            self.prefix_lookups[head] += 1
+            if outcome == "hit":
+                self.prefix_hits[head] += 1
+                self.prefix_warm_tokens[head] += int(tokens)
+            elif outcome == "partial":
+                self.prefix_partial_hits[head] += 1
+            else:
+                self.prefix_misses[head] += 1
+
+    def record_prefix_insert(self, head: str, n: int = 1) -> None:
+        with self._lock:
+            self.prefix_insertions[head] += n
+
+    def record_prefix_evict(self, head: str, n: int = 1,
+                            invalidation: bool = False) -> None:
+        """Entries dropped: LRU/pressure reclaims vs wholesale
+        invalidations (params/catalog swap, drain) — separate counters,
+        a swap storm must not read as memory pressure."""
+        with self._lock:
+            if invalidation:
+                self.prefix_invalidations[head] += n
+            else:
+                self.prefix_evictions[head] += n
+
+    def set_prefix_gauges(self, head: str, gauges: dict) -> None:
+        with self._lock:
+            self.prefix_gauges[head] = dict(gauges)
 
     def set_pool_gauges(self, head: str, gauges: dict) -> None:
         with self._lock:
@@ -285,6 +335,23 @@ class ServingMetrics:
             overload_by_head = dict(sorted(self.overload_by_head.items()))
             oom_deferred_by_head = dict(sorted(self.oom_deferred_by_head.items()))
             kv_pool = {h: dict(g) for h, g in sorted(self.pool_gauges.items())}
+            prefix_heads = sorted(
+                set(self.prefix_lookups) | set(self.prefix_gauges)
+            )
+            prefix_cache = {
+                h: {
+                    "lookups": self.prefix_lookups[h],
+                    "hits": self.prefix_hits[h],
+                    "partial_hits": self.prefix_partial_hits[h],
+                    "misses": self.prefix_misses[h],
+                    "warm_tokens": self.prefix_warm_tokens[h],
+                    "insertions": self.prefix_insertions[h],
+                    "evictions": self.prefix_evictions[h],
+                    "invalidations": self.prefix_invalidations[h],
+                    **self.prefix_gauges.get(h, {}),
+                }
+                for h in prefix_heads
+            }
         return {
             **counts,
             "qps": round(self.qps(), 3),
@@ -297,4 +364,5 @@ class ServingMetrics:
             "overload_by_head": overload_by_head,
             "oom_deferred_by_head": oom_deferred_by_head,
             "kv_pool": kv_pool,
+            "prefix_cache": prefix_cache,
         }

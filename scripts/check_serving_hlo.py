@@ -15,10 +15,14 @@ encoder + KV-cached constrained beam loop):
    (max_slots, pages_per_slot) plus the prefill bucket grid. Traffic is
    deliberately CHURNY: staggered bursts of mixed-length requests are
    submitted while earlier decodes are still in flight, so slots admit
-   and evict mid-decode. Asserts ZERO recompilations under that churn,
-   every answer a real corpus item, all pages/slots released at the end,
-   and that decode steps genuinely interleaved generations (fewer total
-   steps than sequential whole-batch decoding would need).
+   and evict mid-decode. A REPEAT-USER segment then replays previously
+   served histories with the prefix cache on: warm hits must be
+   observed, still with ZERO recompilations (the cache is pure page
+   sharing — no compile-surface change). Asserts zero recompilations
+   under all of it, every answer a real corpus item, all pages/slots
+   (including retained prefix pages) released after drain, and that
+   decode steps genuinely interleaved generations (fewer total steps
+   than sequential whole-batch decoding would need).
 
 Run:  python scripts/check_serving_hlo.py             (default shapes)
       python scripts/check_serving_hlo.py --small     (CI-speed shapes)
@@ -67,7 +71,9 @@ def _drive_churn(engine, head, valid_ids, n_requests, max_hist, n_users, rng):
     """Admit/evict churn: keep a rolling window of in-flight futures and
     top it up as results stream back, so new requests are admitted into
     slots WHILE other slots are mid-decode — the traffic shape
-    continuous batching exists for."""
+    continuous batching exists for. A REPEAT-USER tail then replays a
+    sample of the served (user, history) pairs, so the prefix cache
+    serves warm hits under the same churn."""
     import collections
 
     import numpy as np
@@ -76,15 +82,32 @@ def _drive_churn(engine, head, valid_ids, n_requests, max_hist, n_users, rng):
 
     submitted, items_ok = 0, True
     inflight = collections.deque()
+    served: list = []
     window = 2 * engine._max_batch + 1  # deliberately > max_batch
-    while submitted < n_requests or inflight:
-        while submitted < n_requests and len(inflight) < window:
-            n = int(rng.integers(1, max_hist + 1))
-            inflight.append(engine.submit(Request(
-                head=head.name,
-                history=rng.integers(0, len(valid_ids), n),
-                user_id=int(rng.integers(0, n_users)),
-            )))
+    n_repeat = max(engine._max_batch, 4)
+    total = n_requests + n_repeat
+    while submitted < total or inflight:
+        while submitted < total and len(inflight) < window:
+            if submitted < n_requests:
+                n = int(rng.integers(1, max_hist + 1))
+                req = Request(
+                    head=head.name,
+                    history=rng.integers(0, len(valid_ids), n),
+                    user_id=int(rng.integers(0, n_users)),
+                )
+                served.append(req)
+            else:
+                # Repeat-user tail: identical history + user, drawn from
+                # the RECENTLY served requests — the pool's full budget
+                # covers active slots only, so retention runs the index
+                # under gentle LRU pressure and only recent runs are
+                # guaranteed still retained (older replays would measure
+                # the eviction policy, not the warm path).
+                recent = min(len(served), engine._max_batch)
+                prev = served[-1 - int(rng.integers(recent))]
+                req = Request(head=head.name, history=prev.history,
+                              user_id=prev.user_id)
+            inflight.append(engine.submit(req))
             submitted += 1
         r = inflight.popleft().result(300)
         items_ok = items_ok and bool((np.asarray(r.items) >= 0).all())
@@ -168,10 +191,12 @@ def main(argv=None):
             stats["recompilations"] == 0
             and rec["buckets_hit"] >= 3
             and items_ok
-            and stats["completed"] == n_requests
+            and stats["completed"] == served
         )
         if paged:
             pool = stats["kv_pool"][head.name]
+            prefix = stats["prefix_cache"].get(head.name, {})
+            n_repeat = served - n_requests  # the repeat-user tail
             rec.update(
                 admits=stats["admits"],
                 evictions=stats["evictions"],
@@ -179,16 +204,27 @@ def main(argv=None):
                 oom_deferred_admits=stats["oom_deferred_admits"],
                 pages_in_use_final=pool["pages_in_use"],
                 slots_active_final=pool["slots_active"],
+                prefix_hits=prefix.get("hits", 0),
+                prefix_warm_tokens=prefix.get("warm_tokens", 0),
+                prefix_entries_final=prefix.get("entries", 0),
             )
             # Churn really happened (every request cycled a slot), the
-            # pool drained clean, and decode interleaved generations
-            # (strictly fewer steps than sequential decoding: D each).
+            # repeat-user tail landed WARM (every replay a prefix hit,
+            # still zero recompilations), the pool drained clean — all
+            # pages released, INCLUDING retained prefix pages (the drain
+            # invalidates the index) — and decode interleaved
+            # generations (strictly fewer steps than sequential
+            # decoding: D each).
             ok = ok and (
-                stats["admits"] == n_requests
-                and stats["evictions"] == n_requests
+                stats["admits"] == served
+                and stats["evictions"] == served
+                and n_repeat > 0
+                and prefix.get("hits", 0) >= n_repeat
+                and prefix.get("warm_tokens", 0) > 0
+                and prefix.get("entries", 0) == 0
                 and pool["pages_in_use"] == 0
                 and pool["slots_active"] == 0
-                and 0 < stats["decode_steps"] < n_requests * D
+                and 0 < stats["decode_steps"] < served * D
             )
         rec["ok"] = ok
         phases[phase] = rec
@@ -212,7 +248,8 @@ def main(argv=None):
                 f"OK: dense {d['steady_state_requests']} requests over "
                 f"{d['buckets_hit']} buckets, paged {p['steady_state_requests']} "
                 f"requests through {p['admits']} admit/evict churn cycles "
-                f"({p['decode_steps']} decode steps), 0 recompilations in both"
+                f"({p['decode_steps']} decode steps, {p['prefix_hits']} "
+                "repeat-user prefix-cache warm hits), 0 recompilations in both"
             )
         else:
             msg = "ATTENTION: serving engine recompiled in steady state"

@@ -111,13 +111,48 @@ def test_pool_share_into_is_copy_on_write():
     pool = KVPagePool(cfg, n_layers=1, n_heads=2, head_dim=4)
     src = pool.admit(16)
     dst = pool.share_into(src, 8)  # shared view of the first page's tokens
-    np.testing.assert_array_equal(pool.block_tables[src], pool.block_tables[dst])
+    # Only the COVERING page is shared and reffed: a prefix view must not
+    # pin the donor's tail pages for its whole lifetime (PR-11 fix).
+    src_pages = pool.block_tables[src].copy()
+    np.testing.assert_array_equal(pool.block_tables[dst], [src_pages[0], 0])
     pool.check_invariants()  # aliasing is ref-backed, not a leak
-    pool.evict(src)  # pages survive: dst still holds a ref
-    assert pool.allocator.pages_in_use == 2
+    pool.evict(src)  # shared page survives (dst ref); the TAIL frees now
+    assert pool.allocator.pages_in_use == 1
     pool.evict(dst)
     assert pool.allocator.pages_in_use == 0
     pool.check_invariants()
+    # A full-view share still pins (and shares) the whole run.
+    src = pool.admit(16)
+    dst = pool.share_into(src, 16)
+    np.testing.assert_array_equal(pool.block_tables[src], pool.block_tables[dst])
+    pool.evict(src)
+    assert pool.allocator.pages_in_use == 2  # dst holds both pages
+    pool.evict(dst)
+    assert pool.allocator.pages_in_use == 0
+    pool.check_invariants()
+
+
+def test_pool_admit_shared_binds_retained_run():
+    """admit_shared (the prefix cache's warm admit) binds a free slot to
+    an already-live page run with one extra ref per covering page —
+    exactly share_into without a source SLOT."""
+    cfg = PagedConfig(max_slots=4, page_size=8, pages_per_slot=2)
+    pool = KVPagePool(cfg, n_layers=1, n_heads=2, head_dim=4)
+    donor = pool.admit(13)  # 2 pages
+    run = pool.slot_pages(donor)
+    pool.allocator.addref(run)  # the index's retained ref
+    pool.evict(donor)  # donor gone; the run survives via the index ref
+    assert pool.allocator.pages_in_use == 2
+    warm = pool.admit_shared(run, 13)
+    assert pool.seq_lens[warm] == 13
+    np.testing.assert_array_equal(pool.block_tables[warm], run)
+    pool.check_invariants()
+    pool.evict(warm)
+    assert pool.allocator.pages_in_use == 2  # index ref still holds
+    pool.allocator.free(run)
+    assert pool.allocator.pages_in_use == 0
+    with pytest.raises(ValueError):
+        pool.admit_shared([1, 2], 17)  # view exceeds the run
 
 
 # ---- the churn property test ------------------------------------------------
@@ -139,8 +174,12 @@ def test_allocator_random_churn_never_leaks_or_aliases(rng):
                 live.append(pool.admit(int(rng.integers(0, cfg.max_kv_tokens + 1))))
                 admitted += 1
             elif op < 0.55 and live:
+                # Mix full-view and PARTIAL-PREFIX shares: the prefix
+                # view must ref only its covering pages (no leak of the
+                # donor's tail, no double-free on either eviction order).
                 src = live[int(rng.integers(len(live)))]
-                live.append(pool.share_into(src, int(pool.seq_lens[src])))
+                tokens = int(rng.integers(0, int(pool.seq_lens[src]) + 1))
+                live.append(pool.share_into(src, tokens))
                 shared += 1
             elif live:
                 slot = live.pop(int(rng.integers(len(live))))
@@ -157,6 +196,96 @@ def test_allocator_random_churn_never_leaks_or_aliases(rng):
     pool.check_invariants()
     assert pool.allocator.pages_in_use == 0
     assert pool.allocator.pages_free == cfg.num_pages - 1
+
+
+# ---- PrefixIndex: the cross-request prefix cache over the allocator ---------
+
+
+def test_prefix_index_insert_lookup_exact_and_partial():
+    from genrec_tpu.serving.kv_pool import PrefixIndex
+
+    a = PageAllocator(10)
+    idx = PrefixIndex(a)
+    run = a.alloc(2)
+    e = idx.insert((7, 8, 9), n_tokens=10, pages=run, bucket=(1, 4))
+    assert len(idx) == 1 and idx.retained_pages == 2
+    assert a._refs[run[0]] == 2  # donor slot + index, COW style
+    hit, depth = idx.lookup((7, 8, 9))
+    assert hit is e and depth == 3 and hit.n_tokens == 10
+    # A proper prefix of the retained key is NOT admissible (no entry at
+    # that node) and no shorter entry exists -> depth 0.
+    assert idx.lookup((7, 8)) == (None, 0)
+    # An EXTENSION of the retained key: near-miss at the retained depth
+    # (the "how warm would suffix reuse be" telemetry).
+    assert idx.lookup((7, 8, 9, 11)) == (None, 3)
+    assert idx.lookup((1, 2)) == (None, 0)
+    a.free(run)  # donor evicts; the entry keeps the run alive
+    assert a.pages_free == 10 - 1 - 2
+    idx.remove((7, 8, 9))  # last ref -> pages return to the free list
+    assert a.pages_free == 9 and idx.retained_pages == 0
+    a.check_invariants()
+
+
+def test_prefix_index_lru_reclaim_capacity_and_clear():
+    from genrec_tpu.serving.kv_pool import PrefixIndex
+
+    a = PageAllocator(8)  # 7 allocatable
+    idx = PrefixIndex(a, max_entries=3)
+    for i in range(3):
+        run = a.alloc(2)
+        idx.insert((i, i), n_tokens=16, pages=run)
+        a.free(run)  # the index holds the ONLY ref now
+    assert a.pages_free == 1 and idx.retained_pages == 6
+    idx.touch((0, 0))  # LRU order becomes (1,1), (2,2), (0,0)
+    assert idx.reclaim(3) == 1  # evicting (1,1) frees 2 -> 3 free, stop
+    assert a.pages_free == 3
+    assert idx.lookup((1, 1)) == (None, 0)
+    assert idx.lookup((0, 0))[0] is not None
+    # Capacity bound: the 4th entry evicts the LRU (2,2) first.
+    for key in ((3,), (4,)):
+        run = a.alloc(1)
+        idx.insert(key, n_tokens=8, pages=run)
+        a.free(run)
+    assert len(idx) == 3
+    assert idx.lookup((2, 2)) == (None, 0)
+    # Same-key re-insert REPLACES: the superseded run's refs drop.
+    free_before = a.pages_free
+    run = a.alloc(1)
+    idx.insert((3,), n_tokens=8, pages=run)
+    a.free(run)
+    assert len(idx) == 3 and a.pages_free == free_before
+    # clear() releases everything (swap invalidation / drain).
+    assert idx.clear() == 3
+    assert idx.retained_pages == 0 and a.pages_free == 7
+    a.check_invariants()
+
+
+def test_prefix_index_reclaim_skips_slot_pinned_entries():
+    """An entry whose pages are all still bound by a live slot frees
+    NOTHING when evicted — reclaim must skip it (it stays warm) instead
+    of wiping the index for zero relief, and still evict the entries
+    that DO free pages."""
+    from genrec_tpu.serving.kv_pool import PrefixIndex
+
+    a = PageAllocator(8)  # 7 allocatable
+    idx = PrefixIndex(a)
+    pinned = a.alloc(3)  # donor slot still holds these (refcount stays 2)
+    idx.insert((1,), n_tokens=24, pages=pinned)
+    free_able = a.alloc(3)
+    idx.insert((2,), n_tokens=24, pages=free_able)
+    a.free(free_able)  # donor evicted: index holds the only ref
+    assert a.pages_free == 1
+    # Demand 4: evicting (2,) frees 3 -> 4; (1,) is pinned and — even
+    # though it is the LRU entry — must survive untouched.
+    assert idx.reclaim(4) == 1
+    assert a.pages_free == 4
+    assert idx.lookup((1,))[0] is not None
+    assert idx.lookup((2,)) == (None, 0)
+    # Unmeetable demand: nothing evictable remains, the loop stops
+    # (no index wipe), state intact.
+    assert idx.reclaim(7) == 0
+    assert len(idx) == 1 and idx.retained_pages == 3
+    a.check_invariants()
 
 
 # ---- paged-attention kernel vs fallback parity ------------------------------

@@ -99,13 +99,21 @@ def test_log_serving_stats_smoke(tmp_path):
         "kv_pool": {"tiger": {"pages_in_use": 3, "pages_free": 5,
                               "slots_active": 2, "slots_total": 8,
                               "kv_tokens_resident": 40}},
+        "prefix_cache": {"tiger": {"lookups": 10, "hits": 6,
+                                   "partial_hits": 0, "misses": 4,
+                                   "warm_tokens": 96, "insertions": 4,
+                                   "evictions": 1, "invalidations": 0,
+                                   "entries": 3, "retained_pages": 5,
+                                   "retained_bytes": 10240}},
     }
     log_serving_stats(logger, tracker, stats)
     tracker.finish()
     text = (tmp_path / "metrics.jsonl").read_text()
     assert "serve/qps" in text and "serve/total_ms/p95" in text
-    # Pool gauges flatten into the tracker namespace too.
+    # Pool + prefix-cache gauges flatten into the tracker namespace too.
     assert "serve/kv_pool/tiger/pages_in_use" in text
+    assert "serve/prefix_cache/tiger/hits" in text
+    assert "serve/prefix_cache/tiger/retained_pages" in text
 
 
 # ---- tiny model zoo ---------------------------------------------------------
@@ -276,10 +284,15 @@ def test_paged_continuous_batching_churn_under_pool_pressure(zoo, corpus, rng):
     valid, _ = corpus
     head = TigerGenerativeHead(models["tiger"], valid, top_k=4, name="tiger")
     # 4 slots / 9 pages: at most 2 max-history requests resident at once.
+    # prefix_cache=False: this test pins the COLD pool-pressure deferral
+    # machinery and exact page accounting (the cache would reclaim
+    # retained pages before deferring and keep pages_in_use warm between
+    # requests — tests/test_prefix_cache.py covers that behavior).
     cfg = PagedConfig(max_slots=4, page_size=8, pages_per_slot=4, num_pages=9)
     eng = ServingEngine(
         [head], params["tiger"], ladder=BucketLadder((1, 2), (4, 8)),
         max_batch=2, max_wait_ms=1.0, handle_signals=False, paged_config=cfg,
+        prefix_cache=False,
     ).start()
     try:
         futs = [
