@@ -44,6 +44,7 @@ plus the ratio to the torch reference measured on this host's CPU
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
 import subprocess
@@ -663,6 +664,14 @@ def _serve_bench(model, params, valid_ids, rng, batch: int = SERVE_BATCH,
         out["prefix_cache"] = _prefix_cache_bench(model, params, valid_ids, rng)
     except Exception as e:
         print(f"bench: prefix cache benchmark failed: {e!r}", file=sys.stderr)
+    # Fleet front (genrec_tpu/fleet/): a 2-replica router under the
+    # deterministic diurnal+burst trace — p99-under-burst and shed-rate
+    # are the gated fleet metrics (bit-identical replay is what makes
+    # them gateable at all).
+    try:
+        out["fleet"] = _fleet_bench(model, params, valid_ids, rng)
+    except Exception as e:
+        print(f"bench: fleet benchmark failed: {e!r}", file=sys.stderr)
     return out
 
 
@@ -808,37 +817,15 @@ def _catalog_swap_bench(model, params, valid_ids, rng, batch: int = SERVE_BATCH,
 def zipfian_repeat_user_trace(n_requests: int, n_users: int, max_items: int,
                               corpus_size: int, rng, zipf_a: float = 1.5,
                               p_new_item: float = 0.25):
-    """Deterministic repeat-user request trace for the prefix-cache bench.
+    """Deterministic repeat-user request trace (the prefix-cache bench's
+    workload). MOVED to genrec_tpu/fleet/traffic.py — the fleet traffic
+    harness generalizes it with real arrival times, diurnal modulation
+    and bursts — and re-exported here as a delegating wrapper (imported
+    lazily: the bench parent stays jax-free for the harness tests)."""
+    from genrec_tpu.fleet.traffic import zipfian_repeat_user_trace as impl
 
-    User popularity is Zipfian over ranks (p ∝ 1/rank^zipf_a): a few
-    heavy users dominate arrivals — recommendation traffic's shape, and
-    the prefix cache's best case. Each arrival either REPEATS the user's
-    previous request verbatim (a refresh / next-page fetch: warm
-    full-history hit) or first appends one new interaction
-    (history grew: cold, re-retained). Histories cap at ``max_items`` by
-    sliding (oldest item drops), matching the serving bucket clip.
-
-    Returns a list of (user_id, history ndarray) pairs, fully
-    materialized up front so driver threads never touch the rng
-    (np.random.Generator is not thread-safe — the catalog_swap bench
-    discipline)."""
-    import numpy as np
-
-    ranks = np.arange(1, n_users + 1, dtype=np.float64)
-    p = ranks ** -zipf_a
-    p /= p.sum()
-    histories: dict = {}
-    trace = []
-    for _ in range(n_requests):
-        user = int(rng.choice(n_users, p=p))
-        h = histories.get(user)
-        if h is None:
-            h = list(rng.integers(0, corpus_size, int(rng.integers(3, max_items + 1))))
-        elif rng.random() < p_new_item:
-            h = (h + [int(rng.integers(0, corpus_size))])[-max_items:]
-        histories[user] = h
-        trace.append((user, np.asarray(h, np.int64)))
-    return trace
+    return impl(n_requests, n_users, max_items, corpus_size, rng,
+                zipf_a=zipf_a, p_new_item=p_new_item)
 
 
 def _prefix_cache_bench(model, params, valid_ids, rng,
@@ -1003,6 +990,98 @@ def _prefix_cache_bench(model, params, valid_ids, rng,
             "bucketed prefill executable; streams-at-fixed-HBM = peak "
             "resident decode streams under a page budget sized for "
             f"{cold_cap} unshared streams, hit with a same-history burst"
+        ),
+    )
+
+
+def _fleet_bench(model, params, valid_ids, rng, batch: int = 8) -> dict:
+    """Fleet front under the deterministic million-user traffic harness
+    (genrec_tpu/fleet/): a 2-replica `FleetRouter` of paged TIGER
+    engines with per-head SLO targets replays a seeded Zipfian trace —
+    diurnal rate modulation plus a hard burst — open-loop, exactly as a
+    production front would see it:
+
+    - **p99_under_burst_ms**: total latency p99 of the requests that
+      ARRIVED inside the burst window — the number the bucket ladder,
+      paged admission, and fleet routing jointly defend.
+    - **shed_rate**: typed `OverloadError` rejections per submitted
+      request over the whole trace (fleet-level: the router only sheds
+      when EVERY replica sheds). The burst is sized to overrun two
+      replicas' worth of CPU decode, so the SLO guard genuinely engages
+      and the rate is a measured, regression-gateable quantity.
+
+    The trace is bit-identically replayable (same seed ⇒ same arrival
+    schedule — pinned in tests/test_fleet.py), so run-to-run deltas in
+    these metrics are the SERVING stack, not the workload. CPU-measured
+    where the TPU tunnel is down; same honesty labeling as the other
+    serve sections.
+    """
+    import jax
+
+    from genrec_tpu.fleet import Burst, FleetRouter, TraceConfig, \
+        generate_trace, replay
+    from genrec_tpu.serving import (
+        BucketLadder, PagedConfig, ServingEngine, SLOTarget,
+    )
+    from genrec_tpu.serving.heads import TigerGenerativeHead
+
+    items = BENCH_ITEMS
+    n_tok = 1 + items * model.sem_id_dim
+    cfg = PagedConfig(max_slots=2 * batch, page_size=16,
+                      pages_per_slot=-(-n_tok // 16))
+    target = SLOTarget(p99_ms=2000.0, max_queue_depth=4 * batch,
+                       window_s=2.0, breach_s=0.25, recover_s=1.0)
+
+    def make_replica(rid):
+        head = TigerGenerativeHead(model, valid_ids, top_k=DECODE_BEAM_K,
+                                   name="tiger")
+        return ServingEngine(
+            [head], params, ladder=BucketLadder((1, batch), (items,)),
+            max_batch=batch, max_wait_ms=2.0, handle_signals=False,
+            paged_config=cfg, slo_targets=target, replica_id=rid,
+        )
+
+    router = FleetRouter(make_replica, initial_replicas=2).start()
+    trace_cfg = TraceConfig(
+        n_requests=280, n_users=1_000_000, max_items=items,
+        corpus_size=len(valid_ids), head="tiger", seed=12,
+        base_rate_qps=24.0, diurnal_period_s=8.0, diurnal_amplitude=0.4,
+        bursts=(Burst(3.0, 2.0, 6.0),),
+    )
+    trace = generate_trace(trace_cfg)
+    try:
+        report = replay(trace, router.submit, gather_timeout_s=600.0)
+    finally:
+        agg = router.stop()
+
+    return dict(
+        backend=jax.default_backend(),
+        replicas=2,
+        trace=dict(
+            n_requests=len(trace), n_users=trace_cfg.n_users,
+            seed=trace_cfg.seed, base_rate_qps=trace_cfg.base_rate_qps,
+            burst=dataclasses.asdict(trace_cfg.bursts[0]),
+            distinct_users=len({a.user_id for a in trace.arrivals}),
+        ),
+        submitted=report.submitted,
+        completed=report.completed,
+        lost=report.lost,
+        offered_qps=report.offered_qps and round(report.offered_qps, 2),
+        p50_ms=report.p50_ms,
+        p99_ms=report.p99_ms,
+        p99_under_burst_ms=report.p99_under_burst_ms,
+        burst_submitted=report.burst_submitted,
+        shed_rate=round(report.shed_rate, 4),
+        burst_shed_rate=round(report.burst_shed_rate, 4),
+        fleet_shed_rejected=agg["fleet_shed_rejected"],
+        rerouted=agg["rerouted"],
+        recompilations_steady=agg["recompilations"],
+        note=(
+            "2-replica FleetRouter of paged TIGER engines, seeded "
+            "Zipfian open-loop trace over a 1M-user id space with "
+            "diurnal modulation and a 6x/2s burst; p99_under_burst over "
+            "burst-window arrivals, shed_rate = fleet-level typed "
+            "OverloadError per submit"
         ),
     )
 

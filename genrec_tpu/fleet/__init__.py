@@ -1,0 +1,47 @@
+"""Fleet front: replica router, SLO-driven autoscaler, deterministic
+million-user traffic harness (docs/architecture.md L7, docs/SERVING.md
+"Fleet front").
+
+One `ServingEngine` can serve all four heads with paged KV, a prefix
+cache, hot swaps, an HBM ledger, and SLO-driven shedding — "millions of
+users" means N of them behind a front:
+
+- `router.FleetRouter` — the engine's `submit() -> Future` surface over
+  N in-process replicas, routed by live per-head headroom; a replica's
+  `OverloadError` means try-the-next, replica death means typed
+  at-most-once re-submit of stranded flights.
+- `autoscaler.Autoscaler` — sustained fleet-wide shed ⇒ scale-out
+  (warmup = the measured AOT ladder), sustained all-replica headroom ⇒
+  graceful drain scale-in, hysteresis mirroring obs/slo.py.
+- `traffic` — seeded Zipfian/diurnal/burst open-loop replay, bit-
+  identically reproducible so p99-under-burst and shed-rate gate in
+  bench_gate; chaos hooks (kill a replica mid-burst) ride the schedule.
+
+Layering: fleet imports serving and obs; nothing imports fleet.
+"""
+
+from genrec_tpu.fleet.autoscaler import Autoscaler, AutoscalerConfig
+from genrec_tpu.fleet.router import FleetRouter, ReplicaLostError
+from genrec_tpu.fleet.traffic import (
+    Burst,
+    ReplayReport,
+    Trace,
+    TraceConfig,
+    generate_trace,
+    replay,
+    zipfian_repeat_user_trace,
+)
+
+__all__ = [
+    "Autoscaler",
+    "AutoscalerConfig",
+    "Burst",
+    "FleetRouter",
+    "ReplayReport",
+    "ReplicaLostError",
+    "Trace",
+    "TraceConfig",
+    "generate_trace",
+    "replay",
+    "zipfian_repeat_user_trace",
+]

@@ -1,0 +1,234 @@
+"""SLO-driven autoscaler: sustained fleet-wide breach ⇒ scale-out,
+sustained all-replica headroom ⇒ scale-in, with hysteresis.
+
+The router turns one replica's shed into "try the next replica"; the
+autoscaler turns the FLEET's shed into capacity. Its inputs are the
+cheap scalars the fleet already publishes (`FleetRouter.scale_signal()`:
+per-replica min-headroom + shedding flag, derived from each replica's
+`SLOMonitor` margins), its outputs are the router's two lifecycle verbs:
+
+- **scale-out** — when EVERY live replica is shedding (the same
+  condition under which `submit()` surfaces the fleet-level
+  `OverloadError`) and that has held for ``scale_out_after_s``, add one
+  replica. Warmup is the existing AOT compile ladder, so the scale-out
+  cost is a measured number on the `replica_started` /`scale_out`
+  flight events — capacity lag is traced, not guessed.
+- **scale-in** — when every replica's headroom has stayed above
+  ``scale_in_headroom`` for ``scale_in_after_s`` and the fleet is above
+  ``min_replicas``, gracefully remove the FREEST replica
+  (`remove_replica`: the PR 5 drain — queued + in-flight complete
+  before teardown, nothing is dropped to save power).
+
+Hysteresis mirrors `obs/slo.py`: a condition must HOLD for its window
+(a blip resets the clock), and every action starts a ``cooldown_s``
+during which neither clock accumulates — a new replica needs its warmup
+plus a window of traffic before the fleet's state means anything.
+
+`tick(now=...)` is the whole state machine (fake-clock testable, like
+SLOMonitor); `start()` just runs it on a poll thread.
+
+Layering: fleet imports serving/obs only (docs/architecture.md L7).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from typing import Optional
+
+from genrec_tpu.obs.flight_recorder import get_flight_recorder
+
+
+@dataclasses.dataclass(frozen=True)
+class AutoscalerConfig:
+    """Bounds + hysteresis windows. Defaults suit the in-process bench
+    fleets; production fleets stretch the windows to real warmup cost."""
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    scale_out_after_s: float = 1.0    # sustained all-replica shed
+    scale_in_after_s: float = 10.0    # sustained all-replica headroom
+    scale_in_headroom: float = 0.5    # per-replica min-headroom floor
+    cooldown_s: float = 2.0           # after any action, clocks reset
+    poll_secs: float = 0.25
+
+    def __post_init__(self):
+        if not 1 <= self.min_replicas <= self.max_replicas:
+            raise ValueError(
+                f"need 1 <= min_replicas <= max_replicas, got {self}"
+            )
+        if min(self.scale_out_after_s, self.scale_in_after_s,
+               self.cooldown_s) < 0 or self.poll_secs <= 0:
+            raise ValueError(f"invalid autoscaler windows in {self}")
+
+
+class Autoscaler:
+    """Hysteresis state machine over `router.scale_signal()`."""
+
+    def __init__(self, router, config: Optional[AutoscalerConfig] = None,
+                 logger: Optional[logging.Logger] = None):
+        self.router = router
+        self.config = config or AutoscalerConfig()
+        self._log = logger or logging.getLogger("genrec_tpu")
+        self._flight = get_flight_recorder()
+        self._lock = threading.Lock()
+        self._breach_since: Optional[float] = None
+        self._idle_since: Optional[float] = None
+        self._cooldown_until = 0.0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.scale_outs = 0
+        self.scale_ins = 0
+        self.last_warmup_s: Optional[float] = None
+
+    # -- the state machine ---------------------------------------------------
+
+    def tick(self, now: Optional[float] = None) -> Optional[str]:
+        """One evaluation; returns "scale_out" / "scale_in" when an
+        action fired, else None. Pass ``now`` for fake-clock tests."""
+        fake_now = None if now is None else float(now)
+        now = time.monotonic() if now is None else float(now)
+        sig = self.router.scale_signal()
+        replicas = sig["replicas"]
+        alive = sig["alive"]
+        cfg = self.config
+        with self._lock:
+            if now < self._cooldown_until:
+                # Cooldown: a freshly warmed replica (or a just-drained
+                # fleet) needs a window of traffic before the signal
+                # means anything; neither clock accumulates.
+                self._breach_since = None
+                self._idle_since = None
+                return None
+            # Fleet-wide breach: every live replica sheds (the condition
+            # under which the router surfaces OverloadError), or deaths
+            # dropped the fleet below its floor — backfill after a kill
+            # rides the same hysteresis clock.
+            breaching = alive < cfg.min_replicas or (
+                alive > 0 and all(r["shedding"] for r in replicas.values())
+            )
+            idle = alive > 0 and all(
+                not r["shedding"] and r["headroom"] >= cfg.scale_in_headroom
+                for r in replicas.values()
+            )
+            if breaching and alive < cfg.max_replicas:
+                self._idle_since = None
+                if self._breach_since is None:
+                    self._breach_since = now
+                if now - self._breach_since < cfg.scale_out_after_s:
+                    return None
+                self._breach_since = None
+                action = "scale_out"
+            elif idle and alive > cfg.min_replicas:
+                self._breach_since = None
+                if self._idle_since is None:
+                    self._idle_since = now
+                if now - self._idle_since < cfg.scale_in_after_s:
+                    return None
+                self._idle_since = None
+                action = "scale_in"
+            else:
+                # Neither condition holds (or bounds bind): both clocks
+                # reset — sustained means CONTINUOUSLY, as in obs/slo.py.
+                self._breach_since = None
+                self._idle_since = None
+                return None
+        if action == "scale_out":
+            return self._scale_out(alive, fake_now)
+        return self._scale_in(replicas, alive, fake_now)
+
+    def _start_cooldown(self, fake_now: Optional[float]) -> None:
+        """Cooldown starts when the action COMPLETES — add_replica
+        blocks through the whole AOT warmup, and a cooldown clocked
+        from the decision instant would already be spent by the time a
+        slow-warming replica joins, letting back-to-back scale-outs
+        defeat the settling window the docstring promises. On the fake
+        clock the action is instantaneous, so the passed ``now`` is the
+        completion time."""
+        end = time.monotonic() if fake_now is None else fake_now
+        with self._lock:
+            self._cooldown_until = end + self.config.cooldown_s
+
+    def _scale_out(self, alive: int,
+                   fake_now: Optional[float] = None) -> Optional[str]:
+        t0 = time.monotonic()
+        try:
+            rid = self.router.add_replica()
+        except Exception:  # noqa: BLE001 — scaling must not kill the loop
+            self._log.exception("fleet: scale-out failed")
+            self._start_cooldown(fake_now)  # throttle retry after failure
+            return None
+        warmup_s = time.monotonic() - t0
+        self._start_cooldown(fake_now)
+        with self._lock:
+            self.scale_outs += 1
+            self.last_warmup_s = round(warmup_s, 3)
+        self._flight.record(
+            "scale_out", replica_id=rid, warmup_s=round(warmup_s, 3),
+            n_replicas=alive + 1, reason="sustained fleet-wide shed",
+        )
+        self._log.warning(
+            f"fleet: scale-OUT -> {rid} (fleet was saturated; warmup "
+            f"{warmup_s:.2f}s, now {alive + 1} replicas)"
+        )
+        return "scale_out"
+
+    def _scale_in(self, replicas: dict, alive: int,
+                  fake_now: Optional[float] = None) -> Optional[str]:
+        # Drain the FREEST replica: least in-flight disruption, and the
+        # survivors keep the most loaded working sets warm.
+        rid = max(replicas, key=lambda r: replicas[r]["headroom"])
+        try:
+            self.router.remove_replica(rid)
+        except Exception:  # noqa: BLE001
+            self._log.exception(f"fleet: scale-in of {rid} failed")
+            self._start_cooldown(fake_now)  # throttle retry after failure
+            return None
+        with self._lock:
+            self.scale_ins += 1
+        self._start_cooldown(fake_now)
+        self._flight.record(
+            "scale_in", replica_id=rid, n_replicas=alive - 1,
+            reason="sustained all-replica headroom",
+        )
+        self._log.info(
+            f"fleet: scale-IN {rid} drained and removed "
+            f"(now {alive - 1} replicas)"
+        )
+        return "scale_in"
+
+    # -- poll thread ---------------------------------------------------------
+
+    def start(self) -> "Autoscaler":
+        if self._thread is not None:
+            raise RuntimeError("autoscaler already started")
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="fleet-autoscaler", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.config.poll_secs):
+            try:
+                self.tick()
+            except Exception:  # noqa: BLE001 — the loop must survive
+                self._log.exception("fleet: autoscaler tick failed")
+
+    def stop(self, timeout: float = 10.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "scale_outs": self.scale_outs,
+                "scale_ins": self.scale_ins,
+                "last_warmup_s": self.last_warmup_s,
+                "cooling_down": time.monotonic() < self._cooldown_until,
+            }

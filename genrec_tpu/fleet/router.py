@@ -1,0 +1,600 @@
+"""Fleet router: one `submit()` surface over N `ServingEngine` replicas.
+
+PR 10 taught a single engine to SAY no (`OverloadError` on sustained SLO
+breach); this layer is what turns that signal into capacity. The
+`FleetRouter` owns N in-process replicas (each a full `ServingEngine`:
+own queues, own paged pools, own SLO monitor, own AOT-compiled ladder)
+and exposes the engine's exact request surface — `submit() -> Future`,
+the same typed errors — so a caller cannot tell one replica from a
+fleet, except that the fleet absorbs what a single replica would shed.
+
+Routing policy — cheapest signal that tracks live load:
+
+- Every replica's `stats()` carries a flat per-head ``headroom`` leaf
+  (SLO margin minus queue pressure; serving/engine.py). The router
+  refreshes a cached copy at most every ``headroom_refresh_s`` and
+  ranks candidates by it, tie-broken by the router's own live in-flight
+  count — no percentile math, no nested-dict walks on the submit path.
+- A replica's recoverable `OverloadError` means "try the next replica":
+  the router walks the ranking and only surfaces `OverloadError` to the
+  caller when EVERY live replica shed (the fleet is saturated — that is
+  the autoscaler's cue, counted as ``fleet_shed_rejected``).
+- `DrainingError` from a replica (scale-in, signal) just removes it
+  from consideration for that request.
+
+Failure semantics — accepted work is never silently lost:
+
+- Every accepted request is tracked as a flight (request, caller
+  future, owning replica). `kill_replica` models SIGKILL-style death:
+  the replica is dropped from routing, results it produces after the
+  kill are DISCARDED (a dead process's responses never arrive), and
+  every non-completed flight is re-submitted to a surviving replica —
+  typed, AT MOST ONCE: a request that loses its replica twice fails
+  with `ReplicaLostError` instead of retrying forever, and a re-submit
+  that finds no capacity fails the same way. Flight-recorder events
+  (`replica_dead`, `rerouted`) narrate the episode.
+- Graceful removal (`remove_replica`, the autoscaler's scale-in) is the
+  PR 5 drain reused verbatim: the replica stops taking new routes,
+  `engine.stop()` completes every queued and in-flight request (their
+  fleet futures resolve normally), then the handle is dropped.
+
+Threading: `submit()` runs on caller threads; flight completion
+callbacks run on replica batcher threads; kill/drain/scale run on
+operator or autoscaler threads. One router lock guards the replica
+table and flight sets — never held across an engine call or a
+`Future.result`.
+
+Replica factories should build engines with ``handle_signals=False``:
+the process-level signal path belongs to whoever owns the fleet (one
+`PreemptionGuard` per process), not to each replica.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from concurrent.futures import Future
+from typing import Callable, Optional
+
+from genrec_tpu.obs.flight_recorder import get_flight_recorder
+from genrec_tpu.serving.types import (
+    DrainingError,
+    OverloadError,
+    Request,
+    ServingError,
+)
+
+
+class ReplicaLostError(ServingError):
+    """The replica holding this accepted request died mid-flight and the
+    typed at-most-once re-submit could not complete it (no surviving
+    capacity, or the retry replica died too). The request was NOT
+    silently dropped — this error is the accounting."""
+
+
+class _Flight:
+    """One accepted request in exactly one replica."""
+
+    __slots__ = ("req", "fut", "replica", "retried", "settled")
+
+    def __init__(self, req: Request, fut: Future, replica: "_Replica",
+                 retried: bool):
+        self.req = req
+        self.fut = fut
+        self.replica = replica
+        self.retried = retried   # already re-submitted once (at-most-once)
+        self.settled = False     # result delivered OR ownership moved
+
+
+class _Replica:
+    __slots__ = ("replica_id", "engine", "dead", "draining", "flights",
+                 "headroom", "warmup_s", "folded")
+
+    def __init__(self, replica_id: str, engine, warmup_s: float):
+        self.replica_id = replica_id
+        self.engine = engine
+        self.dead = False        # SIGKILL-style: results discarded
+        self.draining = False    # graceful scale-in: no new routes
+        self.flights: set[_Flight] = set()
+        # Cached stats()["headroom"]: {} = no data yet (a fresh replica
+        # is free capacity), None = the last refresh RAISED (a sick
+        # replica ranks last until it answers again).
+        self.headroom: Optional[dict] = {}
+        self.warmup_s = warmup_s
+        self.folded = False      # final counters folded into _retired
+
+
+class FleetRouter:
+    """Replica router + lifecycle owner. ``make_replica(replica_id)``
+    returns an UN-started `ServingEngine`; the router starts it (the
+    AOT warmup ladder) and times it, so scale-out cost is a measured
+    quantity on every `replica_started` flight event."""
+
+    def __init__(
+        self,
+        make_replica: Callable[[str], object],
+        *,
+        initial_replicas: int = 2,
+        headroom_refresh_s: float = 0.05,
+        logger: Optional[logging.Logger] = None,
+    ):
+        if initial_replicas < 1:
+            raise ValueError("a fleet needs at least one replica")
+        self._make_replica = make_replica
+        self._initial = initial_replicas
+        self._refresh_s = float(headroom_refresh_s)
+        self._log = logger or logging.getLogger("genrec_tpu")
+        self._flight = get_flight_recorder()
+        self._lock = threading.Lock()
+        self._replicas: dict[str, _Replica] = {}
+        self._seq = 0
+        self._draining = False
+        self._started = False
+        self._next_refresh = 0.0
+        # Lifetime counters (stats(); `genrec_fleet_*` counters in
+        # Prometheus exposition — typing pinned in obs/export.py).
+        self._counters = {
+            "routed": 0,
+            "rerouted": 0,
+            "fleet_shed_rejected": 0,
+            "replica_deaths": 0,
+            "replicas_added": 0,
+            "replicas_drained": 0,
+        }
+        # Removed replicas' final COUNTER leaves, retained so the
+        # fleet-aggregated sums in stats() stay monotone across
+        # scale-in and replica death — obs/export.py types them as
+        # Prometheus counters, and a sum over live replicas only would
+        # step backwards on every removal and scrape as a counter
+        # reset (spurious rate() spikes). Gauge leaves (pool occupancy,
+        # prefix entries/retained_*) are NOT retained: a gone replica
+        # holds nothing.
+        self._retired = {
+            "completed": 0,
+            "recompilations": 0,
+            "by_head": {},
+            "prefix_cache": {},
+        }
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "FleetRouter":
+        if self._started:
+            raise RuntimeError("fleet already started")
+        self._started = True
+        for _ in range(self._initial):
+            self.add_replica()
+        return self
+
+    def add_replica(self) -> str:
+        """Scale-out unit: build + start (AOT-warm) one replica and add
+        it to the routing set. Returns its replica id. The measured
+        warmup is THE scale-out cost — the autoscaler's flight events
+        carry it so capacity lag is a traced number, not a guess."""
+        with self._lock:
+            if self._draining:
+                raise DrainingError("fleet is draining; refusing scale-out")
+            rid = f"r{self._seq}"
+            self._seq += 1
+        engine = self._make_replica(rid)
+        if getattr(engine, "replica_id", None) is None:
+            engine.replica_id = rid
+        t0 = time.monotonic()
+        if not getattr(engine, "_started", False):
+            engine.start()
+        warmup_s = time.monotonic() - t0
+        rep = _Replica(rid, engine, warmup_s)
+        with self._lock:
+            # Re-check under the lock: a stop() that raced the (long,
+            # lock-free) warmup above has already snapshotted the
+            # replica table — registering now would leak a started
+            # engine no drain path will ever visit.
+            aborted = self._draining
+            if not aborted:
+                self._replicas[rid] = rep
+                self._counters["replicas_added"] += 1
+                n = len(self._replicas)
+        if aborted:
+            try:
+                engine.stop(timeout=60)
+            except Exception:  # noqa: BLE001
+                self._log.exception(
+                    f"fleet: stopping orphaned replica {rid} failed"
+                )
+            raise DrainingError(
+                "fleet drained during replica warmup; replica discarded"
+            )
+        self._flight.record(
+            "replica_started", replica_id=rid,
+            warmup_s=round(warmup_s, 3),
+            warmup_compiles=engine.metrics.warmup_compiles,
+            n_replicas=n,
+        )
+        self._log.info(
+            f"fleet: replica {rid} up in {warmup_s:.2f}s "
+            f"({engine.metrics.warmup_compiles} warmup compiles, "
+            f"{n} replicas)"
+        )
+        return rid
+
+    # Prefix-cache leaves that are Prometheus COUNTERS (obs/export.py);
+    # the entries/retained_pages/retained_bytes leaves are gauges and
+    # must NOT be retained for removed replicas.
+    _PREFIX_COUNTER_LEAVES = frozenset({
+        "lookups", "hits", "partial_hits", "misses", "warm_tokens",
+        "insertions", "evictions", "invalidations",
+    })
+
+    def _fold_retired(self, rep: _Replica, s: dict) -> None:
+        """Fold a removed replica's final counter totals into the
+        retained accumulator (once per replica, under the lock)."""
+        with self._lock:
+            self._fold_retired_locked(rep, s)
+
+    def _fold_retired_locked(self, rep: _Replica, s: dict) -> None:
+        """Body of :meth:`_fold_retired`; caller holds ``self._lock``."""
+        if rep.folded:
+            return
+        rep.folded = True
+        ret = self._retired
+        ret["completed"] += s.get("completed", 0)
+        ret["recompilations"] += s.get("recompilations", 0)
+        for head, n in (s.get("submitted_by_head") or {}).items():
+            d = ret["by_head"].setdefault(
+                head, {"submitted": 0, "overload_rejected": 0})
+            d["submitted"] += n
+        for head, n in (s.get("overload_by_head") or {}).items():
+            d = ret["by_head"].setdefault(
+                head, {"submitted": 0, "overload_rejected": 0})
+            d["overload_rejected"] += n
+        for head, pc in (s.get("prefix_cache") or {}).items():
+            agg = ret["prefix_cache"].setdefault(head, {})
+            for k, v in pc.items():
+                if (k in self._PREFIX_COUNTER_LEAVES
+                        and isinstance(v, (int, float))):
+                    agg[k] = agg.get(k, 0) + v
+
+    def kill_replica(self, replica_id: str) -> int:
+        """SIGKILL-style death (the chaos harness's hook): the replica
+        vanishes from routing, anything it produces from now on is
+        discarded, and its non-completed flights are re-submitted (typed,
+        at most once) to the survivors. Returns the stranded count."""
+        with self._lock:
+            rep = self._replicas.pop(replica_id, None)
+            if rep is None or rep.dead:
+                return 0
+            rep.dead = True
+            stranded = [fl for fl in rep.flights if not fl.settled]
+            for fl in stranded:
+                fl.settled = True  # the dead replica can never settle these
+            rep.flights.clear()
+            self._counters["replica_deaths"] += 1
+            survivors = len(self._replicas)
+        # Snapshot the counters it racked up BEFORE it died (deliveries
+        # up to the kill) so the fleet's counter sums stay monotone;
+        # anything it "completes" after this instant is discarded work
+        # and deliberately uncounted.
+        try:
+            self._fold_retired(rep, rep.engine.stats())
+        except Exception:  # noqa: BLE001 — a dead replica owes us nothing
+            with self._lock:
+                rep.folded = True
+        self._flight.record(
+            "replica_dead", replica_id=replica_id, cause="killed",
+            stranded=len(stranded), n_replicas=survivors,
+        )
+        self._log.warning(
+            f"fleet: replica {replica_id} died with {len(stranded)} "
+            f"requests in flight — rerouting to {survivors} survivors"
+        )
+        # Reap the abandoned engine's threads off this thread; every
+        # result it still produces is dropped by the dead-check in
+        # _on_replica_done (a dead process's responses never arrive).
+        threading.Thread(
+            target=self._reap, args=(rep,), daemon=True,
+            name=f"fleet-reap-{replica_id}",
+        ).start()
+        for fl in stranded:
+            self._reroute(fl, from_replica=replica_id)
+        return len(stranded)
+
+    def _reap(self, rep: _Replica) -> None:
+        try:
+            rep.engine.stop(timeout=60)
+        except Exception:  # noqa: BLE001 — a dead replica owes us nothing
+            self._log.exception(
+                f"fleet: reaping killed replica {rep.replica_id} failed"
+            )
+
+    def remove_replica(self, replica_id: str, timeout: float = 60.0) -> dict:
+        """Graceful scale-in: stop routing to the replica, drain it (the
+        PR 5 path — queued + in-flight complete, their fleet futures
+        resolve normally), then drop the handle. Returns the replica's
+        final stats snapshot."""
+        with self._lock:
+            rep = self._replicas.get(replica_id)
+            if rep is None or rep.dead:
+                raise KeyError(f"no live replica {replica_id!r}")
+            rep.draining = True
+        final = rep.engine.stop(timeout)
+        with self._lock:
+            # Fold + pop atomically: a concurrent stats() scrape must
+            # never see the replica's counters both live and retired.
+            self._fold_retired_locked(rep, final)
+            self._replicas.pop(replica_id, None)
+            self._counters["replicas_drained"] += 1
+            n = len(self._replicas)
+        self._flight.record(
+            "replica_drained", replica_id=replica_id,
+            completed=final.get("completed"), n_replicas=n,
+        )
+        self._log.info(
+            f"fleet: replica {replica_id} drained and removed "
+            f"({final.get('completed')} lifetime requests, {n} replicas)"
+        )
+        return final
+
+    def stop(self, timeout: float = 60.0) -> dict:
+        """Drain the whole fleet: reject new submissions (typed), finish
+        every accepted request, stop every replica. Returns the final
+        aggregate stats. Idempotent."""
+        with self._lock:
+            already = self._draining
+            self._draining = True
+            reps = list(self._replicas.values())
+        for rep in reps:
+            if rep.dead:
+                continue  # the kill path already folded its counters
+            try:
+                final_r = rep.engine.stop(timeout)
+            except Exception:  # noqa: BLE001 — drain the rest regardless
+                self._log.exception(
+                    f"fleet: stopping replica {rep.replica_id} failed"
+                )
+            else:
+                self._fold_retired(rep, final_r)
+        with self._lock:
+            self._replicas.clear()
+        # After the clear the aggregate reads pure retired counters, so
+        # the returned final stats can never double-count a replica.
+        final = self.stats()
+        if not already:
+            self._flight.record(
+                "fleet_stopped", completed=final.get("completed"),
+                replicas=len(reps),
+            )
+        return final
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def replica_ids(self) -> list[str]:
+        with self._lock:
+            return sorted(self._replicas)
+
+    # -- request path --------------------------------------------------------
+
+    def submit(self, req: Request) -> Future:
+        """The engine surface, fleet-wide: returns a Future; raises the
+        typed `DrainingError` when the fleet is stopping and
+        `OverloadError` only when EVERY live replica sheds."""
+        if self._draining:
+            raise DrainingError(
+                "fleet is draining; request rejected — fail over"
+            )
+        fut = Future()
+        self._dispatch(req, fut, retried=False)
+        return fut
+
+    def _ranked(self, head: str) -> list[_Replica]:
+        now = time.monotonic()
+        refresh = False
+        with self._lock:
+            if now >= self._next_refresh:
+                self._next_refresh = now + self._refresh_s
+                refresh = True
+            reps = [r for r in self._replicas.values()
+                    if not r.dead and not r.draining]
+        if refresh:
+            # Outside the router lock: stats() takes the engine's own
+            # locks. A racing kill marks the replica dead; the stale
+            # cache entry is harmless (submit() re-checks liveness).
+            for r in reps:
+                try:
+                    r.headroom = r.engine.stats()["headroom"]
+                except Exception:  # noqa: BLE001 — a sick replica ranks last
+                    r.headroom = None
+        with self._lock:
+            return sorted(
+                (r for r in reps if not r.dead and not r.draining),
+                key=lambda r: (-(r.headroom.get(head, 1.0)
+                                 if r.headroom is not None else -1.0),
+                               len(r.flights), r.replica_id),
+            )
+
+    def _dispatch(self, req: Request, fut: Future, retried: bool) -> str:
+        """Place one request on the best live replica; raises typed
+        errors when nothing accepts. Returns the accepting replica id."""
+        ranked = self._ranked(req.head)
+        sheds = 0
+        for rep in ranked:
+            try:
+                ef = rep.engine.submit(req)
+            except OverloadError:
+                sheds += 1  # this replica sheds: try the next one
+                continue
+            except DrainingError:
+                continue    # dying replica: not a capacity signal
+            # Anything else (UnknownHeadError, validation) is a caller
+            # bug identical on every replica — propagate.
+            flight = _Flight(req, fut, rep, retried)
+            with self._lock:
+                if rep.dead:
+                    # Killed between submit and registration: its results
+                    # are discarded, so this acceptance never counts.
+                    flight.settled = True
+                else:
+                    rep.flights.add(flight)
+                    self._counters["routed"] += 1
+            if flight.settled and rep.dead:
+                continue
+            ef.add_done_callback(
+                lambda f, fl=flight: self._on_replica_done(fl, f)
+            )
+            return rep.replica_id
+        if ranked and sheds == len(ranked):
+            with self._lock:
+                self._counters["fleet_shed_rejected"] += 1
+            raise OverloadError(
+                f"all {len(ranked)} replicas are load-shedding for head "
+                f"{req.head!r} (fleet saturated); back off and retry"
+            )
+        if self._draining:
+            raise DrainingError("fleet is draining; request rejected")
+        with self._lock:
+            self._counters["fleet_shed_rejected"] += 1
+        raise OverloadError(
+            f"no live replica accepted head {req.head!r} "
+            f"({len(ranked)} candidates); the fleet is at zero capacity"
+        )
+
+    def _on_replica_done(self, flight: _Flight, ef: Future) -> None:
+        """Replica batcher thread: move the replica future's outcome to
+        the caller's fleet future — unless the replica died first, in
+        which case the kill path owns the flight (its 'result' is a
+        message from a dead process; dropping it is the simulation's
+        fidelity, and the reroute already re-placed the request)."""
+        with self._lock:
+            if flight.settled or flight.replica.dead:
+                return
+            flight.settled = True
+            flight.replica.flights.discard(flight)
+        exc = ef.exception()
+        if flight.fut.done():  # caller cancelled: nothing to deliver
+            return
+        if exc is None:
+            flight.fut.set_result(ef.result())
+        else:
+            flight.fut.set_exception(exc)
+
+    def _reroute(self, flight: _Flight, from_replica: str) -> None:
+        """Typed, at-most-once re-submit of a stranded flight."""
+        if flight.fut.done():
+            return
+        if flight.retried:
+            flight.fut.set_exception(ReplicaLostError(
+                f"request lost replica {from_replica} after already being "
+                "re-routed once (at-most-once retry exhausted)"
+            ))
+            return
+        try:
+            to = self._dispatch(flight.req, flight.fut, retried=True)
+        except ServingError as e:
+            flight.fut.set_exception(ReplicaLostError(
+                f"replica {from_replica} died mid-flight and the re-submit "
+                f"found no capacity: {e}"
+            ))
+            return
+        with self._lock:
+            self._counters["rerouted"] += 1
+        self._flight.record(
+            "rerouted", head=flight.req.head,
+            replica_from=from_replica, replica_to=to,
+        )
+
+    # -- autoscaler / observability surface ----------------------------------
+
+    def scale_signal(self) -> dict:
+        """Per-replica scalar load state for the autoscaler: min-over-
+        heads headroom and whether the replica currently sheds."""
+        with self._lock:
+            reps = [r for r in self._replicas.values()
+                    if not r.dead and not r.draining]
+        per = {}
+        for rep in reps:
+            try:
+                s = rep.engine.stats()
+            except Exception:  # noqa: BLE001 — a sick replica reads as full
+                per[rep.replica_id] = {"headroom": -1.0, "shedding": True}
+                continue
+            room = s.get("headroom") or {}
+            hr = min(room.values()) if room else 1.0
+            shedding = bool((s.get("slo") or {}).get("shedding")) or hr <= 0.0
+            per[rep.replica_id] = {
+                "headroom": round(hr, 4), "shedding": shedding,
+            }
+        return {"replicas": per, "alive": len(per)}
+
+    def stats(self) -> dict:
+        """Fleet-aggregated snapshot: router counters + per-head sums of
+        every live replica's submit/overload/prefix-cache counters +
+        per-replica gauges. `write_prometheus(path, router.stats(),
+        namespace="genrec_fleet")` exposes it — counter/gauge typing is
+        pinned by the leaf names (obs/export.py)."""
+        with self._lock:
+            reps = list(self._replicas.values())
+            counters = dict(self._counters)
+            total = self._seq
+            # Removed replicas' retained counter totals seed the sums,
+            # keeping every counter-typed leaf monotone across
+            # scale-in/death (a live-only sum would scrape as a
+            # counter reset).
+            by_head = {h: dict(d)
+                       for h, d in self._retired["by_head"].items()}
+            prefix = {h: dict(d)
+                      for h, d in self._retired["prefix_cache"].items()}
+            recompilations = self._retired["recompilations"]
+            completed = self._retired["completed"]
+        replicas: dict[str, dict] = {}
+        for rep in reps:
+            if rep.dead or rep.folded:
+                continue
+            try:
+                s = rep.engine.stats()
+            except Exception:  # noqa: BLE001 — a sick replica drops out
+                continue
+            recompilations += s.get("recompilations", 0)
+            completed += s.get("completed", 0)
+            pool = s.get("kv_pool") or {}
+            replicas[rep.replica_id] = {
+                "submitted": s.get("submitted", 0),
+                "completed": s.get("completed", 0),
+                "overload_rejected": s.get("overload_rejected", 0),
+                "recompilations": s.get("recompilations", 0),
+                "queue_depth": sum((s.get("queue_depth") or {}).values()),
+                # Paged-pool occupancy summed over heads: "all pages
+                # released after drain" is checked FLEET-wide
+                # (scripts/check_fleet.py) off these two gauges.
+                "pages_in_use": sum(g.get("pages_in_use", 0)
+                                    for g in pool.values()),
+                "slots_active": sum(g.get("slots_active", 0)
+                                    for g in pool.values()),
+                "headroom": dict(s.get("headroom") or {}),
+                "draining": bool(s.get("draining")),
+                "warmup_s": round(rep.warmup_s, 3),
+            }
+            for head, n in (s.get("submitted_by_head") or {}).items():
+                by_head.setdefault(head, {"submitted": 0,
+                                          "overload_rejected": 0})
+                by_head[head]["submitted"] += n
+            for head, n in (s.get("overload_by_head") or {}).items():
+                by_head.setdefault(head, {"submitted": 0,
+                                          "overload_rejected": 0})
+                by_head[head]["overload_rejected"] += n
+            for head, pc in (s.get("prefix_cache") or {}).items():
+                agg = prefix.setdefault(head, {})
+                for k, v in pc.items():
+                    if isinstance(v, (int, float)):
+                        agg[k] = agg.get(k, 0) + v
+        return {
+            **counters,
+            "replicas_alive": len(replicas),
+            "replicas_total": total,
+            "completed": completed,
+            "recompilations": recompilations,
+            "by_head": by_head,
+            "prefix_cache": prefix,
+            "replicas": replicas,
+        }

@@ -1,0 +1,379 @@
+"""Deterministic million-user traffic harness: seeded open-loop replay.
+
+The fleet front (router + autoscaler) is only testable against traffic
+that looks like production — Zipfian user popularity over millions of
+distinct user ids, session arrivals whose rate swings diurnally and
+spikes in bursts — and only GATEABLE when that traffic replays
+bit-identically: the same seed must produce the same arrival schedule
+down to the last float, so `bench_gate` can pin p99-under-burst and
+shed-rate as regression metrics instead of anecdotes.
+
+Three pieces:
+
+- `TraceConfig` + `generate_trace` — the schedule generator. User
+  popularity is Zipfian over ranks (p ∝ 1/rank^zipf_a; the probability
+  vector is O(n_users) float64, so tens of millions of DISTINCT ids are
+  one ~100MB host array — the "millions of users" scale is the id
+  space, while per-user state materializes lazily for the users a trace
+  actually visits). Arrival times are an inhomogeneous Poisson process
+  (Lewis thinning against the peak rate) whose rate is the base QPS
+  modulated by a sinusoidal diurnal factor and piecewise-constant burst
+  multipliers. Everything is drawn from ONE seeded np.random.Generator
+  in a fixed order: same config ⇒ bit-identical `Trace`.
+- `replay` — the open-loop driver: submits each arrival at its scheduled
+  (time-scaled) offset WITHOUT waiting for responses (open loop: an
+  overloaded server does not slow the offered load down — the property
+  closed-loop drivers silently lose), counts typed sheds
+  (`OverloadError`) and drains per arrival, then gathers completions
+  into a `ReplayReport` with p99-under-burst and shed-rate. Chaos
+  belongs in the harness: `chaos=[(t, fn), ...]` fires each hook once
+  when trace time passes `t` — killing a replica mid-burst is
+  `(burst_start + eps, lambda: router.kill_replica("r0"))`.
+- `zipfian_repeat_user_trace` — PR 11's repeat-user trace (moved here
+  from bench.py, which now imports it): the closed-form repeat/refresh
+  workload the prefix-cache bench drives. `generate_trace` generalizes
+  it with real arrival TIMES; this stays for the benches that only need
+  the request sequence.
+
+Layering: fleet sits above serving (docs/architecture.md L7) — this
+module imports serving's `Request` type only.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import time
+from concurrent.futures import TimeoutError as _FutureTimeout
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from genrec_tpu.serving.types import (
+    DrainingError,
+    OverloadError,
+    Request,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Burst:
+    """A load spike: ``rate_mult`` x the diurnal rate over
+    [t_start, t_start + duration_s)."""
+
+    t_start: float
+    duration_s: float
+    rate_mult: float
+
+    def covers(self, t: float) -> bool:
+        return self.t_start <= t < self.t_start + self.duration_s
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceConfig:
+    """Shape of one deterministic traffic trace.
+
+    ``n_users`` is the DISTINCT-id space (millions-capable; the Zipf
+    probability vector is the only O(n_users) cost). ``item_lo`` lets
+    retrieval-head traces use 1-based vocab ids (0 = pad). The diurnal
+    factor is ``1 + diurnal_amplitude * sin(2π t / diurnal_period_s)``
+    — one synthetic "day" per period, compressed so tests and benches
+    see a full cycle in seconds.
+    """
+
+    n_requests: int = 256
+    n_users: int = 1_000_000
+    max_items: int = 20
+    corpus_size: int = 100
+    head: str = "tiger"
+    seed: int = 0
+    zipf_a: float = 1.5
+    p_new_item: float = 0.25
+    base_rate_qps: float = 32.0
+    diurnal_period_s: float = 60.0
+    diurnal_amplitude: float = 0.5
+    bursts: tuple[Burst, ...] = ()
+    item_lo: int = 0  # retrieval heads: 1 (0 is the pad id)
+
+    def __post_init__(self):
+        if not 0 <= self.diurnal_amplitude < 1:
+            raise ValueError("diurnal_amplitude must be in [0, 1)")
+        if self.base_rate_qps <= 0 or self.n_requests <= 0:
+            raise ValueError(f"invalid trace config {self}")
+
+    def rate_at(self, t: float) -> float:
+        """Instantaneous arrival rate (QPS) at trace time ``t``."""
+        rate = self.base_rate_qps * (
+            1.0 + self.diurnal_amplitude
+            * math.sin(2.0 * math.pi * t / self.diurnal_period_s)
+        )
+        for b in self.bursts:
+            if b.covers(t):
+                rate *= b.rate_mult
+        return rate
+
+    @property
+    def peak_rate(self) -> float:
+        """Supremum of `rate_at` over all t — the Lewis-thinning
+        envelope. Burst windows may OVERLAP (`rate_at` multiplies every
+        covering burst), so the envelope is the max multiplier PRODUCT
+        over the piecewise-constant segments the burst boundaries
+        induce — a single largest-multiplier bound would let the
+        acceptance ratio exceed 1 inside an overlap and silently cap
+        the realized rate there."""
+        peak = self.base_rate_qps * (1.0 + self.diurnal_amplitude)
+        bounds = sorted({b.t_start for b in self.bursts}
+                        | {b.t_start + b.duration_s for b in self.bursts})
+        best = 1.0
+        for lo, hi in zip(bounds, bounds[1:]):
+            mid = (lo + hi) / 2.0
+            prod = 1.0
+            for b in self.bursts:
+                if b.covers(mid):
+                    prod *= b.rate_mult
+            best = max(best, prod)
+        return peak * best
+
+    def in_burst(self, t: float) -> bool:
+        return any(b.covers(t) for b in self.bursts)
+
+
+@dataclasses.dataclass(frozen=True)
+class Arrival:
+    t: float          # trace-time offset (s) from replay start
+    user_id: int
+    history: np.ndarray
+    in_burst: bool
+
+
+@dataclasses.dataclass(frozen=True)
+class Trace:
+    config: TraceConfig
+    arrivals: tuple[Arrival, ...]
+
+    def __len__(self) -> int:
+        return len(self.arrivals)
+
+    def schedule(self) -> np.ndarray:
+        """(n,) float64 arrival offsets — the bit-identity surface the
+        determinism test compares."""
+        return np.array([a.t for a in self.arrivals], np.float64)
+
+    def requests(self) -> list[Request]:
+        cfg = self.config
+        return [Request(head=cfg.head, history=a.history, user_id=a.user_id)
+                for a in self.arrivals]
+
+
+def _zipf_probs(n_users: int, zipf_a: float) -> np.ndarray:
+    ranks = np.arange(1, n_users + 1, dtype=np.float64)
+    p = ranks ** -zipf_a
+    p /= p.sum()
+    return p
+
+
+def generate_trace(cfg: TraceConfig) -> Trace:
+    """Materialize one deterministic trace: same cfg ⇒ bit-identical
+    arrival times, user ids, and histories (pinned by
+    tests/test_fleet.py). All randomness flows through ONE seeded
+    generator in a fixed draw order — keep it that way when editing."""
+    rng = np.random.default_rng(cfg.seed)
+    # 1) Arrival times: Lewis thinning against the peak rate. Candidate
+    # inter-arrivals are drawn at peak and accepted w.p. rate(t)/peak —
+    # an exact inhomogeneous Poisson sampler, and deterministic here
+    # because every candidate consumes exactly two draws.
+    peak = cfg.peak_rate
+    times = []
+    t = 0.0
+    while len(times) < cfg.n_requests:
+        t += rng.exponential(1.0 / peak)
+        if rng.random() <= cfg.rate_at(t) / peak:
+            times.append(t)
+    # 2) Users: one vectorized Zipfian draw over the full id space.
+    users = rng.choice(cfg.n_users, size=cfg.n_requests,
+                       p=_zipf_probs(cfg.n_users, cfg.zipf_a))
+    # 3) Histories: per-user session state, created lazily on first
+    # visit (ids drawn per arrival in order, so the dict never holds
+    # more than the VISITED users — the id space can be millions wide).
+    histories: dict[int, list] = {}
+    arrivals = []
+    for t, user in zip(times, users):
+        user = int(user)
+        h = histories.get(user)
+        if h is None:
+            n0 = int(rng.integers(3, cfg.max_items + 1))
+            h = list(rng.integers(cfg.item_lo, cfg.corpus_size, n0))
+        elif rng.random() < cfg.p_new_item:
+            h = (h + [int(rng.integers(cfg.item_lo, cfg.corpus_size))]
+                 )[-cfg.max_items:]
+        histories[user] = h
+        arrivals.append(Arrival(
+            t=float(t), user_id=user,
+            history=np.asarray(h, np.int64),
+            in_burst=cfg.in_burst(float(t)),
+        ))
+    return Trace(config=cfg, arrivals=tuple(arrivals))
+
+
+@dataclasses.dataclass
+class ReplayReport:
+    """Outcome of one open-loop replay. ``lost`` is the invariant the
+    kill-chaos tests pin at zero: every arrival is accounted for as
+    completed, typed-shed, typed-drain-rejected, or failed-with-error —
+    a future that silently never resolved counts as lost."""
+
+    submitted: int = 0
+    completed: int = 0
+    shed: int = 0
+    rejected: int = 0   # typed DrainingError at submit
+    failed: int = 0     # future resolved with a non-typed error
+    lost: int = 0       # future never resolved inside the gather timeout
+    wall_s: float = 0.0
+    offered_qps: float = 0.0
+    p50_ms: Optional[float] = None
+    p99_ms: Optional[float] = None
+    burst_submitted: int = 0
+    burst_shed: int = 0
+    p99_under_burst_ms: Optional[float] = None
+    late_submits: int = 0  # arrivals dispatched >1 tick behind schedule
+
+    @property
+    def shed_rate(self) -> float:
+        return self.shed / self.submitted if self.submitted else 0.0
+
+    @property
+    def burst_shed_rate(self) -> float:
+        return (self.burst_shed / self.burst_submitted
+                if self.burst_submitted else 0.0)
+
+    def summary(self) -> dict:
+        return {
+            "submitted": self.submitted,
+            "completed": self.completed,
+            "shed": self.shed,
+            "rejected": self.rejected,
+            "failed": self.failed,
+            "lost": self.lost,
+            "shed_rate": round(self.shed_rate, 4),
+            "wall_s": round(self.wall_s, 2),
+            "offered_qps": round(self.offered_qps, 2),
+            "p50_ms": self.p50_ms,
+            "p99_ms": self.p99_ms,
+            "burst_submitted": self.burst_submitted,
+            "burst_shed_rate": round(self.burst_shed_rate, 4),
+            "p99_under_burst_ms": self.p99_under_burst_ms,
+            "late_submits": self.late_submits,
+        }
+
+
+def _pct(vals: Sequence[float], q: float) -> Optional[float]:
+    if not vals:
+        return None
+    vals = sorted(vals)
+    return round(vals[min(len(vals) - 1, int(q * len(vals)))] * 1e3, 3)
+
+
+def replay(
+    trace: Trace,
+    submit: Callable[[Request], object],
+    *,
+    time_scale: float = 1.0,
+    chaos: Sequence[tuple[float, Callable[[], None]]] = (),
+    gather_timeout_s: float = 120.0,
+) -> ReplayReport:
+    """Drive one trace open-loop through ``submit`` (a FleetRouter's or
+    a bare engine's — anything returning a Future and raising the typed
+    serving errors). Arrival ``t`` maps to wall offset ``t *
+    time_scale`` (compress a 60s synthetic day into 6s with 0.1).
+    ``chaos`` hooks fire once each when trace time passes their ``t`` —
+    BEFORE the next submit, so "kill a replica mid-burst" lands between
+    two scheduled arrivals, exactly like a preemption would."""
+    pending: list[tuple[Arrival, object]] = []
+    report = ReplayReport()
+    hooks = sorted(chaos, key=lambda c: c[0])
+    hook_i = 0
+    t0 = time.monotonic()
+    for arr in trace.arrivals:
+        target = t0 + arr.t * time_scale
+        while hook_i < len(hooks) and hooks[hook_i][0] <= arr.t:
+            hooks[hook_i][1]()
+            hook_i += 1
+        delay = target - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        elif -delay > max(0.05, 0.05 * time_scale):
+            report.late_submits += 1  # host fell behind the schedule
+        report.submitted += 1
+        if arr.in_burst:
+            report.burst_submitted += 1
+        req = Request(head=trace.config.head, history=arr.history,
+                      user_id=arr.user_id)
+        try:
+            fut = submit(req)
+        except OverloadError:
+            report.shed += 1
+            if arr.in_burst:
+                report.burst_shed += 1
+            continue
+        except DrainingError:
+            report.rejected += 1
+            continue
+        pending.append((arr, fut))
+    for t_hook, fn in hooks[hook_i:]:  # hooks past the last arrival
+        fn()
+    lat: list[float] = []
+    burst_lat: list[float] = []
+    deadline = time.monotonic() + gather_timeout_s
+    for arr, fut in pending:
+        try:
+            resp = fut.result(max(deadline - time.monotonic(), 0.001))
+        except (_FutureTimeout, TimeoutError):
+            report.lost += 1
+            continue
+        except Exception:  # noqa: BLE001 — typed per-future failure
+            report.failed += 1
+            continue
+        report.completed += 1
+        lat.append(resp.total_s)
+        if arr.in_burst:
+            burst_lat.append(resp.total_s)
+    report.wall_s = time.monotonic() - t0
+    report.offered_qps = report.submitted / report.wall_s \
+        if report.wall_s > 0 else 0.0
+    report.p50_ms = _pct(lat, 0.50)
+    report.p99_ms = _pct(lat, 0.99)
+    report.p99_under_burst_ms = _pct(burst_lat, 0.99)
+    return report
+
+
+def zipfian_repeat_user_trace(n_requests: int, n_users: int, max_items: int,
+                              corpus_size: int, rng, zipf_a: float = 1.5,
+                              p_new_item: float = 0.25):
+    """Deterministic repeat-user request trace (the prefix-cache bench's
+    workload; PR 11, moved here from bench.py).
+
+    User popularity is Zipfian over ranks (p ∝ 1/rank^zipf_a): a few
+    heavy users dominate arrivals — recommendation traffic's shape, and
+    the prefix cache's best case. Each arrival either REPEATS the user's
+    previous request verbatim (a refresh / next-page fetch: warm
+    full-history hit) or first appends one new interaction
+    (history grew: cold, re-retained). Histories cap at ``max_items`` by
+    sliding (oldest item drops), matching the serving bucket clip.
+
+    Returns a list of (user_id, history ndarray) pairs, fully
+    materialized up front so driver threads never touch the rng
+    (np.random.Generator is not thread-safe)."""
+    p = _zipf_probs(n_users, zipf_a)
+    histories: dict = {}
+    trace = []
+    for _ in range(n_requests):
+        user = int(rng.choice(n_users, p=p))
+        h = histories.get(user)
+        if h is None:
+            h = list(rng.integers(0, corpus_size,
+                                  int(rng.integers(3, max_items + 1))))
+        elif rng.random() < p_new_item:
+            h = (h + [int(rng.integers(0, corpus_size))])[-max_items:]
+        histories[user] = h
+        trace.append((user, np.asarray(h, np.int64)))
+    return trace

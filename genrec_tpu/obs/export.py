@@ -32,6 +32,10 @@ _COUNTER_LEAVES = frozenset({
     # entries/retained_pages/retained_bytes leaves stay gauges.
     "lookups", "hits", "partial_hits", "misses", "warm_tokens",
     "insertions", "invalidations",
+    # Fleet-front lifetime totals (genrec_fleet_*, fleet/router.py +
+    # fleet/autoscaler.py); replicas_alive / headroom leaves stay gauges.
+    "routed", "rerouted", "fleet_shed_rejected", "replica_deaths",
+    "replicas_added", "replicas_drained", "scale_outs", "scale_ins",
 })
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_]")

@@ -68,7 +68,7 @@ class SLOTarget:
 
 class _HeadState:
     __slots__ = ("shedding", "breach_since", "ok_since", "breaches",
-                 "breached", "values", "counters")
+                 "breached", "values", "margins", "counters")
 
     def __init__(self):
         self.shedding = False
@@ -77,8 +77,29 @@ class _HeadState:
         self.breaches = 0
         self.breached: list[str] = []   # dimensions currently violated
         self.values: dict = {}          # last observed values
+        self.margins: dict = {}         # per-target margin (1=free, <0=over)
         # (t, oom_deferred_total, submitted_total) ring for window deltas
         self.counters: collections.deque = collections.deque(maxlen=4096)
+
+
+def _margin(observed: float, target: float) -> float:
+    """Fractional distance to a lower-is-better target, clamped to
+    [-1, 1]: 1.0 = completely free, 0.0 = exactly at the target,
+    negative = over it. The cheap scalar a fleet router ranks replicas
+    by without re-deriving percentiles from nested snapshots."""
+    if target <= 0:
+        return 1.0 if observed <= target else -1.0
+    return max(-1.0, min(1.0, (target - float(observed)) / float(target)))
+
+
+def _head_headroom(st: _HeadState) -> float:
+    """One scalar per head: the tightest per-target margin (1.0 when no
+    dimension has an observation yet — an idle head is free capacity).
+    A SHEDDING head advertises no headroom regardless of its instant
+    margins: hysteresis owns the recovery decision, and a router that
+    resumed traffic on the first good margin would defeat it."""
+    room = min(st.margins.values()) if st.margins else 1.0
+    return min(room, 0.0) if st.shedding else room
 
 
 class SLOMonitor:
@@ -140,21 +161,30 @@ class SLOMonitor:
                 )
             breached: list[str] = []
             values: dict = {}
+            margins: dict = {}
             if target.p99_ms is not None and p99_ms is not None:
                 values["p99_ms"] = round(float(p99_ms), 3)
+                margins["p99_ms"] = _margin(p99_ms, target.p99_ms)
                 if p99_ms > target.p99_ms:
                     breached.append("p99_ms")
             if target.max_queue_depth is not None and queue_depth is not None:
                 values["queue_depth"] = int(queue_depth)
+                margins["queue_depth"] = _margin(
+                    queue_depth, target.max_queue_depth
+                )
                 if queue_depth > target.max_queue_depth:
                     breached.append("queue_depth")
             if target.max_deferral_rate is not None:
                 rate = self._deferral_rate(st, target, now)
                 if rate is not None:
                     values["deferral_rate"] = round(rate, 4)
+                    margins["deferral_rate"] = _margin(
+                        rate, target.max_deferral_rate
+                    )
                     if rate > target.max_deferral_rate:
                         breached.append("deferral_rate")
             st.values = values
+            st.margins = margins
             st.breached = breached
             if breached:
                 st.ok_since = None
@@ -198,8 +228,19 @@ class SLOMonitor:
             ) or "recovering"
         return f"sustained SLO breach on {head}: {dims}"
 
+    def headroom(self) -> dict:
+        """{head: scalar headroom} — the flat per-head signal a fleet
+        router ranks replicas by (dict reads under the lock, no
+        percentile math; see :func:`_head_headroom`)."""
+        with self._lock:
+            return {name: round(_head_headroom(st), 4)
+                    for name, st in self._state.items()}
+
     def snapshot(self) -> dict:
-        """Numeric per-head state for metrics/Prometheus exposition."""
+        """Numeric per-head state for metrics/Prometheus exposition.
+        Each head carries its last observed values, the per-target
+        ``margins`` (1 = free, 0 = at target, negative = over), and the
+        scalar ``headroom`` (tightest margin, 0-floored while shedding)."""
         with self._lock:
             heads = {}
             for name, st in self._state.items():
@@ -207,6 +248,9 @@ class SLOMonitor:
                     "shedding": st.shedding,
                     "breaches": st.breaches,
                     "breached_dims": len(st.breached),
+                    "headroom": round(_head_headroom(st), 4),
+                    "margins": {k: round(v, 4)
+                                for k, v in st.margins.items()},
                     **{k: v for k, v in st.values.items()},
                 }
             any_shed = any(s.shedding for s in self._state.values())

@@ -712,6 +712,7 @@ class _PagedRunner:
                     compute_s=now - t_admit,
                     total_s=now - t_enq,
                     request_id=tr[0] if tr is not None else None,
+                    replica_id=eng.replica_id,
                 )
             except Exception as e:  # noqa: BLE001 — one bad slot, not the loop
                 eng._log.exception(
@@ -773,7 +774,12 @@ class ServingEngine:
         hbm_budget_bytes: Optional[int] = None,
         slo_targets=None,
         slo_poll_secs: float = 0.05,
+        replica_id: Optional[str] = None,
     ):
+        # Replica identity (fleet deployments, genrec_tpu/fleet/): stamped
+        # into every Response (`Response.replica_id` provenance) and the
+        # lifecycle flight events. None for a standalone engine.
+        self.replica_id = replica_id
         self._heads = {h.name: h for h in heads}
         if len(self._heads) != len(heads):
             raise ValueError("duplicate head names")
@@ -928,6 +934,7 @@ class ServingEngine:
             "serving_started", heads=sorted(self._heads),
             paged_heads=sorted(self._runners),
             warmup_compiles=self.metrics.warmup_compiles,
+            replica_id=self.replica_id,
         )
         self._batcher.start()
         return self
@@ -1116,6 +1123,26 @@ class ServingEngine:
         snap = self.metrics.snapshot()
         snap["params_step"] = self._step
         snap["draining"] = self._draining
+        with self._lock:
+            depths = {name: len(q) for name, q in self._queues.items()}
+        snap["queue_depth"] = depths
+        # Flat per-head headroom leaf: the ONE scalar a fleet router
+        # (genrec_tpu/fleet/router.py) ranks replicas by — SLO margin
+        # (tightest per-target margin, 1.0 with no monitor or no
+        # observations yet) minus live queue pressure, normalized by the
+        # replica's in-flight budget. Draining floors it at -1: a dying
+        # replica never looks like capacity. Dict reads + one division
+        # per head — no percentile math on this path.
+        slo_room = self._slo.headroom() if self._slo is not None else {}
+        norm = float(max(4 * self._max_batch, 1))
+        snap["headroom"] = {
+            name: round(
+                min(slo_room.get(name, 1.0) - depths[name] / norm,
+                    -1.0 if self._draining else 1.0),
+                4,
+            )
+            for name in self._heads
+        }
         # Device-memory ledger gauges (per-head operand/executable HBM
         # model + budget headroom) and the SLO shed state ride in every
         # snapshot, so log_serving_stats / write_prometheus expose them
@@ -1343,6 +1370,7 @@ class ServingEngine:
                 compute_s=t_done - t_start,
                 total_s=now - t_enq,
                 request_id=tr[0] if tr is not None else None,
+                replica_id=self.replica_id,
             )
             self.metrics.record_response(
                 resp.queue_wait_s, resp.compute_s, resp.total_s,

@@ -332,6 +332,7 @@ class ServingMetrics:
                 overload_rejected=self.overload_rejected,
             )
             rejected_by_head = dict(sorted(self.rejected_by_head.items()))
+            submitted_by_head = dict(sorted(self.submitted_by_head.items()))
             overload_by_head = dict(sorted(self.overload_by_head.items()))
             oom_deferred_by_head = dict(sorted(self.oom_deferred_by_head.items()))
             kv_pool = {h: dict(g) for h, g in sorted(self.pool_gauges.items())}
@@ -361,6 +362,7 @@ class ServingMetrics:
             "total_ms": self.total.summary(),
             "bucket_hits": bucket_hits,
             "rejected_by_head": rejected_by_head,
+            "submitted_by_head": submitted_by_head,
             "overload_by_head": overload_by_head,
             "oom_deferred_by_head": oom_deferred_by_head,
             "kv_pool": kv_pool,

@@ -83,3 +83,9 @@ class Response:
     # the key into the span tree (obs/spans.py) for this request. None
     # when tracing is off (the default).
     request_id: Optional[str] = None
+    #: Identity of the `ServingEngine` replica that answered, threaded by
+    #: the fleet router (genrec_tpu/fleet/) — provenance beside
+    #: params_step/catalog_version, so offline metric attribution (A/B
+    #: across replicas, post-hoc blame for a degraded replica) is free.
+    #: None on a single-engine deployment with no replica_id configured.
+    replica_id: Optional[str] = None

@@ -1,0 +1,212 @@
+"""Fleet-front check (built on the shared graftlint harness,
+genrec_tpu/analysis/ir.py — CLI, verdict JSON and rc conventions
+unchanged): does the replica router really turn one engine's discipline
+into a fleet's?
+
+One scenario, end to end: a 2-replica `FleetRouter` of paged TIGER
+engines replays a DETERMINISTIC burst trace (seeded Zipfian users,
+diurnal rate, one hard burst — genrec_tpu/fleet/traffic.py) open-loop,
+and one replica is SIGKILL-style killed mid-burst. Asserts:
+
+- **zero steady-state recompiles fleet-wide** — every replica holds the
+  AOT ladder discipline under fleet routing, reroutes included;
+- **nothing lost** — every accepted request completes (rerouted to the
+  survivor where needed) or is visibly typed; the flight recorder
+  narrates the kill (`replica_dead` + `rerouted` events);
+- **all pages released after drain** — the surviving replicas' KV pools
+  (including retained prefix pages) account clean after `stop()`;
+- every constrained answer is a real corpus item, on both sides of the
+  kill.
+
+Run:  python scripts/check_fleet.py             (default shapes)
+      python scripts/check_fleet.py --small     (CI-speed shapes)
+Appends a verdict line to docs/PERF.md when --write-note is passed.
+Prints ONE JSON verdict line on stdout; rc 0 ok / 1 failed.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from genrec_tpu.analysis import ir  # noqa: E402
+
+
+def main(argv=None):
+    args = ir.check_args(argv)
+
+    import jax
+
+    if args.platform:
+        from genrec_tpu.parallel.mesh import pin_platform
+
+        pin_platform(args.platform)
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from genrec_tpu.fleet import (
+        Burst, FleetRouter, TraceConfig, generate_trace, replay,
+    )
+    from genrec_tpu.models.tiger import Tiger
+    from genrec_tpu.obs.flight_recorder import get_flight_recorder
+    from genrec_tpu.serving import BucketLadder, PagedConfig, ServingEngine
+    from genrec_tpu.serving.heads import TigerGenerativeHead
+
+    backend = jax.default_backend()
+    if args.small:
+        n_corpus = 50
+        arch = dict(embedding_dim=16, attn_dim=32, dropout=0.0, num_heads=4,
+                    n_layers=2, num_item_embeddings=8, num_user_embeddings=20,
+                    sem_id_dim=3)
+        ladder = BucketLadder((1, 2), (8,))
+        max_batch = 2
+        n_requests = 28
+        rate = 60.0
+    else:
+        n_corpus = 1000
+        arch = dict(embedding_dim=64, attn_dim=128, dropout=0.0, num_heads=4,
+                    n_layers=4, num_item_embeddings=64,
+                    num_user_embeddings=10_000, sem_id_dim=3)
+        ladder = BucketLadder((1, 4), (8, 16))
+        max_batch = 4
+        n_requests = 64
+        rate = 40.0
+    D = arch["sem_id_dim"]
+    Kcb = arch["num_item_embeddings"]
+    max_hist = ladder.history_buckets[-1]
+
+    model = Tiger(**arch)
+    rng = np.random.default_rng(0)
+    valid_ids = np.unique(rng.integers(0, Kcb, (n_corpus, D)), axis=0)
+    B0, L0 = 2, 2 * D
+    params = model.init(
+        jax.random.key(0),
+        jnp.zeros((B0,), jnp.int32), jnp.zeros((B0, L0), jnp.int32),
+        jnp.zeros((B0, L0), jnp.int32), jnp.zeros((B0, D), jnp.int32),
+        jnp.zeros((B0, D), jnp.int32), jnp.ones((B0, L0), jnp.int32),
+    )["params"]
+
+    n_tok = 1 + max_hist * D
+    cfg = PagedConfig(max_slots=2 * max_batch, page_size=8,
+                      pages_per_slot=-(-n_tok // 8))
+
+    def make_replica(rid):
+        head = TigerGenerativeHead(model, valid_ids, top_k=5)
+        return ServingEngine(
+            [head], params, ladder=ladder, max_batch=max_batch,
+            max_wait_ms=2.0, handle_signals=False, paged_config=cfg,
+            replica_id=rid,
+        )
+
+    fr = get_flight_recorder()
+    deaths_before = len(fr.events("replica_dead"))
+    reroutes_before = len(fr.events("rerouted"))
+
+    router = FleetRouter(make_replica, initial_replicas=2).start()
+    # Deterministic burst trace: the kill hook fires at the burst's
+    # midpoint, so r0 dies with accepted requests in flight.
+    trace_cfg = TraceConfig(
+        n_requests=n_requests, n_users=100_000, max_items=max_hist,
+        corpus_size=len(valid_ids), head="tiger", seed=5,
+        base_rate_qps=rate, diurnal_period_s=4.0, diurnal_amplitude=0.3,
+        bursts=(Burst(0.15, 0.3, 5.0),),
+    )
+    trace = generate_trace(trace_cfg)
+    # Kill at the MIDPOINT ARRIVAL's timestamp, not a wall guess: half
+    # the (deterministic) schedule is still inbound when r0 dies, so the
+    # replica is guaranteed to hold accepted work — queued or mid-decode
+    # — whatever this host's service rate is.
+    t_kill = trace.arrivals[len(trace) // 2].t
+    items_ok = [True]
+    completed = [0]
+    orig_submit = router.submit
+
+    def submit(req):
+        fut = orig_submit(req)
+
+        def check(f):
+            if f.exception() is None:
+                r = f.result()
+                completed[0] += 1
+                items_ok[0] = items_ok[0] and bool(
+                    (np.asarray(r.items) >= 0).all()
+                )
+
+        fut.add_done_callback(check)
+        return fut
+
+    report = replay(
+        trace, submit,
+        chaos=[(t_kill, lambda: router.kill_replica("r0"))],
+        gather_timeout_s=600.0,
+    )
+    final = router.stop()
+
+    deaths = len(fr.events("replica_dead")) - deaths_before
+    reroutes = len(fr.events("rerouted")) - reroutes_before
+    # Surviving replicas drained clean: all pages (incl. retained prefix
+    # pages — drain invalidates the index) released, all slots free.
+    pages_in_use = sum(r["pages_in_use"] for r in final["replicas"].values())
+    slots_active = sum(r["slots_active"] for r in final["replicas"].values())
+
+    verdict = {
+        "backend": backend,
+        "replicas_started": final["replicas_added"],
+        "submitted": report.submitted,
+        "completed": report.completed,
+        "shed": report.shed,
+        "failed": report.failed,
+        "lost": report.lost,
+        "rerouted": final["rerouted"],
+        "replica_deaths": final["replica_deaths"],
+        "kill_narrated": deaths >= 1,
+        "reroutes_narrated": reroutes >= 1,
+        "recompilations": final["recompilations"],
+        "pages_in_use_final": pages_in_use,
+        "slots_active_final": slots_active,
+        "constrained_items_valid": items_ok[0],
+        "p99_under_burst_ms": report.p99_under_burst_ms,
+        "ok": False,
+    }
+    ok = (
+        report.lost == 0
+        and report.failed == 0
+        and report.completed + report.shed == report.submitted
+        and final["recompilations"] == 0
+        and final["rerouted"] >= 1
+        and final["replica_deaths"] == 1
+        and deaths >= 1
+        and reroutes >= 1
+        and items_ok[0]
+        and completed[0] == report.completed
+        and pages_in_use == 0
+        and slots_active == 0
+    )
+    verdict["ok"] = ok
+    ir.emit_verdict(verdict)
+
+    if args.write_note:
+        if ok:
+            msg = (
+                f"OK: {report.submitted} burst-trace requests through a "
+                f"2-replica fleet with a mid-burst SIGKILL — "
+                f"{report.completed} completed ({final['rerouted']} "
+                f"rerouted off the dead replica), {report.shed} typed "
+                "sheds, 0 lost, 0 fleet-wide recompilations, pools clean "
+                "after drain"
+            )
+        else:
+            msg = "ATTENTION: fleet front lost work or recompiled under chaos"
+        ir.append_perf_note(
+            f"\n- Fleet check (scripts/check_fleet.py, backend={backend}): "
+            f"{msg}\n"
+        )
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -13,6 +13,13 @@
 #                            zero recompiles, no catalog-sized constants
 #                            in the optimized HLO, bit-identical sem_ids
 #                            vs the baked-trie reference
+#   check_fleet.py         — fleet front: a 2-replica FleetRouter
+#                            replays a deterministic burst trace with a
+#                            SIGKILL-style replica death mid-burst —
+#                            zero steady-state recompiles fleet-wide,
+#                            every accepted request completes or is
+#                            rerouted (flight-recorder narrative), all
+#                            pages released after drain
 #   check_obs.py           — obs smoke: a traced serve loop yields a
 #                            complete per-request span tree + valid
 #                            Chrome-trace JSON, a traced train loop's
@@ -113,6 +120,15 @@ if [ "$MODE" = "--smoke" ]; then
     if [ -z "${GENREC_CI_SKIP_CATALOG:-}" ]; then
         run python scripts/check_catalog_hlo.py --small --platform cpu
     fi
+    # Fleet-front smoke: 2-replica router replays a deterministic burst
+    # trace with a mid-burst replica kill — zero fleet-wide recompiles,
+    # nothing lost (reroutes narrated), pools clean after drain.
+    # GENREC_CI_SKIP_FLEET=1 skips it for callers whose pytest pass
+    # already runs tests/test_fleet.py directly (same contract as the
+    # knobs above).
+    if [ -z "${GENREC_CI_SKIP_FLEET:-}" ]; then
+        run python scripts/check_fleet.py --small --platform cpu
+    fi
     # Obs smoke (traced serve span tree + goodput schema + overhead
     # budget + memory ledger + SLO shed). GENREC_CI_SKIP_OBS=1 skips it
     # for callers whose pytest pass already runs tests/test_obs.py
@@ -171,6 +187,7 @@ else
     run python scripts/check_packed_hlo.py --write-note
     run python scripts/check_serving_hlo.py --write-note
     run python scripts/check_catalog_hlo.py --write-note
+    run python scripts/check_fleet.py --write-note
     run python scripts/check_obs.py
     run python scripts/graftlint.py
     # Perf regression gate: self-test, then the newest committed
@@ -181,7 +198,7 @@ else
     # slow COBRA trie-constraint pins, and the full paged-parity matrix).
     run_strict env JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py \
         tests/test_trie_constrained.py tests/test_catalog.py \
-        tests/test_kv_pool.py \
+        tests/test_kv_pool.py tests/test_fleet.py \
         tests/test_paged_parity.py -q -p no:cacheprovider 1>&2
     # Full chaos suite: SIGTERM mid-epoch + exact-resume parity for all
     # seven trainers, ladder fallback, NaN injection — plus the 2-process

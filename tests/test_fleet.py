@@ -1,0 +1,431 @@
+"""Fleet front (genrec_tpu/fleet/): replica router, SLO-driven
+autoscaler, deterministic traffic harness.
+
+Engine-backed tests use the small-ladder fixture discipline (one history
+bucket, tiny SASRec retrieval head — 2 executables per replica) so a
+2-replica fleet warms in a couple of seconds and the file stays inside
+the tier-1 budget; the paged/chaos-heavy fleet e2e lives in
+scripts/check_fleet.py.
+"""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from genrec_tpu.fleet import (
+    Autoscaler,
+    AutoscalerConfig,
+    Burst,
+    FleetRouter,
+    ReplicaLostError,
+    TraceConfig,
+    generate_trace,
+    replay,
+)
+from genrec_tpu.fleet.traffic import zipfian_repeat_user_trace
+from genrec_tpu.models.sasrec import SASRec
+from genrec_tpu.obs import prometheus_text
+from genrec_tpu.obs.flight_recorder import get_flight_recorder
+from genrec_tpu.serving import (
+    BucketLadder,
+    OverloadError,
+    Request,
+    ServingEngine,
+    SLOTarget,
+)
+from genrec_tpu.serving.heads import RetrievalHead
+
+N_ITEMS = 30
+
+
+@pytest.fixture(scope="module")
+def sas():
+    model = SASRec(num_items=N_ITEMS, max_seq_len=8, embed_dim=16,
+                   num_heads=2, num_blocks=1, ffn_dim=32, dropout=0.0)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((2, 8), jnp.int32))["params"]
+    return model, params
+
+
+def _factory(sas, slo=None, max_wait_ms=1.0, max_batch=2):
+    model, params = sas
+
+    def make(rid):
+        return ServingEngine(
+            [RetrievalHead("sasrec", model, top_k=5)], params,
+            ladder=BucketLadder((1, max_batch), (8,)), max_batch=max_batch,
+            max_wait_ms=max_wait_ms, handle_signals=False,
+            replica_id=rid, slo_targets=slo,
+        )
+
+    return make
+
+
+def _req(rng, n=5):
+    return Request(head="sasrec", history=rng.integers(1, N_ITEMS + 1, n),
+                   user_id=int(rng.integers(0, 1000)))
+
+
+def _force_shedding(engine, head="sasrec", t=1000.0):
+    """Drive a replica's SLO monitor into SHEDDING directly (fake-clock
+    observations, recover_s chosen huge by the caller's SLOTarget so the
+    engine's own healthy polls cannot un-shed it mid-test)."""
+    engine._slo.observe(head, queue_depth=10**6, now=t)
+    engine._slo.observe(head, queue_depth=10**6, now=t + 60.0)
+    assert engine._slo.is_shedding(head)
+
+
+# ---- traffic harness (no engines, no jax work) ------------------------------
+
+
+def test_trace_same_seed_is_bit_identical():
+    cfg = TraceConfig(
+        n_requests=96, n_users=1_500_000, max_items=8, corpus_size=N_ITEMS,
+        head="sasrec", item_lo=1, seed=7, base_rate_qps=40.0,
+        diurnal_period_s=10.0, diurnal_amplitude=0.5,
+        bursts=(Burst(0.5, 0.4, 6.0),),
+    )
+    a, b = generate_trace(cfg), generate_trace(cfg)
+    # The whole schedule is the determinism surface: times, users,
+    # histories, burst flags — bit-identical, not approximately equal.
+    assert (a.schedule() == b.schedule()).all()
+    for x, y in zip(a.arrivals, b.arrivals):
+        assert x.user_id == y.user_id and x.in_burst == y.in_burst
+        assert (x.history == y.history).all()
+    # Arrival times are a valid open-loop schedule over a millions-wide
+    # id space, and the burst window genuinely concentrated arrivals.
+    t = a.schedule()
+    assert (np.diff(t) > 0).all() and (t > 0).all()
+    assert all(0 <= x.user_id < cfg.n_users for x in a.arrivals)
+    assert all((x.history >= 1).all() and (x.history < N_ITEMS).all()
+               for x in a.arrivals)
+    assert any(x.in_burst for x in a.arrivals)
+    # A different seed is a different schedule.
+    import dataclasses
+
+    c = generate_trace(dataclasses.replace(cfg, seed=8))
+    assert not (c.schedule() == a.schedule()).all()
+
+
+def test_trace_burst_raises_local_rate():
+    base = TraceConfig(n_requests=400, n_users=1000, max_items=6,
+                       corpus_size=N_ITEMS, seed=3, base_rate_qps=50.0,
+                       diurnal_amplitude=0.0,
+                       bursts=(Burst(1.0, 1.0, 8.0),))
+    t = generate_trace(base).schedule()
+    in_burst = ((t >= 1.0) & (t < 2.0)).sum()
+    before = ((t >= 0.0) & (t < 1.0)).sum()
+    # 8x the rate in the burst second vs the plain second before it
+    # (Poisson noise leaves plenty of slack at these counts).
+    assert in_burst > 3 * max(before, 1)
+
+
+def test_zipfian_repeat_user_trace_lives_in_fleet_and_bench_reexports():
+    """PR 11's trace generator moved to fleet/traffic.py; bench.py keeps
+    a delegating re-export so existing callers don't break."""
+    import importlib.util
+    import os
+
+    t1 = zipfian_repeat_user_trace(50, 16, 8, N_ITEMS,
+                                   np.random.default_rng(0))
+    t2 = zipfian_repeat_user_trace(50, 16, 8, N_ITEMS,
+                                   np.random.default_rng(0))
+    assert all(u1 == u2 and (h1 == h2).all()
+               for (u1, h1), (u2, h2) in zip(t1, t2))
+    spec = importlib.util.spec_from_file_location(
+        "bench_for_fleet_test",
+        os.path.join(os.path.dirname(__file__), "..", "bench.py"),
+    )
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    t3 = bench.zipfian_repeat_user_trace(50, 16, 8, N_ITEMS,
+                                         np.random.default_rng(0))
+    assert all(u1 == u3 and (h1 == h3).all()
+               for (u1, h1), (u3, h3) in zip(t1, t3))
+
+
+# ---- router -----------------------------------------------------------------
+
+
+def test_router_serves_with_replica_provenance_and_fleet_stats(sas, rng):
+    router = FleetRouter(_factory(sas), initial_replicas=2).start()
+    try:
+        futs = [router.submit(_req(rng)) for _ in range(10)]
+        resps = [f.result(60) for f in futs]
+        # Response.replica_id provenance: every answer names its replica.
+        assert all(r.replica_id in ("r0", "r1") for r in resps)
+        assert all((r.items >= 1).all() for r in resps)
+        st = router.stats()
+        assert st["routed"] == 10 and st["completed"] == 10
+        assert st["replicas_alive"] == 2 and st["fleet_shed_rejected"] == 0
+        assert st["recompilations"] == 0
+        # Fleet aggregation sums the per-replica per-head counters.
+        assert st["by_head"]["sasrec"]["submitted"] == 10
+        per_rep = sum(r["submitted"] for r in st["replicas"].values())
+        assert per_rep == 10
+        # Replica stats carry the satellite surface the router ranks by:
+        # a flat headroom leaf + queue depths, no nested p99 re-derive.
+        eng = router._replicas["r0"].engine
+        es = eng.stats()
+        assert isinstance(es["headroom"]["sasrec"], float)
+        assert es["queue_depth"]["sasrec"] == 0
+        # genrec_fleet_* exposition: counters typed counter, gauges gauge.
+        text = prometheus_text(st, namespace="genrec_fleet")
+        assert "# TYPE genrec_fleet_routed counter" in text
+        assert "# TYPE genrec_fleet_rerouted counter" in text
+        assert "# TYPE genrec_fleet_by_head_sasrec_submitted counter" in text
+        assert "# TYPE genrec_fleet_replicas_alive gauge" in text
+    finally:
+        router.stop()
+
+
+def test_router_skips_shedding_replica(sas, rng):
+    """A shedding replica is routed AROUND: the healthy replica absorbs
+    every request and nothing surfaces fleet-level."""
+    slo = SLOTarget(max_queue_depth=64, breach_s=0.05, recover_s=3600.0)
+    router = FleetRouter(_factory(sas, slo=slo), initial_replicas=2).start()
+    try:
+        _force_shedding(router._replicas["r0"].engine)
+        futs = [router.submit(_req(rng)) for _ in range(8)]
+        resps = [f.result(60) for f in futs]
+        assert all(r.replica_id == "r1" for r in resps)
+        st = router.stats()
+        assert st["fleet_shed_rejected"] == 0
+        assert st["replicas"]["r0"]["completed"] == 0
+        # Only when EVERY replica sheds does the fleet surface the typed
+        # recoverable error (and counts it).
+        _force_shedding(router._replicas["r1"].engine)
+        with pytest.raises(OverloadError, match="all 2 replicas"):
+            router.submit(_req(rng))
+        assert router.stats()["fleet_shed_rejected"] == 1
+    finally:
+        router.stop()
+
+
+def test_replica_kill_mid_burst_loses_nothing(sas, rng):
+    """SIGKILL-style death with accepted requests in flight: every fleet
+    future still completes (rerouted to the survivor), the flight
+    recorder narrates, and results from the dead replica are discarded
+    rather than double-delivered."""
+    fr = get_flight_recorder()
+    # max_wait_ms=250 w/ max_batch=4: a sub-batch queue waits for the
+    # deadline, so the kill below is guaranteed to land while r0 still
+    # holds un-flushed accepted requests (no race against fast decode).
+    router = FleetRouter(
+        _factory(sas, max_wait_ms=250.0, max_batch=4), initial_replicas=2,
+    ).start()
+    try:
+        futs = [router.submit(_req(rng)) for _ in range(6)]
+        stranded = router.kill_replica("r0")
+        assert stranded >= 1  # both replicas idle at submit: load spread
+        resps = [f.result(60) for f in futs]
+        assert len(resps) == 6
+        assert all(r.replica_id == "r1" for r in resps if r is not None)
+        st = router.stats()
+        assert st["replica_deaths"] == 1 and st["replicas_alive"] == 1
+        assert st["rerouted"] == stranded
+        deaths = fr.events("replica_dead")
+        assert any(e["replica_id"] == "r0" for e in deaths)
+        reroutes = fr.events("rerouted")
+        assert len([e for e in reroutes if e["replica_from"] == "r0"]) \
+            >= stranded
+    finally:
+        router.stop()
+
+
+def test_kill_with_no_survivor_fails_typed_not_silent(sas, rng):
+    """At-most-once + typed surfacing: when the re-submit has nowhere to
+    go, the future fails with ReplicaLostError — never hangs, never
+    silently drops."""
+    router = FleetRouter(
+        _factory(sas, max_wait_ms=250.0, max_batch=4), initial_replicas=1,
+    ).start()
+    try:
+        futs = [router.submit(_req(rng)) for _ in range(3)]
+        assert router.kill_replica("r0") == 3
+        for f in futs:
+            with pytest.raises(ReplicaLostError):
+                f.result(10)
+    finally:
+        router.stop()
+
+
+# ---- autoscaler -------------------------------------------------------------
+
+
+class _FakeRouter:
+    """Scripted scale_signal + recorded actions for fake-clock walks."""
+
+    def __init__(self, n=2):
+        self.n = n
+        self.actions: list[str] = []
+        self.shedding = False
+        self.headroom = 1.0
+
+    def scale_signal(self):
+        return {
+            "replicas": {
+                f"r{i}": {"headroom": self.headroom,
+                          "shedding": self.shedding}
+                for i in range(self.n)
+            },
+            "alive": self.n,
+        }
+
+    def add_replica(self):
+        self.n += 1
+        self.actions.append("out")
+        return f"r{self.n - 1}"
+
+    def remove_replica(self, rid, timeout=60.0):
+        self.n -= 1
+        self.actions.append(f"in:{rid}")
+        return {"completed": 0}
+
+
+def test_autoscaler_hysteresis_walk_fake_clock():
+    r = _FakeRouter(n=2)
+    cfg = AutoscalerConfig(min_replicas=1, max_replicas=3,
+                           scale_out_after_s=2.0, scale_in_after_s=5.0,
+                           scale_in_headroom=0.5, cooldown_s=3.0)
+    asc = Autoscaler(r, cfg)
+    t = 100.0
+    # Healthy fleet: nothing happens.
+    assert asc.tick(t) is None
+    # Breach starts; not sustained yet.
+    r.shedding, r.headroom = True, -0.5
+    assert asc.tick(t + 1.0) is None
+    # A blip back to healthy resets the breach clock (sustained means
+    # CONTINUOUSLY — the obs/slo.py discipline).
+    r.shedding, r.headroom = False, 1.0
+    assert asc.tick(t + 1.5) is None
+    r.shedding, r.headroom = True, -0.5
+    assert asc.tick(t + 2.0) is None
+    assert asc.tick(t + 3.0) is None  # only 1.0s into the NEW breach
+    assert asc.tick(t + 4.1) == "scale_out"
+    assert r.n == 3 and r.actions == ["out"]
+    # Cooldown: still shedding, but no second scale-out yet...
+    assert asc.tick(t + 5.0) is None
+    # ...and at max_replicas the bound binds even after cooldown.
+    assert asc.tick(t + 8.0) is None
+    assert asc.tick(t + 11.0) is None
+    assert r.n == 3
+    # Recovery: headroom must SUSTAIN scale_in_after_s before scale-in.
+    r.shedding, r.headroom = False, 0.9
+    assert asc.tick(t + 12.0) is None
+    assert asc.tick(t + 14.0) is None
+    # Dip below the headroom floor resets the idle clock.
+    r.headroom = 0.2
+    assert asc.tick(t + 15.0) is None
+    r.headroom = 0.9
+    assert asc.tick(t + 16.0) is None
+    assert asc.tick(t + 20.0) is None  # 4.0s into the NEW idle window
+    assert asc.tick(t + 21.5) == "scale_in"
+    assert r.n == 2 and r.actions == ["out", "in:r0"]
+    # Cooldown again, then the min bound: one more scale-in, never past
+    # min_replicas.
+    assert asc.tick(t + 22.0) is None
+    assert asc.tick(t + 30.0) is None
+    assert asc.tick(t + 36.0) == "scale_in"
+    assert r.n == 1
+    assert asc.tick(t + 40.0) is None
+    assert asc.tick(t + 50.0) is None
+    assert r.n == 1  # min_replicas floor held
+    assert asc.stats()["scale_outs"] == 1
+    assert asc.stats()["scale_ins"] == 2
+
+
+def test_scale_in_drains_before_teardown(sas, rng):
+    """Scale-in is the PR 5 graceful drain: requests queued on the
+    victim complete (their fleet futures resolve) before the replica is
+    torn down — capacity reduction never drops accepted work."""
+    fr = get_flight_recorder()
+    router = FleetRouter(
+        _factory(sas, max_wait_ms=200.0, max_batch=4), initial_replicas=2,
+    ).start()
+    asc = Autoscaler(router, AutoscalerConfig(
+        min_replicas=1, max_replicas=2, scale_out_after_s=60.0,
+        scale_in_after_s=0.05, cooldown_s=0.0, scale_in_headroom=0.5,
+    ))
+    try:
+        futs = [router.submit(_req(rng)) for _ in range(6)]
+        # Two idle-ish ticks bracketing the window -> scale-in fires
+        # while some of those requests still wait on flush deadlines.
+        assert asc.tick() is None
+        time.sleep(0.06)
+        action = asc.tick()
+        assert action == "scale_in"
+        resps = [f.result(60) for f in futs]
+        assert len(resps) == 6 and all(r.total_s >= 0 for r in resps)
+        st = router.stats()
+        assert st["replicas_alive"] == 1 and st["replicas_drained"] == 1
+        assert st["rerouted"] == 0  # drained, not stranded: no retries
+        events = fr.events("scale_in")
+        assert events and events[-1]["n_replicas"] == 1
+        drained = fr.events("replica_drained")
+        # The drained replica completed everything it had accepted.
+        assert drained and drained[-1]["completed"] == \
+            sum(1 for r in resps
+                if r.replica_id == drained[-1]["replica_id"])
+    finally:
+        asc.stop()
+        router.stop()
+
+
+# ---- e2e: deterministic burst replay + kill + autoscaler backfill -----------
+
+
+def test_fleet_e2e_kill_mid_burst_autoscaler_backfills(sas, rng):
+    """The acceptance walk on a real (tiny) fleet: a deterministic
+    bursty trace replays open-loop, a replica is SIGKILLed mid-burst,
+    the router reroutes every stranded accepted request (zero lost), and
+    the autoscaler backfills the fleet within its hysteresis window —
+    the flight recorder narrating each step."""
+    fr = get_flight_recorder()
+    router = FleetRouter(
+        _factory(sas, max_wait_ms=4.0, max_batch=2), initial_replicas=2,
+    ).start()
+    asc = Autoscaler(router, AutoscalerConfig(
+        min_replicas=2, max_replicas=3, scale_out_after_s=0.05,
+        scale_in_after_s=3600.0, cooldown_s=0.5, poll_secs=0.05,
+    )).start()
+    cfg = TraceConfig(
+        n_requests=48, n_users=100_000, max_items=8, corpus_size=N_ITEMS,
+        head="sasrec", item_lo=1, seed=11, base_rate_qps=60.0,
+        diurnal_period_s=4.0, diurnal_amplitude=0.3,
+        bursts=(Burst(0.25, 0.5, 4.0),),
+    )
+    trace = generate_trace(cfg)
+    try:
+        report = replay(
+            trace, router.submit,
+            chaos=[(0.3, lambda: router.kill_replica("r0"))],
+        )
+        # Zero accepted requests lost: everything either completed
+        # (possibly after a reroute) or was visibly typed.
+        assert report.lost == 0
+        assert report.submitted == len(trace)
+        assert report.completed + report.shed + report.rejected \
+            + report.failed == report.submitted
+        assert report.completed > 0 and report.rejected == 0
+        assert report.failed == 0  # survivors absorbed every reroute
+        # The kill genuinely happened mid-trace...
+        assert any(e["replica_id"] == "r0"
+                   for e in fr.events("replica_dead"))
+        # ...and the autoscaler backfilled to min_replicas within its
+        # window (scale_out flight event carries the measured warmup).
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            if router.stats()["replicas_alive"] >= 2:
+                break
+            time.sleep(0.05)
+        assert router.stats()["replicas_alive"] >= 2
+        outs = fr.events("scale_out")
+        assert outs and outs[-1]["warmup_s"] > 0
+    finally:
+        asc.stop()
+        router.stop()

@@ -359,10 +359,12 @@ def test_prefix_gauges_flow_to_prometheus():
 
 
 def test_zipfian_repeat_user_trace_is_deterministic_and_warm_heavy():
-    """The bench's trace generator: seeded determinism (thread-safe by
-    construction — fully materialized before any driver thread runs) and
-    a genuinely repeat-heavy shape (verbatim repeats dominate)."""
-    from bench import zipfian_repeat_user_trace
+    """The bench's trace generator (canonical home since PR 12:
+    genrec_tpu/fleet/traffic.py, re-exported by bench): seeded
+    determinism (thread-safe by construction — fully materialized before
+    any driver thread runs) and a genuinely repeat-heavy shape (verbatim
+    repeats dominate)."""
+    from genrec_tpu.fleet.traffic import zipfian_repeat_user_trace
 
     t1 = zipfian_repeat_user_trace(200, 32, 20, 100,
                                    np.random.default_rng(5))
