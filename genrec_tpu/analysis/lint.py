@@ -24,7 +24,8 @@ Three rule families:
   trace-time value into the executable (recompile ladder / frozen
   clock) or forces a trace-time concretization error at best.
 
-- **lock_held_blocking** — in the threaded layers (serving/, obs/): no
+- **lock_held_blocking** — in the threaded layers (serving/, obs/,
+  disagg/): no
   ``Future.result``, ``<queue>.get`` without timeout, ``time.sleep``,
   thread ``join``, or device sync (``block_until_ready`` /
   ``device_get``) while a ``threading.Lock``/``RLock`` is held. The
@@ -383,7 +384,7 @@ def check_trace_purity(relpath: str, tree: ast.AST) -> list[Finding]:
 
 #: Directories (package names) the lock rule applies to — the layers
 #: with batcher/watcher/tracer thread pools.
-LOCKED_PACKAGES = ("serving", "obs")
+LOCKED_PACKAGES = ("serving", "obs", "disagg")
 
 _LOCKISH = re.compile(r"lock", re.I)
 _QUEUEISH = re.compile(r"(^|_)(q|queue|queues|inbox|inq|outq)$", re.I)

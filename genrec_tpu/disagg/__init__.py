@@ -1,0 +1,67 @@
+"""Disaggregated serving: prefill/decode worker pools with typed
+KV-page handoff (docs/architecture.md L7 beside fleet, docs/SERVING.md
+"Disaggregated serving").
+
+COBRA's history prefill and its suffix-step decode have completely
+different arithmetic-intensity profiles (TPLA, arxiv 2508.15881); this
+package splits them into role-specialized pools joined by a typed,
+self-describing `KVHandoff` — the refcounted page run + post-prefill
+slot-state snapshot the PR-11 prefix cache already retains, at the same
+page granularity Ragged Paged Attention made the pool's native unit:
+
+- `handoff` — `KVHandoff` + the pinned wire format; validation skew is
+  a typed `HandoffRefusedError`, worker loss a typed `WorkerLostError`.
+- `transport` — `KVTransport` with an in-process zero-copy
+  implementation (shared page bank, COW `admit_shared` across pools)
+  and a serializing host-roundtrip implementation that pins the wire
+  bytes and measures transfer cost — the cross-host backend is a
+  transport swap, not a redesign.
+- `workers` — `PrefillWorker` (admission + the AOT prefill bucket grid
+  + per-worker prefix cache) and `DecodeWorker` (slot-level continuous
+  batching over decode-only executables, per-worker `MemoryLedger`
+  budget enforced at warmup).
+- `front` — `DisaggFront`: the engine's exact `submit() -> Future`
+  surface, request -> prefill pool -> decode pool routing, at-most-once
+  typed re-submit on worker death, drain that completes in-flight
+  handoffs, and `role_pool()` adapters so `fleet.Autoscaler` scales the
+  two roles independently (prefill on queue depth, decode on slot
+  occupancy).
+
+The co-located `ServingEngine` stays the default; disagg is opt-in per
+head. Layering: disagg imports serving/obs (and core for the signal
+guard); nothing imports disagg.
+"""
+
+from genrec_tpu.disagg.front import DisaggFront
+from genrec_tpu.disagg.handoff import (
+    DisaggError,
+    HandoffRefusedError,
+    KVHandoff,
+    WIRE_VERSION,
+    WorkerLostError,
+    pack_handoff,
+    unpack_handoff,
+)
+from genrec_tpu.disagg.transport import (
+    InProcessTransport,
+    KVTransport,
+    SerializingTransport,
+)
+from genrec_tpu.disagg.workers import DecodeWorker, Flight, PrefillWorker
+
+__all__ = [
+    "DecodeWorker",
+    "DisaggError",
+    "DisaggFront",
+    "Flight",
+    "HandoffRefusedError",
+    "InProcessTransport",
+    "KVHandoff",
+    "KVTransport",
+    "PrefillWorker",
+    "SerializingTransport",
+    "WIRE_VERSION",
+    "WorkerLostError",
+    "pack_handoff",
+    "unpack_handoff",
+]

@@ -1,0 +1,939 @@
+"""DisaggFront: the engine's `submit() -> Future` surface over
+role-specialized prefill/decode worker pools.
+
+Request path: `submit` routes to the least-loaded *prefill worker* of
+the request's head (prefill saturates on queue depth); the completed
+prefill emits a typed `KVHandoff` which the front routes to the decode
+worker with the most free slots (decode saturates on slot occupancy);
+the decode worker's continuous-batching loop resolves the caller's
+future with full provenance (`Response.prefill_worker_id` /
+`decode_worker_id` beside replica/params/catalog).
+
+The co-located `ServingEngine` stays the default; disagg is opt-in per
+head — a `DisaggFront` serves paged-capable heads only, and a deployment
+mixes fronts and engines per head. The front duck-types the engine
+surface (`start/stop/submit/stats()["headroom"]/metrics.warmup_compiles`
+/`replica_id`), so a `fleet.FleetRouter` can route over N disagg fronts
+exactly as it routes over N engines, while `fleet.Autoscaler` instances
+scale the two roles INDEPENDENTLY through `role_pool(head, role)` —
+each role pool speaks the router protocol the autoscaler drives
+(`scale_signal`/`add_replica`/`remove_replica`).
+
+Failure discipline (the fleet front's, one level down): a decode
+worker's SIGKILL-style death strands the flights whose KV died with it —
+each is re-submitted typed and AT MOST ONCE back through a surviving
+prefill/decode pair (the KV must be re-encoded; a surviving prefill
+worker's prefix cache usually makes that re-encode warm), and a second
+loss fails `WorkerLostError`, never silence. Handoff validation failures
+are typed `HandoffRefusedError` refusals. Drain completes in-flight
+handoffs: queued requests prefill, pending handoffs land, decode slots
+finish, and the pools on BOTH sides account clean.
+
+Execution model: one runtime thread cooperatively schedules every
+worker (prefill pass -> handoff delivery -> one decode step per worker)
+— the engine's single-writer pool discipline held across the split, so
+the in-process front is a CONTROL-PLANE of the disaggregated system;
+compute overlap between roles arrives with the cross-host transport,
+which slots in behind `KVTransport` without touching this file.
+``start(run_loop=False)`` + `pump_once()` exposes the same scheduling
+deterministically for tests and the chaos harness.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+import time
+from concurrent.futures import Future
+from typing import Optional, Sequence
+
+from genrec_tpu.disagg.handoff import (
+    HandoffRefusedError,
+    WorkerLostError,
+)
+from genrec_tpu.disagg.transport import (
+    InProcessTransport,
+    KVTransport,
+    SerializingTransport,
+)
+from genrec_tpu.disagg.workers import DecodeWorker, Flight, PrefillWorker
+from genrec_tpu.obs.flight_recorder import get_flight_recorder
+from genrec_tpu.obs.slo import SLOMonitor, SLOTarget
+from genrec_tpu.serving.buckets import BucketLadder, default_ladder
+from genrec_tpu.serving.kv_pool import KVPagePool, PagedConfig
+from genrec_tpu.serving.metrics import LatencyHistogram, ServingMetrics
+from genrec_tpu.serving.types import (
+    DrainingError,
+    OverloadError,
+    Request,
+    UnknownHeadError,
+)
+
+
+class _HeadGroup:
+    """One head's role pools + in-flight handoffs."""
+
+    __slots__ = ("head", "bank", "transport", "prefill", "decode",
+                 "pending", "seq")
+
+    def __init__(self, head, bank, transport):
+        self.head = head
+        self.bank: Optional[KVPagePool] = bank
+        self.transport: KVTransport = transport
+        self.prefill: list[PrefillWorker] = []
+        self.decode: list[DecodeWorker] = []
+        # (flight, handoff, t_sent): sent but not yet admitted — routed
+        # to a concrete decode worker only when one has a free slot, so
+        # a kill in between strands nothing that is still re-routable.
+        self.pending: collections.deque = collections.deque()
+        self.seq = {"prefill": 0, "decode": 0}
+
+
+class _RolePool:
+    """`fleet.Autoscaler`-compatible view of one (head, role) pool:
+    scale_signal/add_replica/remove_replica over WORKERS instead of
+    engine replicas — the two roles scale independently, each on its own
+    saturation signal."""
+
+    def __init__(self, front: "DisaggFront", head: str, role: str):
+        self._front = front
+        self.head = head
+        self.role = role
+
+    def scale_signal(self) -> dict:
+        return self._front._role_signal(self.head, self.role)
+
+    def add_replica(self) -> str:
+        return self._front._add_worker(self.head, self.role)
+
+    def remove_replica(self, worker_id: str, timeout: float = 60.0) -> dict:
+        return self._front._remove_worker(self.head, self.role, worker_id,
+                                          timeout)
+
+
+class DisaggFront:
+    def __init__(
+        self,
+        heads: Sequence,
+        params,
+        *,
+        ladder: Optional[BucketLadder] = None,
+        max_batch: int = 8,
+        max_wait_ms: float = 4.0,
+        n_prefill: int = 1,
+        n_decode: int = 1,
+        transport: str = "inprocess",
+        paged_config: Optional[PagedConfig] = None,
+        bank_num_pages: Optional[int] = None,
+        prefix_cache: bool = True,
+        prefix_cache_entries: int = 4096,
+        prefill_hbm_budget_bytes: Optional[int] = None,
+        decode_hbm_budget_bytes: Optional[int] = None,
+        slo_targets: Optional[dict] = None,
+        slo_poll_secs: float = 0.05,
+        params_step: Optional[int] = None,
+        params_by_head: Optional[bool] = None,
+        replica_id: Optional[str] = None,
+        handle_signals: bool = False,
+        guard=None,
+        logger: Optional[logging.Logger] = None,
+    ):
+        self._heads = {h.name: h for h in heads}
+        if len(self._heads) != len(heads):
+            raise ValueError("duplicate head names")
+        for h in heads:
+            if not getattr(h, "supports_paged", False):
+                raise ValueError(
+                    f"head {h.name!r} has no paged decode protocol — "
+                    "disagg is opt-in per head; serve it on the "
+                    "co-located ServingEngine instead"
+                )
+        self._params = params
+        self._params_by_head = (
+            params_by_head if params_by_head is not None
+            else len(self._heads) > 1
+        )
+        if self._params_by_head:
+            missing = [n for n in self._heads if n not in params]
+            if missing:
+                raise ValueError(f"params missing head subtrees: {missing}")
+        self._step = params_step
+        self._ladder = ladder or default_ladder(max_batch=max_batch)
+        self._max_batch = max_batch
+        self._max_wait_s = max_wait_ms / 1e3
+        if n_prefill < 1 or n_decode < 1:
+            raise ValueError("need at least one worker per role")
+        self._n_prefill = n_prefill
+        self._n_decode = n_decode
+        if transport not in ("inprocess", "serializing"):
+            raise ValueError(
+                f"unknown transport {transport!r}: "
+                "'inprocess' (zero-copy shared page bank) or "
+                "'serializing' (host-roundtrip wire)"
+            )
+        self._transport_kind = transport
+        self._paged_config = paged_config
+        self._bank_num_pages = bank_num_pages
+        self._prefix_cache = bool(prefix_cache)
+        self._prefix_cache_entries = int(prefix_cache_entries)
+        self._prefill_budget = prefill_hbm_budget_bytes
+        self._decode_budget = decode_hbm_budget_bytes
+        self.replica_id = replica_id
+        self._handle_signals = handle_signals
+        self._guard = guard
+        self._log = logger or logging.getLogger("genrec_tpu")
+        self._flight = get_flight_recorder()
+        self.metrics = ServingMetrics()
+        # Role-level SLO guard: {"prefill": SLOTarget, "decode":
+        # SLOTarget} applied per head; the monitor keys on
+        # "<head>/<role>" and submit sheds when EITHER role of the
+        # request's head is shedding (a saturated decode pool must push
+        # back at admission, not queue unboundedly at prefill).
+        if slo_targets is None:
+            self._slo = None
+        else:
+            unknown = [r for r in slo_targets if r not in ("prefill",
+                                                           "decode")]
+            if unknown:
+                raise ValueError(
+                    f"slo_targets keys must be roles, got {unknown}")
+            targets = {
+                f"{name}/{role}": t
+                for name in self._heads
+                for role, t in slo_targets.items()
+                if isinstance(t, SLOTarget)
+            }
+            self._slo = SLOMonitor(targets, flight=self._flight)
+        self._slo_poll_secs = float(slo_poll_secs)
+        self._slo_next_poll = 0.0
+        self._groups: dict[str, _HeadGroup] = {}
+        # Queue lock + wake condition (submit threads <-> runtime
+        # thread) and the coarse runtime lock serializing pump
+        # iterations with operator verbs (kill/add/remove).
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self._runtime = threading.RLock()
+        self._counters = {
+            "handoffs_sent": 0,
+            "handoffs_admitted": 0,
+            "handoffs_refused": 0,
+            "handoffs_resubmitted": 0,
+            "transfer_bytes": 0,
+            "decode_worker_deaths": 0,
+            "prefill_worker_deaths": 0,
+        }
+        self.transfer = LatencyHistogram()
+        self._draining = False
+        self._drained = threading.Event()
+        self._batcher: Optional[threading.Thread] = None
+        self._started = False
+
+    # -- construction helpers ------------------------------------------------
+
+    def _select(self, head, params):
+        return params[head.name] if self._params_by_head else params
+
+    def _default_config(self, head) -> PagedConfig:
+        page_size = 16
+        max_kv = head.paged_kv_tokens(10**9, self._ladder.history_buckets[-1])
+        return PagedConfig(
+            max_slots=4 * self._max_batch,
+            page_size=page_size,
+            pages_per_slot=-(-max_kv // page_size),
+        )
+
+    def _build_group(self, head) -> _HeadGroup:
+        cfg = self._paged_config or self._default_config(head)
+        max_kv = head.paged_kv_tokens(10**9, self._ladder.history_buckets[-1])
+        if cfg.max_kv_tokens < max_kv:
+            raise ValueError(
+                f"paged config holds {cfg.max_kv_tokens} KV tokens/slot "
+                f"but head {head.name!r} needs {max_kv} at the largest "
+                "history bucket"
+            )
+        n_layers, n_heads, head_dim, dtype = head.paged_layout()
+        if self._transport_kind == "inprocess":
+            # One shared page bank per head: decode workers are slot
+            # VIEWS over it, prefill writes raw runs into it — the
+            # zero-copy handoff substrate. Sized for every decode slot
+            # plus in-flight prefill staging (retained prefix pages ride
+            # inside and reclaim under pressure).
+            bank_pages = self._bank_num_pages or (
+                1 + cfg.pages_per_slot
+                * (self._n_decode * cfg.max_slots + 2 * self._max_batch)
+            )
+            bank_cfg = PagedConfig(
+                max_slots=1, page_size=cfg.page_size,
+                pages_per_slot=cfg.pages_per_slot, num_pages=bank_pages,
+            )
+            bank = KVPagePool(bank_cfg, n_layers, n_heads, head_dim, dtype)
+            return _HeadGroup(head, bank, InProcessTransport(bank))
+        return _HeadGroup(head, None, SerializingTransport())
+
+    def _make_prefill(self, group: _HeadGroup) -> PrefillWorker:
+        head = group.head
+        wid = f"{head.name}:p{group.seq['prefill']}"
+        group.seq["prefill"] += 1
+        cfg = self._paged_config or self._default_config(head)
+        if group.bank is not None:
+            pool, owns = group.bank, False
+        else:
+            n_layers, n_heads, head_dim, dtype = head.paged_layout()
+            staging_cfg = PagedConfig(
+                max_slots=1, page_size=cfg.page_size,
+                pages_per_slot=cfg.pages_per_slot,
+                num_pages=1 + cfg.pages_per_slot * 3 * self._max_batch,
+            )
+            pool = KVPagePool(staging_cfg, n_layers, n_heads, head_dim, dtype)
+            owns = True
+        return PrefillWorker(
+            wid, head, self._select(head, self._params),
+            ladder=self._ladder, transport=group.transport, pool=pool,
+            owns_pool=owns, max_batch=self._max_batch,
+            max_wait_s=self._max_wait_s, metrics=self.metrics,
+            flight_recorder=self._flight, params_step=self._step,
+            prefix_cache=self._prefix_cache,
+            prefix_cache_entries=self._prefix_cache_entries,
+            hbm_budget_bytes=self._prefill_budget, logger=self._log,
+        )
+
+    def _make_decode(self, group: _HeadGroup) -> DecodeWorker:
+        head = group.head
+        wid = f"{head.name}:d{group.seq['decode']}"
+        group.seq["decode"] += 1
+        cfg = self._paged_config or self._default_config(head)
+        n_layers, n_heads, head_dim, dtype = head.paged_layout()
+        if group.bank is not None:
+            view_cfg = PagedConfig(
+                max_slots=cfg.max_slots, page_size=cfg.page_size,
+                pages_per_slot=cfg.pages_per_slot,
+                num_pages=group.bank.cfg.num_pages,
+            )
+            pool = KVPagePool(view_cfg, n_layers, n_heads, head_dim, dtype,
+                              bank=group.bank)
+            owns = False
+        else:
+            pool = KVPagePool(cfg, n_layers, n_heads, head_dim, dtype)
+            owns = True
+        return DecodeWorker(
+            wid, head, self._select(head, self._params),
+            transport=group.transport, pool=pool, owns_pool=owns,
+            ladder=self._ladder, metrics=self.metrics,
+            flight_recorder=self._flight,
+            slot_floor=min(self._max_batch, cfg.max_slots),
+            params_step=self._step, replica_id=self.replica_id,
+            hbm_budget_bytes=self._decode_budget, logger=self._log,
+        )
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self, run_loop: bool = True) -> "DisaggFront":
+        if self._started:
+            raise RuntimeError("front already started")
+        for head in self._heads.values():
+            head.on_params(self._select(head, self._params))
+        t0 = time.monotonic()
+        for head in self._heads.values():
+            group = self._build_group(head)
+            for _ in range(self._n_prefill):
+                group.prefill.append(self._make_prefill(group))
+            for _ in range(self._n_decode):
+                group.decode.append(self._make_decode(group))
+            self._groups[head.name] = group
+        workers = [w for g in self._groups.values()
+                   for w in g.prefill + g.decode]
+        for w in workers:
+            # Operands-only budget pass over EVERY worker first: an
+            # impossible budget on any role refuses before the front
+            # pays a single compile (prefill warms before decode below,
+            # so warmup()'s own early check alone would not cover a
+            # decode-side refusal).
+            w._ledger(operands_only=True)
+        for w in workers:
+            w.warmup()  # HBMBudgetError refusal propagates
+        self.metrics.warmup_compiles = sum(
+            w.warmup_compiles
+            for g in self._groups.values() for w in g.prefill + g.decode
+        )
+        self.metrics.mark_warm()
+        if self._guard is None and self._handle_signals:
+            from genrec_tpu.core.preemption import PreemptionGuard
+
+            self._guard = PreemptionGuard(self._log)
+        self._started = True
+        self._flight.record(
+            "disagg_started", heads=sorted(self._heads),
+            transport=self._transport_kind,
+            prefill_workers=self._n_prefill * len(self._heads),
+            decode_workers=self._n_decode * len(self._heads),
+            warmup_compiles=self.metrics.warmup_compiles,
+            replica_id=self.replica_id,
+        )
+        self._log.info(
+            f"disagg: front up ({self._transport_kind} transport, "
+            f"{self._n_prefill} prefill + {self._n_decode} decode "
+            f"workers/head, {self.metrics.warmup_compiles} warmup "
+            f"executables in {time.monotonic() - t0:.1f}s)"
+        )
+        if run_loop:
+            self._batcher = threading.Thread(
+                target=self._run_loop, name="disagg-runtime", daemon=True
+            )
+            self._batcher.start()
+        return self
+
+    def stop(self, timeout: float = 60.0) -> dict:
+        """Drain: queued requests prefill, in-flight handoffs land,
+        decode slots finish; new submissions get the typed error.
+        Idempotent; returns the final stats snapshot."""
+        with self._lock:
+            self._draining = True
+            self._work.notify_all()
+        if self._batcher is not None:
+            self._batcher.join(timeout)
+        else:
+            # Loop-less front (run_loop=False): pump the drain here.
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                progressed = self.pump_once()
+                if self._check_drained():
+                    break
+                if not progressed:
+                    time.sleep(1e-3)
+            if not self._drained.is_set():
+                self._finish_drain()
+        if self._guard is not None:
+            self._guard.close()
+        return self.stats()
+
+    def join(self, timeout: Optional[float] = None) -> bool:
+        return self._drained.wait(timeout)
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    @property
+    def params_step(self) -> Optional[int]:
+        return self._step
+
+    # -- request path --------------------------------------------------------
+
+    def submit(self, req: Request) -> Future:
+        head = self._heads.get(req.head)
+        if head is None:
+            raise UnknownHeadError(
+                f"unknown head {req.head!r}; have {sorted(self._heads)}"
+            )
+        head.validate(req)
+        flight = Flight(req)
+        with self._lock:
+            if self._draining:
+                self.metrics.record_reject(req.head)
+                raise DrainingError(
+                    "disagg front is draining; request rejected — fail "
+                    "over to another replica"
+                )
+            if self._slo is not None and (
+                self._slo.is_shedding(f"{req.head}/prefill")
+                or self._slo.is_shedding(f"{req.head}/decode")
+            ):
+                self.metrics.record_overload(req.head)
+                raise OverloadError(
+                    f"head {req.head!r} disagg pools are load-shedding; "
+                    "back off and retry or fail over"
+                )
+            try:
+                self._enqueue_locked(flight)
+            except WorkerLostError as e:
+                # Zero live prefill workers: to a FLEET caller this
+                # replica is saturated-unusable, not broken — raise the
+                # recoverable error FleetRouter fails over on
+                # (WorkerLostError would propagate through the router as
+                # a caller bug and skip the surviving replicas).
+                self.metrics.record_overload(req.head)
+                raise OverloadError(
+                    f"head {req.head!r} has no live prefill workers on "
+                    f"this front; fail over ({e})"
+                ) from e
+            self._work.notify()
+        self.metrics.record_submit(head=req.head)
+        return flight.fut
+
+    def serve(self, req: Request, timeout: Optional[float] = 60.0):
+        return self.submit(req).result(timeout)
+
+    def _enqueue_locked(self, flight: Flight) -> None:
+        """Route to the prefill worker with the shallowest queue (the
+        prefill pool's saturation signal IS queue depth). Caller holds
+        the queue lock."""
+        group = self._groups[flight.req.head]
+        live = [w for w in group.prefill if not w.dead and not w.draining]
+        if not live:
+            raise WorkerLostError(
+                f"no live prefill workers for head {flight.req.head!r}"
+            )
+        min(live, key=lambda w: (len(w.queue), w.worker_id)).queue.append(
+            flight
+        )
+
+    # -- the runtime loop ----------------------------------------------------
+
+    def _run_loop(self) -> None:
+        try:
+            while True:
+                try:
+                    if (
+                        self._guard is not None
+                        and self._guard.fired
+                        and not self._draining
+                    ):
+                        with self._lock:
+                            self._draining = True
+                        self._flight.record("disagg_drain_started",
+                                            cause="signal")
+                    progressed = self.pump_once()
+                    if self._draining and self._check_drained():
+                        break
+                    if progressed:
+                        continue
+                    with self._lock:
+                        busy = any(
+                            w.queue for g in self._groups.values()
+                            for w in g.prefill
+                        ) or any(g.pending for g in self._groups.values())
+                        self._work.wait(
+                            timeout=max(self._max_wait_s / 4, 1e-3)
+                            if busy else 0.05
+                        )
+                except Exception:  # noqa: BLE001 — the loop must survive
+                    self._log.exception("disagg: runtime iteration failed")
+        finally:
+            self._finish_drain()
+
+    def pump_once(self) -> bool:
+        """One cooperative scheduling pass over every worker: prefill
+        admission -> handoff delivery -> one decode step per worker.
+        Deterministically callable when started with run_loop=False (the
+        chaos tests single-step the pipeline through it)."""
+        progressed = False
+        with self._runtime:
+            for group in self._groups.values():
+                for pw in list(group.prefill):
+                    if pw.dead:
+                        continue
+                    for fl, handoff in pw.pump(self._lock, self._draining):
+                        self._counters["handoffs_sent"] += 1
+                        self._flight.record(
+                            "handoff_sent", head=group.head.name,
+                            prefill_worker=handoff.prefill_worker_id,
+                            n_tokens=handoff.n_tokens, warm=handoff.warm,
+                            transfer_bytes=handoff.transfer_bytes,
+                        )
+                        group.pending.append((fl, handoff, time.monotonic()))
+                        progressed = True
+                progressed |= self._deliver(group)
+                for dw in list(group.decode):
+                    if dw.dead:
+                        continue
+                    progressed |= dw.step()
+            self._poll_slo()
+        return progressed
+
+    def _deliver(self, group: _HeadGroup) -> bool:
+        """Route pending handoffs onto decode workers with free slots
+        (most-free-first — the decode pool's saturation signal is slot
+        occupancy). A handoff with no admissible worker NOW stays
+        pending; zero live decode workers is a typed failure."""
+        progressed = False
+        while group.pending:
+            live = [w for w in group.decode
+                    if not w.dead and not w.draining]
+            if not live:
+                fl, handoff, _t = group.pending.popleft()
+                group.transport.release(handoff)
+                if not fl.fut.done():
+                    fl.fut.set_exception(WorkerLostError(
+                        f"no live decode workers for head "
+                        f"{group.head.name!r}; handoff dropped typed"
+                    ))
+                    self.metrics.record_failure(1)
+                progressed = True
+                continue
+            target = max(live, key=lambda w: (w.free_slots, w.worker_id))
+            if target.free_slots == 0:
+                break  # every live worker full: deliver after evictions
+            fl, handoff, t_sent = group.pending.popleft()
+            if fl.fut.done():  # caller cancelled while in flight
+                group.transport.release(handoff)
+                continue
+            tb = handoff.transfer_bytes
+            try:
+                target.validate(handoff)
+                admitted = target.admit(fl, handoff)
+            except Exception as e:  # noqa: BLE001 — any admit failure
+                # Typed refusals AND unexpected admit errors take the
+                # same exit: the flight was already popped from pending,
+                # so anything escaping here would strand its future
+                # unresolved (the caller hangs to its own timeout).
+                if not isinstance(e, HandoffRefusedError):
+                    self._log.exception(
+                        f"disagg: handoff admit failed on "
+                        f"{target.worker_id}"
+                    )
+                group.transport.release(handoff)
+                self._counters["handoffs_refused"] += 1
+                self._flight.record(
+                    "handoff_refused", head=group.head.name,
+                    prefill_worker=handoff.prefill_worker_id,
+                    decode_worker=target.worker_id, reason=str(e),
+                )
+                if not fl.fut.done():
+                    fl.fut.set_exception(e)
+                self.metrics.record_failure(1)
+                progressed = True
+                continue
+            if not admitted:
+                group.pending.appendleft((fl, handoff, t_sent))
+                break
+            self._counters["handoffs_admitted"] += 1
+            self._counters["transfer_bytes"] += tb
+            self.transfer.record(time.monotonic() - t_sent)
+            self._flight.record(
+                "handoff_admitted", head=group.head.name,
+                prefill_worker=handoff.prefill_worker_id,
+                decode_worker=target.worker_id,
+                n_tokens=handoff.n_tokens, warm=handoff.warm,
+                transfer_bytes=tb,
+            )
+            progressed = True
+        return progressed
+
+    def _check_drained(self) -> bool:
+        with self._lock:
+            queues_empty = all(
+                not w.queue for g in self._groups.values()
+                for w in g.prefill
+            )
+        return (
+            queues_empty
+            and all(not g.pending for g in self._groups.values())
+            and all(dw.idle for g in self._groups.values()
+                    for dw in g.decode if not dw.dead)
+        )
+
+    def _finish_drain(self) -> None:
+        # Release every retained prefix page so the banks/pools account
+        # clean at shutdown (pages released after drain — the
+        # check_disagg bar, both sides).
+        with self._runtime:
+            for group in self._groups.values():
+                for pw in group.prefill:
+                    pw.clear_prefix_cache("drain")
+        self._flight.record("disagg_stopped",
+                            completed=self.metrics.completed)
+        self._drained.set()
+
+    # -- SLO guard -----------------------------------------------------------
+
+    def _poll_slo(self) -> None:
+        if self._slo is None:
+            return
+        now = time.monotonic()
+        if now < self._slo_next_poll:
+            return
+        self._slo_next_poll = now + self._slo_poll_secs
+        for name, group in self._groups.items():
+            with self._lock:
+                qdepth = sum(len(w.queue) for w in group.prefill)
+            for role, depth, p99, deferred in (
+                # Deferral is an ADMISSION-side phenomenon: feed the
+                # per-head oom/submit counters to the prefill target so
+                # SLOTarget.max_deferral_rate sheds a page-thrashing
+                # pool (the engine's _poll_slo wiring, per role).
+                ("prefill", qdepth, None,
+                 self.metrics.oom_deferred_by_head[name]),
+                ("decode", len(group.pending),
+                 self.metrics.recent_p99_ms(
+                     self._slo.targets.get(
+                         f"{name}/decode",
+                         SLOTarget(max_queue_depth=1)).window_s,
+                     head=name)
+                 if f"{name}/decode" in self._slo.targets else None, None),
+            ):
+                key = f"{name}/{role}"
+                if key in self._slo.targets:
+                    self._slo.observe(
+                        key, p99_ms=p99, queue_depth=depth,
+                        oom_deferred_total=deferred,
+                        submitted_total=(
+                            self.metrics.submitted_by_head[name]
+                            if deferred is not None else None),
+                        now=now)
+
+    # -- failure injection / role scaling ------------------------------------
+
+    def kill_decode_worker(self, worker_id: str) -> int:
+        """SIGKILL-style decode-worker death: its resident KV is gone,
+        every flight it held is re-submitted typed + at-most-once back
+        through the prefill path on the survivors. Returns the stranded
+        count."""
+        with self._runtime:
+            group, worker = self._find(worker_id, "decode")
+            group.decode.remove(worker)
+            stranded = worker.kill()
+            group.transport.forget(worker.pool)
+            self._counters["decode_worker_deaths"] += 1
+            self._flight.record(
+                "disagg_worker_dead", worker=worker_id, role="decode",
+                head=group.head.name, stranded=len(stranded),
+                survivors=len(group.decode),
+            )
+            self._log.warning(
+                f"disagg: decode worker {worker_id} died with "
+                f"{len(stranded)} requests resident — re-submitting "
+                f"through {len(group.decode)} survivors"
+            )
+            for fl in stranded:
+                self._resubmit(group, fl, from_worker=worker_id)
+        with self._lock:
+            self._work.notify()
+        return len(stranded)
+
+    def kill_prefill_worker(self, worker_id: str) -> int:
+        """Prefill-worker death: nothing decoded is lost (its queue
+        holds un-prefilled requests), but its retained prefix pages and
+        queue die with it — queued flights re-route to surviving prefill
+        workers (no retry spent: no accepted work was lost)."""
+        with self._runtime:
+            group, worker = self._find(worker_id, "prefill")
+            group.prefill.remove(worker)
+            worker.dead = True
+            worker.clear_prefix_cache("worker_killed")
+            group.transport.forget(worker.pool)
+            with self._lock:
+                stranded = list(worker.queue)
+                worker.queue.clear()
+            self._counters["prefill_worker_deaths"] += 1
+            self._flight.record(
+                "disagg_worker_dead", worker=worker_id, role="prefill",
+                head=group.head.name, stranded=len(stranded),
+                survivors=len(group.prefill),
+            )
+            for fl in stranded:
+                try:
+                    with self._lock:
+                        self._enqueue_locked(fl)
+                except WorkerLostError as e:
+                    if not fl.fut.done():
+                        fl.fut.set_exception(e)
+                        self.metrics.record_failure(1)
+        with self._lock:
+            self._work.notify()
+        return len(stranded)
+
+    def _resubmit(self, group: _HeadGroup, flight: Flight,
+                  from_worker: str) -> None:
+        if flight.fut.done():
+            return
+        if flight.retried:
+            flight.fut.set_exception(WorkerLostError(
+                f"request lost decode worker {from_worker} after already "
+                "being re-submitted once (at-most-once retry exhausted)"
+            ))
+            self.metrics.record_failure(1)
+            return
+        live_decode = [w for w in group.decode if not w.dead]
+        if not live_decode:
+            flight.fut.set_exception(WorkerLostError(
+                f"decode worker {from_worker} died and no decode "
+                "capacity survives for the re-submit"
+            ))
+            self.metrics.record_failure(1)
+            return
+        flight.retried = True
+        try:
+            with self._lock:
+                self._enqueue_locked(flight)
+        except WorkerLostError as e:
+            flight.fut.set_exception(e)
+            self.metrics.record_failure(1)
+            return
+        self._counters["handoffs_resubmitted"] += 1
+        self._flight.record(
+            "handoff_resubmitted", head=group.head.name,
+            worker_from=from_worker,
+        )
+
+    def _find(self, worker_id: str, role: str):
+        for group in self._groups.values():
+            pool = group.decode if role == "decode" else group.prefill
+            for w in pool:
+                if w.worker_id == worker_id:
+                    return group, w
+        raise KeyError(f"no live {role} worker {worker_id!r}")
+
+    def role_pool(self, head: str, role: str) -> _RolePool:
+        if head not in self._heads or role not in ("prefill", "decode"):
+            raise KeyError(f"no role pool ({head!r}, {role!r})")
+        return _RolePool(self, head, role)
+
+    def _role_signal(self, head: str, role: str) -> dict:
+        group = self._groups[head]
+        workers = group.prefill if role == "prefill" else group.decode
+        per = {}
+        with self._lock:
+            pending = len(group.pending)
+            for w in workers:
+                if w.dead or w.draining:
+                    continue
+                hr = w.headroom()
+                if role == "prefill":
+                    shedding = len(w.queue) >= 4 * self._max_batch
+                else:
+                    shedding = w.free_slots == 0 and pending > 0
+                per[w.worker_id] = {"headroom": hr, "shedding": shedding}
+        return {"replicas": per, "alive": len(per)}
+
+    def _add_worker(self, head: str, role: str) -> str:
+        with self._runtime:
+            if self._draining:
+                raise DrainingError("front is draining; refusing scale-out")
+            group = self._groups[head]
+            if role == "prefill":
+                w = self._make_prefill(group)
+                w.warmup()
+                group.prefill.append(w)
+            else:
+                w = self._make_decode(group)
+                w.warmup()
+                group.decode.append(w)
+            self._flight.record(
+                "disagg_worker_added", worker=w.worker_id, role=role,
+                head=head, warmup_compiles=w.warmup_compiles,
+            )
+        with self._lock:
+            self._work.notify()
+        return w.worker_id
+
+    def _remove_worker(self, head: str, role: str, worker_id: str,
+                       timeout: float) -> dict:
+        group, worker = self._find(worker_id, role)
+        worker.draining = True
+        if role == "prefill":
+            # Re-route its queued flights; nothing prefilled is lost.
+            # Removing the LAST live prefill worker fails its queue
+            # typed — a raise here would strand the flights with their
+            # futures never set (callers hang to their own timeouts).
+            with self._runtime:
+                with self._lock:
+                    queued = list(worker.queue)
+                    worker.queue.clear()
+                group.prefill.remove(worker)
+                for fl in queued:
+                    try:
+                        with self._lock:
+                            self._enqueue_locked(fl)
+                    except WorkerLostError as e:
+                        if not fl.fut.done():
+                            fl.fut.set_exception(e)
+                            self.metrics.record_failure(1)
+                worker.clear_prefix_cache("scale_in")
+        else:
+            # Graceful: stop routing handoffs to it, let resident slots
+            # finish (the loop keeps stepping it), then drop the handle.
+            deadline = time.monotonic() + timeout
+            while not worker.idle and time.monotonic() < deadline:
+                if self._batcher is None:
+                    self.pump_once()
+                else:
+                    time.sleep(0.005)
+            if not worker.idle:
+                raise TimeoutError(
+                    f"decode worker {worker_id} did not drain in "
+                    f"{timeout}s"
+                )
+            with self._runtime:
+                group.decode.remove(worker)
+        group.transport.forget(worker.pool)
+        final = worker.stats()
+        self._flight.record(
+            "disagg_worker_removed", worker=worker_id, role=role,
+            head=head,
+        )
+        return final
+
+    # -- observability -------------------------------------------------------
+
+    def stats(self) -> dict:
+        snap = self.metrics.snapshot()
+        snap["params_step"] = self._step
+        snap["draining"] = self._draining
+        workers = [w for g in self._groups.values()
+                   for w in g.prefill + g.decode]
+        snap["warmup_compiles"] = sum(w.warmup_compiles for w in workers)
+        snap["recompilations"] = sum(w.recompilations for w in workers)
+        with self._lock:
+            depths = {
+                name: sum(len(w.queue) for w in g.prefill)
+                for name, g in self._groups.items()
+            }
+        snap["queue_depth"] = depths
+        headroom, kv_pool, roles_by_head = {}, {}, {}
+        for name, g in self._groups.items():
+            pre_live = [w for w in g.prefill if not w.dead and not w.draining]
+            dec_live = [w for w in g.decode if not w.dead and not w.draining]
+            pre_hr = max((w.headroom() for w in pre_live), default=-1.0)
+            dec_hr = max((w.headroom() for w in dec_live), default=-1.0)
+            headroom[name] = round(
+                min(pre_hr, dec_hr, -1.0 if self._draining else 1.0), 4
+            )
+            pools = []
+            if g.bank is not None:
+                pools.append(g.bank)
+            else:
+                pools.extend(w.pool for w in g.prefill if w.owns_pool)
+                pools.extend(w.pool for w in g.decode if w.owns_pool)
+            kv_pool[name] = {
+                "pages_in_use": sum(p.allocator.pages_in_use for p in pools),
+                "pages_free": sum(p.allocator.pages_free for p in pools),
+                "slots_active": sum(w.pool.active_slot_count
+                                    for w in g.decode),
+                "slots_total": sum(w.pool.cfg.max_slots for w in g.decode),
+                "kv_tokens_resident": int(sum(
+                    w.pool.seq_lens.sum() for w in g.decode
+                )),
+            }
+            roles_by_head[name] = {
+                "prefill": {
+                    "workers": len(pre_live),
+                    "queue_depth": depths[name],
+                    "headroom": round(pre_hr, 4),
+                    "deferred": sum(w.deferred for w in g.prefill),
+                    "per_worker": {w.worker_id: w.stats()
+                                   for w in g.prefill},
+                },
+                "decode": {
+                    "workers": len(dec_live),
+                    "pending_handoffs": len(g.pending),
+                    "slots_active": kv_pool[name]["slots_active"],
+                    "slots_total": kv_pool[name]["slots_total"],
+                    "headroom": round(dec_hr, 4),
+                    "per_worker": {w.worker_id: w.stats()
+                                   for w in g.decode},
+                },
+            }
+        snap["headroom"] = headroom
+        snap["kv_pool"] = kv_pool
+        snap["disagg"] = {
+            "transport": self._transport_kind,
+            **dict(self._counters),
+            "pending_handoffs": sum(len(g.pending)
+                                    for g in self._groups.values()),
+            "transfer_ms": self.transfer.summary(),
+            "roles": roles_by_head,
+        }
+        if self._slo is not None:
+            snap["slo"] = self._slo.snapshot()
+        return snap

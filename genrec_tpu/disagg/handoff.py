@@ -1,0 +1,173 @@
+"""The typed KV-handoff artifact: what a prefill worker gives a decode
+worker.
+
+A `KVHandoff` is self-describing: beside the KV payload (a page run in a
+shared in-process page bank, or serialized page content for the
+host-roundtrip wire) it carries everything the receiving side needs to
+VALIDATE the artifact before touching it — head name, page-pool layout,
+token count, the donor's prefill bucket, the post-prefill slot-state
+snapshot, and full provenance (params_step / catalog_version /
+prefill_worker_id). Receipt validation is a typed refusal
+(`HandoffRefusedError`), never silent mixing: a decode worker serving
+params step N must not generate from KV a prefill worker encoded at step
+M, and a catalog-version mismatch would beam-search against the wrong
+trie.
+
+The WIRE format (`pack_handoff`/`unpack_handoff`) is the cross-host
+contract, pinned by ``WIRE_VERSION`` and tests/test_disagg.py: a JSON
+header (provenance + layout + array manifest) followed by raw
+little-endian array bytes, framed inside one ``.npz`` container. The
+serializing in-process transport round-trips every handoff through it,
+so a future cross-host backend is a transport swap — the bytes already
+mean the same thing on both sides.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import json
+from typing import Optional
+
+import numpy as np
+
+from genrec_tpu.serving.types import ServingError
+
+#: Bump when the pack/unpack layout changes; unpack refuses other
+#: versions (typed) instead of misreading bytes.
+WIRE_VERSION = 1
+
+
+class DisaggError(ServingError):
+    """Base class for disaggregated-serving errors."""
+
+
+class HandoffRefusedError(DisaggError):
+    """The receiving worker rejected a `KVHandoff` at validation time —
+    wrong head, incompatible page layout, params/catalog version skew, or
+    an unknown wire version. The refusal is the accounting: the request
+    fails typed (and is counted/narrated) instead of decoding against
+    mismatched state."""
+
+
+class WorkerLostError(DisaggError):
+    """The decode worker holding this request's KV died mid-flight and
+    the typed at-most-once re-submit (back through a surviving
+    prefill/decode pair — the KV died with the worker) could not complete
+    it. Mirrors fleet.ReplicaLostError one level down: accepted work is
+    never silently dropped."""
+
+
+@dataclasses.dataclass
+class KVHandoff:
+    """One request's prefilled KV state, in flight between roles.
+
+    ``layout`` is ``(n_layers, n_heads, head_dim, dtype_str)`` — the KV
+    tensor geometry both sides must share (`layout_of`). Page SIZE is
+    the transport's concern: the in-process tier shares one bank (views
+    must match its geometry at construction), and the serializing tier
+    re-checks the wire content's page size against the receiving pool
+    at admit. ``init`` is the
+    donor's post-prefill slot-state rows (host numpy, None/empty when the
+    head's prefill leaves state zeroed — TIGER); the receiving worker
+    patches bucket-dependent fields against the request's OWN bucket via
+    ``head.paged_warm_state`` (the prefix-cache warm-admission semantics:
+    a handoff is a warm admission whose donor ran on another worker).
+
+    Payload is exactly one of:
+
+    - ``pages`` — a page run in the SHARED page bank (in-process
+      zero-copy transport; the handoff holds one allocator ref per page
+      until it is admitted or dropped);
+    - ``wire`` — the serialized page content (`pack_handoff` bytes, the
+      host-roundtrip transport / future cross-host hop).
+    """
+
+    head: str
+    n_tokens: int
+    bucket: tuple[int, int]
+    layout: tuple
+    init: Optional[dict]
+    params_step: Optional[int]
+    catalog_version: Optional[str]
+    prefill_worker_id: str
+    warm: bool = False          # served from the prefill worker's prefix cache
+    pages: Optional[list] = None
+    wire: Optional[bytes] = None
+
+    @property
+    def transfer_bytes(self) -> int:
+        """Bytes that crossed the transport: the wire size, or 0 for the
+        zero-copy in-process path (pages move by reference)."""
+        return len(self.wire) if self.wire is not None else 0
+
+
+def layout_of(head) -> tuple:
+    """The handoff-validation layout tuple for one paged head + the
+    page geometry it serves under (page_size from the pool config)."""
+    n_layers, n_heads, head_dim, dtype = head.paged_layout()
+    return (int(n_layers), int(n_heads), int(head_dim),
+            np.dtype(dtype).name)
+
+
+def pack_handoff(handoff: KVHandoff, k_content, v_content) -> bytes:
+    """Serialize one handoff + its page content to the pinned wire
+    format. ``k_content``/``v_content`` are per-layer host arrays shaped
+    ``(n_pages_used, page_size, n_heads, head_dim)`` — exactly the pages
+    the run covers, no padding (the receiving side re-pads to its own
+    fixed scatter shape)."""
+    header = {
+        "wire_version": WIRE_VERSION,
+        "head": handoff.head,
+        "n_tokens": int(handoff.n_tokens),
+        "bucket": list(handoff.bucket),
+        "layout": list(handoff.layout),
+        "params_step": handoff.params_step,
+        "catalog_version": handoff.catalog_version,
+        "prefill_worker_id": handoff.prefill_worker_id,
+        "warm": bool(handoff.warm),
+        "n_layers": len(k_content),
+        "state_keys": sorted(handoff.init) if handoff.init else [],
+    }
+    arrays = {"__header__": np.frombuffer(
+        json.dumps(header).encode("utf-8"), np.uint8)}
+    for i, (k, v) in enumerate(zip(k_content, v_content)):
+        arrays[f"k{i}"] = np.ascontiguousarray(k)
+        arrays[f"v{i}"] = np.ascontiguousarray(v)
+    for key in header["state_keys"]:
+        arrays[f"s_{key}"] = np.ascontiguousarray(handoff.init[key])
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    return buf.getvalue()
+
+
+def unpack_handoff(data: bytes) -> tuple[KVHandoff, tuple, tuple]:
+    """Wire bytes -> (handoff, k_content, v_content). Refuses unknown
+    wire versions typed — misreading a future layout as this one would
+    be silent corruption, the one failure mode the format exists to
+    prevent."""
+    with np.load(io.BytesIO(data)) as z:
+        header = json.loads(bytes(z["__header__"]).decode("utf-8"))
+        if header.get("wire_version") != WIRE_VERSION:
+            raise HandoffRefusedError(
+                f"handoff wire version {header.get('wire_version')!r} != "
+                f"supported {WIRE_VERSION}; refusing to decode bytes under "
+                "the wrong layout"
+            )
+        n_layers = int(header["n_layers"])
+        k_content = tuple(z[f"k{i}"] for i in range(n_layers))
+        v_content = tuple(z[f"v{i}"] for i in range(n_layers))
+        init = {key: z[f"s_{key}"] for key in header["state_keys"]} or None
+    handoff = KVHandoff(
+        head=header["head"],
+        n_tokens=int(header["n_tokens"]),
+        bucket=tuple(header["bucket"]),
+        layout=tuple(header["layout"]),
+        init=init,
+        params_step=header["params_step"],
+        catalog_version=header["catalog_version"],
+        prefill_worker_id=header["prefill_worker_id"],
+        warm=bool(header["warm"]),
+        wire=data,
+    )
+    return handoff, k_content, v_content

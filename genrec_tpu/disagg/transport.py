@@ -1,0 +1,257 @@
+"""KV transports: how a `KVHandoff`'s page content crosses worker pools.
+
+Two tiers, one interface, so the cross-host backend later is a transport
+swap rather than a redesign:
+
+- `InProcessTransport` — zero-copy. Prefill and decode workers share ONE
+  device page bank (`KVPagePool(bank=...)` slot views over the same page
+  arrays + refcounted allocator), and the handoff moves a page run by
+  REFERENCE: `send` takes a COW ref per page, the receiving pool's
+  `admit_shared` binds a slot onto the same pages, and the handoff ref
+  drops — exactly the PR-11 prefix-cache machinery generalized across
+  pools. ``transfer_bytes`` is 0; the payload never moves.
+- `SerializingTransport` — host-roundtrip. The sender gathers the run's
+  page content to host (one fixed-shape compiled gather per pool, AOT at
+  warmup), packs it through the pinned wire format
+  (disagg/handoff.pack_handoff), and the receiver allocates fresh pages
+  in its OWN pool and scatters the content in (one fixed-shape compiled
+  scatter, AOT at warmup). This pins the wire contract and makes the
+  transfer cost a measured quantity (bytes + latency per handoff) —
+  the cross-host hop will serialize exactly these bytes.
+
+Both transports keep the compile discipline: every executable is built
+at worker warmup (counted there), steady state never compiles.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from genrec_tpu.disagg.handoff import (
+    HandoffRefusedError,
+    KVHandoff,
+    pack_handoff,
+    unpack_handoff,
+)
+from genrec_tpu.serving.aot import donate_argnums, sds_tree as _sds_tree
+from genrec_tpu.serving.kv_pool import KVPagePool
+
+
+class KVTransport:
+    """Interface both workers program against.
+
+    Lifecycle of one handoff: the prefill worker calls
+    ``send(src_pool, pages, handoff)`` (attach the payload — take refs or
+    serialize), the front routes it, the decode worker calls
+    ``admit(handoff, dst_pool)`` (bind a slot; may raise PoolExhausted —
+    the handoff stays pending and retries), and finally
+    ``release(handoff)`` drops the in-flight payload refs (after a
+    successful admit, a typed refusal, or a kill). All calls run on the
+    front's single runtime thread — the same single-writer discipline the
+    engine's batcher holds over its pool."""
+
+    name = "abstract"
+
+    def prepare_send(self, pool: KVPagePool, on_compile) -> None:
+        """Compile/validate the sender-side path for ``pool`` (worker
+        warmup; ``on_compile()`` counts every executable built)."""
+
+    def prepare_admit(self, pool: KVPagePool, on_compile) -> None:
+        """Compile/validate the receiver-side path for ``pool``."""
+
+    def send(self, src_pool: KVPagePool, pages, handoff: KVHandoff) -> None:
+        raise NotImplementedError
+
+    def admit(self, handoff: KVHandoff, dst_pool: KVPagePool) -> int:
+        raise NotImplementedError
+
+    def release(self, handoff: KVHandoff) -> None:
+        raise NotImplementedError
+
+    def forget(self, pool: KVPagePool) -> None:
+        """Drop any per-pool compiled/cached state — the front calls
+        this when a worker is killed or scaled in, so a group-lifetime
+        transport does not accumulate executables for dead pools."""
+
+
+class InProcessTransport(KVTransport):
+    """Zero-copy: every worker pool must be a slot view over ``bank``."""
+
+    name = "inprocess"
+
+    def __init__(self, bank: KVPagePool):
+        self.bank = bank
+
+    def _check_pool(self, pool: KVPagePool) -> None:
+        if pool.allocator is not self.bank.allocator:
+            raise ValueError(
+                "in-process transport requires every worker pool to share "
+                "the page bank (KVPagePool(bank=...)); this pool has its "
+                "own allocator — use the serializing transport instead"
+            )
+
+    def prepare_send(self, pool, on_compile) -> None:
+        self._check_pool(pool)
+
+    def prepare_admit(self, pool, on_compile) -> None:
+        self._check_pool(pool)
+
+    def send(self, src_pool, pages, handoff) -> None:
+        # The handoff's own COW ref per page: the run survives the
+        # sender's temp ref / prefix entry being dropped, and dies with
+        # release() if the handoff never lands.
+        src_pool.allocator.addref(pages)
+        handoff.pages = list(pages)
+
+    def admit(self, handoff, dst_pool) -> int:
+        return dst_pool.admit_shared(handoff.pages, handoff.n_tokens)
+
+    def release(self, handoff) -> None:
+        if handoff.pages is not None:
+            self.bank.allocator.free(handoff.pages)
+            handoff.pages = None
+
+
+class SerializingTransport(KVTransport):
+    """Host-roundtrip: gather -> pinned wire bytes -> scatter."""
+
+    name = "serializing"
+
+    def __init__(self):
+        # Compiled per pool object (pools differ in num_pages across
+        # roles/workers); built at worker warmup, looked up steady-state.
+        # _pools pins a strong ref per cached pool: the id() keys stay
+        # valid (a GC'd pool's id can be recycled by a NEW pool, whose
+        # prepare_* would then silently reuse the dead pool's
+        # executable); `forget` drops all three entries on worker
+        # removal.
+        self._gather: dict[int, object] = {}
+        self._scatter: dict[int, object] = {}
+        self._pools: dict[int, KVPagePool] = {}
+
+    def forget(self, pool) -> None:
+        key = id(pool)
+        self._gather.pop(key, None)
+        self._scatter.pop(key, None)
+        self._pools.pop(key, None)
+
+    def prepare_send(self, pool, on_compile) -> None:
+        import jax
+
+        if id(pool) in self._gather:
+            return
+        P = pool.cfg.pages_per_slot
+
+        def gather(k_pools, v_pools, pages_vec):
+            return (tuple(k[pages_vec] for k in k_pools),
+                    tuple(v[pages_vec] for v in v_pools))
+
+        args = (
+            _sds_tree(pool.k_pools), _sds_tree(pool.v_pools),
+            jax.ShapeDtypeStruct((P,), np.int32),
+        )
+        self._gather[id(pool)] = jax.jit(gather).lower(*args).compile()
+        self._pools[id(pool)] = pool
+        on_compile(self._gather[id(pool)])
+
+    def prepare_admit(self, pool, on_compile) -> None:
+        import jax
+
+        if id(pool) in self._scatter:
+            return
+        P = pool.cfg.pages_per_slot
+
+        def scatter(k_pools, v_pools, pages_vec, k_content, v_content):
+            # Padding rows target the reserved null page 0 — attention
+            # never reads it unmasked (ops/paged.py contract), so
+            # duplicate index-0 writes are harmless.
+            k_pools = tuple(k.at[pages_vec].set(c)
+                            for k, c in zip(k_pools, k_content))
+            v_pools = tuple(v.at[pages_vec].set(c)
+                            for v, c in zip(v_pools, v_content))
+            return k_pools, v_pools
+
+        page_shape = jax.ShapeDtypeStruct(
+            (P,) + tuple(np.shape(pool.k_pools[0])[1:]),
+            np.result_type(pool.k_pools[0]),
+        )
+        args = (
+            _sds_tree(pool.k_pools), _sds_tree(pool.v_pools),
+            jax.ShapeDtypeStruct((P,), np.int32),
+            tuple(page_shape for _ in pool.k_pools),
+            tuple(page_shape for _ in pool.v_pools),
+        )
+        self._scatter[id(pool)] = jax.jit(
+            scatter, donate_argnums=donate_argnums(0, 1)
+        ).lower(*args).compile()
+        self._pools[id(pool)] = pool
+        on_compile(self._scatter[id(pool)])
+
+    def send(self, src_pool, pages, handoff) -> None:
+        import jax.numpy as jnp
+
+        gather = self._gather[id(src_pool)]
+        P = src_pool.cfg.pages_per_slot
+        vec = np.zeros(P, np.int32)
+        vec[: len(pages)] = pages
+        k_content, v_content = gather(
+            src_pool.k_pools, src_pool.v_pools, jnp.asarray(vec)
+        )
+        n = len(pages)
+        k_host = tuple(np.asarray(k)[:n] for k in k_content)
+        v_host = tuple(np.asarray(v)[:n] for v in v_content)
+        handoff.wire = pack_handoff(handoff, k_host, v_host)
+        handoff.pages = None  # nothing pinned on the sender side
+
+    def admit(self, handoff, dst_pool) -> int:
+        import jax.numpy as jnp
+
+        parsed = getattr(handoff, "_parsed", None)
+        if parsed is None:
+            decoded, k_content, v_content = unpack_handoff(handoff.wire)
+            # The wire is self-describing; cross-check the framing fields
+            # against the routed handoff so a swapped payload cannot ride
+            # valid routing metadata.
+            if (decoded.head, decoded.n_tokens) != (
+                handoff.head, handoff.n_tokens
+            ):
+                raise HandoffRefusedError(
+                    "handoff wire payload disagrees with its routing "
+                    f"metadata: {decoded.head}/{decoded.n_tokens} vs "
+                    f"{handoff.head}/{handoff.n_tokens}"
+                )
+            parsed = handoff._parsed = (k_content, v_content)
+        k_content, v_content = parsed
+        n = k_content[0].shape[0]
+        if k_content[0].shape[1] != dst_pool.cfg.page_size:
+            raise HandoffRefusedError(
+                f"handoff page size {k_content[0].shape[1]} != receiving "
+                f"pool page size {dst_pool.cfg.page_size}"
+            )
+        if n > dst_pool.cfg.pages_per_slot:
+            raise HandoffRefusedError(
+                f"handoff spans {n} pages but the receiving pool binds "
+                f"at most {dst_pool.cfg.pages_per_slot} per slot"
+            )
+        pages = dst_pool.allocator.alloc(n)  # may raise PoolExhausted
+        try:
+            P = dst_pool.cfg.pages_per_slot
+            vec = np.zeros(P, np.int32)
+            vec[:n] = pages
+            pad = ((0, P - n),) + ((0, 0),) * (k_content[0].ndim - 1)
+            scatter = self._scatter[id(dst_pool)]
+            k_pools, v_pools = scatter(
+                dst_pool.k_pools, dst_pool.v_pools, jnp.asarray(vec),
+                tuple(np.pad(k, pad) for k in k_content),
+                tuple(np.pad(v, pad) for v in v_content),
+            )
+            dst_pool.k_pools, dst_pool.v_pools = k_pools, v_pools
+            return dst_pool.bind_pages(pages, handoff.n_tokens)
+        except Exception:
+            dst_pool.allocator.free(pages)
+            raise
+
+    def release(self, handoff) -> None:
+        handoff.wire = None
+        if hasattr(handoff, "_parsed"):
+            handoff._parsed = None

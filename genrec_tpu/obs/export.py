@@ -36,6 +36,12 @@ _COUNTER_LEAVES = frozenset({
     # fleet/autoscaler.py); replicas_alive / headroom leaves stay gauges.
     "routed", "rerouted", "fleet_shed_rejected", "replica_deaths",
     "replicas_added", "replicas_drained", "scale_outs", "scale_ins",
+    # Disaggregated-serving lifetime totals (genrec_tpu/disagg/);
+    # pending_handoffs / occupancy / transfer_ms percentiles / per-role
+    # headroom leaves stay gauges.
+    "handoffs_sent", "handoffs_admitted", "handoffs_refused",
+    "handoffs_resubmitted", "transfer_bytes", "decode_worker_deaths",
+    "prefill_worker_deaths", "prefills", "deferred", "admitted",
 })
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_]")

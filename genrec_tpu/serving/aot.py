@@ -1,0 +1,30 @@
+"""AOT-lowering helpers shared by the serving engine and the disagg
+workers/transports.
+
+Both rules are load-bearing compile discipline, so they live in exactly
+one place:
+
+- ``sds_tree``: pytree -> ShapeDtypeStructs, lowering without live
+  buffers;
+- ``donate_argnums``: the backend donation policy — CPU has no buffer
+  donation, and donating there only emits a per-call warning.
+"""
+
+from __future__ import annotations
+
+
+def donate_argnums(*argnums):
+    """``argnums`` where the backend supports donation, ``()`` on CPU."""
+    import jax
+
+    return argnums if jax.default_backend() != "cpu" else ()
+
+
+def sds_tree(tree):
+    """Pytree -> ShapeDtypeStructs for AOT lowering without live buffers."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(jnp.shape(x), jnp.result_type(x)), tree
+    )

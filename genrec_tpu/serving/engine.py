@@ -133,11 +133,8 @@ from genrec_tpu.serving.types import (
 )
 
 
-def _sds(tree):
-    """Pytree -> ShapeDtypeStructs for AOT lowering without live buffers."""
-    return jax.tree_util.tree_map(
-        lambda x: jax.ShapeDtypeStruct(jnp.shape(x), jnp.result_type(x)), tree
-    )
+from genrec_tpu.serving.aot import donate_argnums as _donate_argnums
+from genrec_tpu.serving.aot import sds_tree as _sds
 
 
 #: The slot-state operand of the paged decode step is dead after every
@@ -247,8 +244,7 @@ class _PagedRunner:
             self._prefill[(B, L)] = self._compile_prefill(B, L)
 
     def _donate(self, *argnums):
-        # CPU has no buffer donation; avoid the per-call warning there.
-        return argnums if jax.default_backend() != "cpu" else ()
+        return _donate_argnums(*argnums)
 
     def _compile_decode(self, S: int, operands=None, catalog_compile=False):
         eng = self.engine
@@ -713,6 +709,11 @@ class _PagedRunner:
                     total_s=now - t_enq,
                     request_id=tr[0] if tr is not None else None,
                     replica_id=eng.replica_id,
+                    # Co-located engine: prefill and decode ran in this
+                    # process — no handoff, no worker attribution (the
+                    # disagg front stamps real ids at ITS finalize).
+                    prefill_worker_id=None,
+                    decode_worker_id=None,
                 )
             except Exception as e:  # noqa: BLE001 — one bad slot, not the loop
                 eng._log.exception(
@@ -1371,6 +1372,8 @@ class ServingEngine:
                 total_s=now - t_enq,
                 request_id=tr[0] if tr is not None else None,
                 replica_id=self.replica_id,
+                prefill_worker_id=None,  # co-located: no handoff to
+                decode_worker_id=None,   # attribute (see paged finalize)
             )
             self.metrics.record_response(
                 resp.queue_wait_s, resp.compute_s, resp.total_s,

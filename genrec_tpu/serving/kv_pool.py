@@ -152,9 +152,14 @@ class PageAllocator:
         sharing). Refusing dead pages catches use-after-free at the
         source."""
         pages = list(pages)
-        if any(self._refs[p] <= 0 for p in pages):
+        if not self.is_live(pages):
             raise ValueError("addref on a page that is not live")
         self._refs[pages] += 1
+
+    def is_live(self, pages) -> bool:
+        """Whether every page currently holds at least one ref — the
+        public liveness probe (callers must not read ``_refs``)."""
+        return all(self._refs[p] > 0 for p in pages)
 
     def free(self, pages) -> None:
         """Drop one ref per page; a page returns to the free list at zero.
@@ -187,16 +192,37 @@ class PageAllocator:
 
 
 class KVPagePool:
-    """Device page pools + slot bindings for ONE head's decode layers."""
+    """Device page pools + slot bindings for ONE head's decode layers.
+
+    ``bank=`` builds a pool that SHARES another pool's device page
+    arrays and allocator but owns its own slot tables — the
+    disaggregated-serving split (genrec_tpu/disagg/): a prefill worker
+    writes KV into the bank's pages and a decode worker binds its own
+    slots onto the same pages (`admit_shared`, the PR-11 COW machinery
+    generalized across pools). The bank and every view must agree on
+    page geometry; slot capacity (``max_slots``) is per-view.
+    """
 
     def __init__(self, cfg: PagedConfig, n_layers: int, n_heads: int,
-                 head_dim: int, dtype=jnp.float32):
+                 head_dim: int, dtype=jnp.float32, bank: "KVPagePool" = None):
         self.cfg = cfg
         self.n_layers = n_layers
-        shape = (cfg.num_pages, cfg.page_size, n_heads, head_dim)
-        self.k_pools = tuple(jnp.zeros(shape, dtype) for _ in range(n_layers))
-        self.v_pools = tuple(jnp.zeros(shape, dtype) for _ in range(n_layers))
-        self.allocator = PageAllocator(cfg.num_pages)
+        self._bank = bank
+        if bank is None:
+            shape = (cfg.num_pages, cfg.page_size, n_heads, head_dim)
+            self._k_pools = tuple(jnp.zeros(shape, dtype) for _ in range(n_layers))
+            self._v_pools = tuple(jnp.zeros(shape, dtype) for _ in range(n_layers))
+            self.allocator = PageAllocator(cfg.num_pages)
+        else:
+            if (cfg.num_pages, cfg.page_size) != (
+                bank.cfg.num_pages, bank.cfg.page_size
+            ) or n_layers != bank.n_layers:
+                raise ValueError(
+                    "slot view must match its bank's page geometry: "
+                    f"view {cfg} x {n_layers} layers vs bank {bank.cfg} x "
+                    f"{bank.n_layers}"
+                )
+            self.allocator = bank.allocator
         self.block_tables = np.zeros((cfg.max_slots, cfg.pages_per_slot), np.int32)
         self.seq_lens = np.zeros((cfg.max_slots,), np.int32)
         self._slot_pages: list[list[int] | None] = [None] * cfg.max_slots
@@ -205,6 +231,31 @@ class KVPagePool:
         # shape covering max(active index) (the collapsed decode ladder).
         self._free_slots = list(range(cfg.max_slots))
         heapq.heapify(self._free_slots)
+
+    # Device pools live on the BANK when this pool is a slot view: a
+    # prefill executable donates + replaces the bank's arrays, and every
+    # view must read the replacement, not a stale reference.
+    @property
+    def k_pools(self):
+        return self._bank.k_pools if self._bank is not None else self._k_pools
+
+    @k_pools.setter
+    def k_pools(self, value):
+        if self._bank is not None:
+            self._bank.k_pools = value
+        else:
+            self._k_pools = value
+
+    @property
+    def v_pools(self):
+        return self._bank.v_pools if self._bank is not None else self._v_pools
+
+    @v_pools.setter
+    def v_pools(self, value):
+        if self._bank is not None:
+            self._bank.v_pools = value
+        else:
+            self._v_pools = value
 
     @property
     def free_slot_count(self) -> int:
@@ -224,6 +275,14 @@ class KVPagePool:
         if not self._free_slots:
             raise PoolExhausted("no free decode slots")
         pages = self.allocator.alloc(self.cfg.pages_for(n_tokens))  # may raise
+        return self._bind_slot(pages, n_tokens)
+
+    def _bind_slot(self, pages: list[int], n_tokens: int) -> int:
+        """Pop a free slot and point it at ``pages``. The caller has
+        already arranged one alloc ref per page for the slot to own
+        (fresh alloc, addref'd share, or a transferred ref) and checked
+        ``_free_slots`` — every entry point shares this body so slot
+        bookkeeping changes in exactly one place."""
         slot = heapq.heappop(self._free_slots)
         self._slot_pages[slot] = pages
         row = np.zeros(self.cfg.pages_per_slot, np.int32)
@@ -276,18 +335,28 @@ class KVPagePool:
             raise ValueError("shared view exceeds the retained page run")
         return self._bind_shared(pages, n_tokens)
 
+    def bind_pages(self, pages, n_tokens: int) -> int:
+        """Bind a slot onto pages this caller ALREADY OWNS (their alloc
+        ref transfers to the slot — no addref): the serializing-transport
+        admit path, where a handoff's KV content was scattered into
+        freshly allocated pages of the receiving pool. Evicting the slot
+        drops the transferred ref like any admit. State unchanged on
+        error (no free slot raises before ownership moves)."""
+        pages = list(pages)
+        if n_tokens > len(pages) * self.cfg.page_size:
+            raise ValueError("bound view exceeds the page run")
+        if not self.allocator.is_live(pages):
+            raise ValueError("bind_pages on a page that is not live")
+        if not self._free_slots:
+            raise PoolExhausted("no free decode slots")
+        return self._bind_slot(pages, n_tokens)
+
     def _bind_shared(self, pages: list[int], n_tokens: int) -> int:
         if not self._free_slots:
             raise PoolExhausted("no free decode slots")
         cover = pages[: self.cfg.pages_for(n_tokens)]
         self.allocator.addref(cover)  # may raise; slot state untouched
-        slot = heapq.heappop(self._free_slots)
-        self._slot_pages[slot] = list(cover)
-        row = np.zeros(self.cfg.pages_per_slot, np.int32)
-        row[: len(cover)] = cover
-        self.block_tables[slot] = row
-        self.seq_lens[slot] = n_tokens
-        return slot
+        return self._bind_slot(list(cover), n_tokens)
 
     def check_invariants(self) -> None:
         """Property-test hook: allocator accounting holds AND no page is

@@ -89,3 +89,11 @@ class Response:
     #: across replicas, post-hoc blame for a degraded replica) is free.
     #: None on a single-engine deployment with no replica_id configured.
     replica_id: Optional[str] = None
+    #: Disaggregated serving provenance (genrec_tpu/disagg/): which
+    #: prefill worker encoded this request's history KV and which decode
+    #: worker generated from it — stamped by the disagg finalize from the
+    #: `KVHandoff`'s provenance. A co-located engine stamps both None at
+    #: its two finalize sites: prefill and decode happened in the same
+    #: process with no handoff to attribute.
+    prefill_worker_id: Optional[str] = None
+    decode_worker_id: Optional[str] = None

@@ -87,6 +87,15 @@ GATED_METRICS: dict[str, tuple[str, float]] = {
     # (the zero-baseline rule above).
     "serve/fleet/p99_under_burst_ms": ("lower", 50.0),
     "serve/fleet/shed_rate": ("lower", 100.0),
+    # Disaggregated serving (PR 13): the serializing handoff's
+    # send->admit p50 (latency on a shared CPU host: wide band), the
+    # mean wire bytes per handoff (deterministic shape math on the
+    # seeded trace: tight band — catches wire-format growth), and the
+    # in-process front's qps against the co-located engine at parity
+    # traffic (same-backend ratio; the split's control-plane overhead).
+    "serve/disagg/handoff_p50_ms": ("lower", 60.0),
+    "serve/disagg/wire_bytes_per_handoff": ("lower", 15.0),
+    "serve/disagg/qps_vs_colocated": ("higher", 40.0),
 }
 
 

@@ -20,6 +20,12 @@
 #                            every accepted request completes or is
 #                            rerouted (flight-recorder narrative), all
 #                            pages released after drain
+#   check_disagg.py        — disaggregated serving: mixed warm/cold
+#                            churn through a 1-prefill/2-decode split
+#                            on the serializing KV transport — zero
+#                            steady-state recompiles, answers
+#                            bit-identical to a co-located engine, all
+#                            pages on BOTH pools released after drain
 #   check_obs.py           — obs smoke: a traced serve loop yields a
 #                            complete per-request span tree + valid
 #                            Chrome-trace JSON, a traced train loop's
@@ -129,6 +135,15 @@ if [ "$MODE" = "--smoke" ]; then
     if [ -z "${GENREC_CI_SKIP_FLEET:-}" ]; then
         run python scripts/check_fleet.py --small --platform cpu
     fi
+    # Disagg smoke: 1-prefill/2-decode split under mixed warm/cold
+    # churn over the serializing wire — zero recompiles, bit-identical
+    # to a co-located engine, both pools clean after drain.
+    # GENREC_CI_SKIP_DISAGG=1 skips it for callers whose pytest pass
+    # already runs tests/test_disagg.py directly (same contract as the
+    # knobs above).
+    if [ -z "${GENREC_CI_SKIP_DISAGG:-}" ]; then
+        run python scripts/check_disagg.py --small --platform cpu
+    fi
     # Obs smoke (traced serve span tree + goodput schema + overhead
     # budget + memory ledger + SLO shed). GENREC_CI_SKIP_OBS=1 skips it
     # for callers whose pytest pass already runs tests/test_obs.py
@@ -188,6 +203,7 @@ else
     run python scripts/check_serving_hlo.py --write-note
     run python scripts/check_catalog_hlo.py --write-note
     run python scripts/check_fleet.py --write-note
+    run python scripts/check_disagg.py --write-note
     run python scripts/check_obs.py
     run python scripts/graftlint.py
     # Perf regression gate: self-test, then the newest committed
@@ -198,7 +214,7 @@ else
     # slow COBRA trie-constraint pins, and the full paged-parity matrix).
     run_strict env JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py \
         tests/test_trie_constrained.py tests/test_catalog.py \
-        tests/test_kv_pool.py tests/test_fleet.py \
+        tests/test_kv_pool.py tests/test_fleet.py tests/test_disagg.py \
         tests/test_paged_parity.py -q -p no:cacheprovider 1>&2
     # Full chaos suite: SIGTERM mid-epoch + exact-resume parity for all
     # seven trainers, ladder fallback, NaN injection — plus the 2-process

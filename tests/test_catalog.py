@@ -307,9 +307,16 @@ def test_catalog_swap_mid_churn_zero_recompiles_no_version_mixing(rng):
         snap_b.version: {tuple(r) for r in valid_b},
     }
     head, params = _tiger_head_and_params(valid_a)
+    # Small-ladder discipline (tier-1 wall time): one history bucket and
+    # max_slots == max_batch collapse warmup to 2 prefill + 1 decode
+    # executables; the swap barrier/no-mixing property is bucket-count
+    # independent.
+    from genrec_tpu.serving import PagedConfig
+
     eng = ServingEngine(
-        [head], params, ladder=BucketLadder((1, 2), (4, 8)), max_batch=2,
+        [head], params, ladder=BucketLadder((1, 2), (8,)), max_batch=2,
         max_wait_ms=1.0, handle_signals=False,
+        paged_config=PagedConfig(max_slots=2, page_size=8, pages_per_slot=4),
     ).start()
     try:
         n_corpus = min(len(valid_a), len(valid_b))
@@ -353,8 +360,10 @@ def test_catalog_swap_mid_churn_zero_recompiles_no_version_mixing(rng):
         assert r_swapped.catalog_version == snap_b.version
         head_b, params_b = _tiger_head_and_params(valid_b)
         ref = ServingEngine(
-            [head_b], params, ladder=BucketLadder((1, 2), (4, 8)), max_batch=2,
+            [head_b], params, ladder=BucketLadder((1, 2), (8,)), max_batch=2,
             max_wait_ms=1.0, handle_signals=False,
+            paged_config=PagedConfig(max_slots=2, page_size=8,
+                                     pages_per_slot=4),
         ).start()
         try:
             r_ref = ref.serve(fixed, timeout=60)

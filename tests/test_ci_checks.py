@@ -33,6 +33,11 @@ FLEET_KEYS = {"backend", "replicas_started", "submitted", "completed",
               "kill_narrated", "reroutes_narrated", "recompilations",
               "pages_in_use_final", "slots_active_final",
               "constrained_items_valid", "p99_under_burst_ms", "ok"}
+DISAGG_KEYS = {"backend", "submitted", "completed", "failed", "replays",
+               "warm_hits", "handoffs_sent", "handoffs_admitted",
+               "handoffs_refused", "transfer_bytes", "recompilations",
+               "prefill_pages_final", "decode_pages_final",
+               "slots_active_final", "parity_ok", "ok"}
 # bench_gate is the new perf regression gate (one verdict line,
 # graftlint mold); check_obs's grown verdict (memory + slo sections) is
 # exercised by its own full run in ci_checks, not re-run here.
@@ -81,7 +86,8 @@ def test_check_scripts_keep_their_cli():
     (ci_checks.sh and the watchdog pass these exact flags)."""
     for script in ("check_decode_hlo", "check_packed_hlo",
                    "check_fused_ce_hlo", "check_serving_hlo",
-                   "check_catalog_hlo", "check_fleet", "check_obs"):
+                   "check_catalog_hlo", "check_fleet", "check_disagg",
+                   "check_obs"):
         proc = subprocess.run(
             [sys.executable, os.path.join(REPO, "scripts", f"{script}.py"),
              "--help"],
@@ -110,15 +116,20 @@ def test_ci_checks_smoke_entrypoint():
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     # One verdict JSON per check on stdout (decode, fused-ce, packed,
-    # serving, fleet, bench-gate self-test).
+    # serving, fleet, disagg, bench-gate self-test).
     verdicts = [json.loads(l) for l in proc.stdout.splitlines() if l.strip()]
-    assert len(verdicts) == 6
+    assert len(verdicts) == 7
     serving = [v for v in verdicts if "dense" in v]
     assert len(serving) == 1 and serving[0]["recompilations"] == 0
     assert set(serving[0]) == SERVING_KEYS  # harness migration parity
     fleet = [v for v in verdicts if "rerouted" in v]
     assert len(fleet) == 1 and set(fleet[0]) == FLEET_KEYS
     assert fleet[0]["recompilations"] == 0 and fleet[0]["lost"] == 0
+    disagg = [v for v in verdicts if "handoffs_sent" in v]
+    assert len(disagg) == 1 and set(disagg[0]) == DISAGG_KEYS
+    assert disagg[0]["recompilations"] == 0 and disagg[0]["parity_ok"]
+    assert disagg[0]["prefill_pages_final"] == 0
+    assert disagg[0]["decode_pages_final"] == 0
     decode = [v for v in verdicts if "cached_broadcast_hits" in v]
     assert len(decode) == 1 and set(decode[0]) == DECODE_KEYS
     gate = [v for v in verdicts if v.get("check") == "bench_gate"]

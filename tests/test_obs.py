@@ -704,6 +704,18 @@ def test_prometheus_text_exposition():
         "kv_pool": {"tiger": {"pages_in_use": 3}},
         "skip_nan": float("nan"),
         "draining": False,
+        # Disaggregated-serving aggregation (genrec_tpu/disagg/): the
+        # handoff/transfer lifetime totals are counters; pending
+        # backlog, transfer percentiles, and per-role headroom are
+        # gauges — typing pinned here beside the engine leaves.
+        "disagg": {
+            "handoffs_sent": 9, "handoffs_admitted": 9,
+            "handoffs_refused": 0, "handoffs_resubmitted": 1,
+            "transfer_bytes": 43684, "pending_handoffs": 2,
+            "transfer_ms": {"p50": 0.4},
+            "roles": {"tiger": {"prefill": {"headroom": 0.9},
+                                "decode": {"headroom": 0.5}}},
+        },
     })
     lines = text.splitlines()
     assert "# TYPE genrec_completed counter" in lines
@@ -715,6 +727,12 @@ def test_prometheus_text_exposition():
     assert "genrec_kv_pool_tiger_pages_in_use 3" in lines
     assert "genrec_draining 0" in lines
     assert not any("nan" in ln.lower() for ln in lines if "genrec_skip" in ln)
+    assert "# TYPE genrec_disagg_handoffs_sent counter" in lines
+    assert "# TYPE genrec_disagg_handoffs_refused counter" in lines
+    assert "# TYPE genrec_disagg_transfer_bytes counter" in lines
+    assert "# TYPE genrec_disagg_pending_handoffs gauge" in lines
+    assert "# TYPE genrec_disagg_transfer_ms_p50 gauge" in lines
+    assert "# TYPE genrec_disagg_roles_tiger_prefill_headroom gauge" in lines
 
 
 def test_trace_report_cli_summarizes(tmp_path, capsys):

@@ -188,9 +188,14 @@ def test_engine_four_heads_smoke_and_drain(zoo, corpus, rng):
         RetrievalHead("hstu", models["hstu"], top_k=5),
     ]
     prev_term = signal.getsignal(signal.SIGTERM)
+    # Small-ladder discipline: one history bucket and max_slots ==
+    # max_batch (shared by both paged heads: TIGER needs 25 KV tokens at
+    # L=8, COBRA 32 — both fit 4 pages of 8) keeps warmup at one decode
+    # shape per head instead of the default 4x ladder.
     eng = ServingEngine(
         heads, params, ladder=BucketLadder((1, 2), (8,)), max_batch=2,
         max_wait_ms=2.0,
+        paged_config=PagedConfig(max_slots=2, page_size=8, pages_per_slot=4),
     ).start()
     try:
         futs = [
@@ -290,7 +295,7 @@ def test_paged_continuous_batching_churn_under_pool_pressure(zoo, corpus, rng):
     # requests — tests/test_prefix_cache.py covers that behavior).
     cfg = PagedConfig(max_slots=4, page_size=8, pages_per_slot=4, num_pages=9)
     eng = ServingEngine(
-        [head], params["tiger"], ladder=BucketLadder((1, 2), (4, 8)),
+        [head], params["tiger"], ladder=BucketLadder((1, 2), (8,)),
         max_batch=2, max_wait_ms=1.0, handle_signals=False, paged_config=cfg,
         prefix_cache=False,
     ).start()
@@ -320,7 +325,7 @@ def test_paged_continuous_batching_churn_under_pool_pressure(zoo, corpus, rng):
         r = eng.serve(fixed, timeout=60)
         dense = ServingEngine(
             [TigerGenerativeHead(models["tiger"], valid, top_k=4, name="tiger")],
-            params["tiger"], ladder=BucketLadder((1, 2), (4, 8)),
+            params["tiger"], ladder=BucketLadder((1, 2), (8,)),
             max_batch=2, max_wait_ms=1.0, handle_signals=False, paged=False,
         ).start()
         try:
@@ -348,6 +353,7 @@ def test_paged_drain_chaos_sigterm_midchurn(zoo, corpus, rng):
     eng = ServingEngine(
         [head], params["tiger"], ladder=BucketLadder((1, 2), (8,)),
         max_batch=2, max_wait_ms=1.0,
+        paged_config=PagedConfig(max_slots=2, page_size=8, pages_per_slot=4),
     )
     try:
         with chaos.inject(chaos.ChaosPlan(kill_at_step=2)):
