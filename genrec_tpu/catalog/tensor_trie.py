@@ -77,20 +77,30 @@ class TensorTrie:
     through ``jax.jit`` lowering into the compiled call.
     """
 
-    def __init__(self, keys, offsets, codebook_size: int):
+    def __init__(self, keys, offsets, codebook_size: int, weights=None):
         self.keys = keys          # (D, C) int32, per-row sorted, PAD_KEY-padded
         self.offsets = offsets    # (D, C+1) int32 CSR row index
         self.codebook_size = int(codebook_size)
+        # Per-node draft weight, aligned with ``keys``: by default the
+        # number of complete legal tuples below each node (leaf counts —
+        # the corpus-popularity signal the speculative drafter ranks
+        # trie-legal children by, ops/spec_tree.py). ``build`` can
+        # aggregate per-item scores instead (e.g. retrieval-head item
+        # scores mapped through the corpus index). Zeros when the
+        # builder has no signal: the drafter then ranks by code order.
+        if weights is None:
+            weights = np.zeros(np.shape(keys), np.float32)
+        self.weights = weights    # (D, C) float32, 0 on padding rows
 
     # -- pytree protocol (arrays are leaves, K is static) --------------------
 
     def tree_flatten(self):
-        return (self.keys, self.offsets), (self.codebook_size,)
+        return (self.keys, self.offsets, self.weights), (self.codebook_size,)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        keys, offsets = children
-        return cls(keys, offsets, aux[0])
+        keys, offsets, weights = children
+        return cls(keys, offsets, aux[0], weights)
 
     @property
     def depth(self) -> int:
@@ -104,12 +114,19 @@ class TensorTrie:
 
     @classmethod
     def build(cls, valid_ids: np.ndarray, codebook_size: int,
-              capacity: int | None = None) -> "TensorTrie":
+              capacity: int | None = None,
+              item_weights: np.ndarray | None = None) -> "TensorTrie":
         """Flatten (N, D) legal tuples into the padded runtime encoding.
 
         ``capacity`` pins an explicit rung (it must cover the widest
         step); by default the smallest ladder rung covering the catalog
         is used, so same-rung snapshots share executables.
+
+        ``item_weights`` (N,) optionally scores each tuple (e.g. a
+        retrieval head's item scores through the corpus index); each
+        trie node's draft weight is the SUM over the tuples below it.
+        Default: every tuple weighs 1, so node weight == leaf count
+        (corpus popularity), the zero-cost drafter signal.
         """
         valid_ids = np.asarray(valid_ids, np.int64)
         if valid_ids.ndim != 2 or valid_ids.size == 0:
@@ -118,13 +135,22 @@ class TensorTrie:
         K = int(codebook_size)
         if valid_ids.min() < 0 or valid_ids.max() >= K:
             raise ValueError(f"sem-id codes outside [0, {K})")
-        step_keys = []
+        w_items = (
+            np.ones(N, np.float64) if item_weights is None
+            else np.asarray(item_weights, np.float64).reshape(N)
+        )
+        step_keys, step_weights = [], []
         rank = np.zeros(N, np.int64)
         for t in range(D):
             k = rank * K + valid_ids[:, t]
             uniq = np.unique(k)
             step_keys.append(uniq)
             rank = np.searchsorted(uniq, k)
+            # Node weight = sum of item weights below the node (leaf
+            # count under the default all-ones weighting).
+            step_weights.append(
+                np.bincount(rank, weights=w_items, minlength=len(uniq))
+            )
         n_max = max(len(u) for u in step_keys)
         C = capacity_for(n_max) if capacity is None else int(capacity)
         if C < n_max:
@@ -138,18 +164,21 @@ class TensorTrie:
             )
         keys = np.full((D, C), PAD_KEY, np.int32)
         offsets = np.zeros((D, C + 1), np.int32)
+        weights = np.zeros((D, C), np.float32)
         for t, uniq in enumerate(step_keys):
             keys[t, : len(uniq)] = uniq
+            weights[t, : len(uniq)] = step_weights[t]
             # CSR row starts: node p's children begin where key p*K would
             # insert. Rows past the real node count collapse to empty
             # segments at n_keys (PAD_KEY sorts above every probe).
             offsets[t] = np.searchsorted(uniq, np.arange(C + 1) * K)
-        return cls(keys, offsets, K)
+        return cls(keys, offsets, K, weights)
 
     def device(self) -> "TensorTrie":
         """The same trie with its tensors as jax device arrays."""
         return TensorTrie(
-            jnp.asarray(self.keys), jnp.asarray(self.offsets), self.codebook_size
+            jnp.asarray(self.keys), jnp.asarray(self.offsets),
+            self.codebook_size, jnp.asarray(self.weights),
         )
 
     def n_nodes(self) -> list[int]:
@@ -182,6 +211,27 @@ class TensorTrie:
             row_keys = self.keys[steps]
             return jax.vmap(self._advance_row)(row_keys, prefix_idx, token)
 
+    def child_weights_ragged(self, prefix_idx: jax.Array,
+                             steps: jax.Array) -> jax.Array:
+        """Draft weight of every candidate child code, per-row step:
+        prefix_idx (S, ...) + steps (S,) -> (S, ..., K) float32 — the
+        node weight of the extended prefix where it is legal, 0 where it
+        is not (the speculative drafter masks illegal codes itself).
+        Same searchsorted gather as `legal_mask_ragged`, one extra
+        weight-row read."""
+        with jax.named_scope("trie_child_weights_ragged"):
+            row_keys = self.keys[steps]     # (S, C)
+            row_w = self.weights[steps]     # (S, C)
+
+            def one_row(keys_row, w_row, prefix):
+                K = self.codebook_size
+                cand = prefix[..., None] * K + jnp.arange(K, dtype=jnp.int32)
+                pos = jnp.clip(jnp.searchsorted(keys_row, cand), 0,
+                               keys_row.shape[0] - 1)
+                return jnp.where(keys_row[pos] == cand, w_row[pos], 0.0)
+
+            return jax.vmap(one_row)(row_keys, row_w, prefix_idx)
+
     # -- shared row kernels (sorted-gather binary search) --------------------
 
     def _mask_row(self, row_keys: jax.Array, prefix_idx: jax.Array) -> jax.Array:
@@ -206,6 +256,7 @@ class TensorTrie:
         return (
             tuple(int(s) for s in self.keys.shape),
             tuple(int(s) for s in self.offsets.shape),
+            tuple(int(s) for s in self.weights.shape),
             self.codebook_size,
         )
 

@@ -125,6 +125,25 @@ def log_serving_stats(logger, tracker, stats: Mapping[str, Any]) -> None:
             f"evictions={g.get('evictions', 0)} "
             f"invalidations={g.get('invalidations', 0)}"
         )
+    # Speculative tree decode: one line per spec head — codes committed
+    # per target invocation (1.0 == plain decode), draft acceptance, and
+    # the accept-length histogram — so "is speculation actually paying"
+    # reads off the same interval line as the pool gauges.
+    for head, g in (stats.get("spec") or {}).items():
+        slot_steps = g.get("slot_steps", 0)
+        accepted = g.get("accepted", 0)
+        hist = ",".join(
+            f"{k.rsplit('_', 1)[-1]}:{v}"
+            for k, v in sorted((g.get("accept_len_hist") or {}).items())
+        )
+        logger.info(
+            f"serving spec[{head}]: {g.get('codes_per_invocation', 0.0):.2f} "
+            f"codes/invocation ({accepted} codes over {slot_steps} "
+            f"slot-steps in {g.get('spec_steps', 0)} invocations; "
+            f"{accepted - slot_steps} speculated codes accepted, "
+            f"{g.get('drafted', 0)} tree tokens drafted), "
+            f"accept_len[{hist}]"
+        )
     # Device-memory ledger (obs/memory.py): one HBM line per head —
     # ledger total vs the declared budget with headroom %, so "how close
     # to OOM is this replica" reads off the same interval line as the

@@ -164,6 +164,46 @@ class _TorchMHA(nn.Module):
         out = out.transpose(0, 2, 1, 3).reshape(B, L, D)
         return self.out_proj(out), (k, v)
 
+    def decode_tree(self, x, k_pool, v_pool, block_tables, seq_lens, cache,
+                    topo, base_steps):
+        """Speculative tree-verification twin of `decode_paged`: one
+        parallel pass over every candidate-tree node (N replaces the
+        beam axis; ops/spec_tree.py holds the topology tables).
+
+        The paged-history partial is the same `paged_attention_stats`
+        read (nodes of a slot share its pages like beams do); the dense
+        suffix partial runs over each node's VIRTUAL cache — the
+        committed beam cache with ancestor K/V from this pass overlaid
+        at the speculated slots — through `ops.paged.tree_suffix_stats`,
+        whose score/mask/merge ops are the plain step's, so an accepted
+        path's output is bitwise the sequential steps'. The committed
+        ``cache`` is read, never written: a rejected branch leaves no
+        trace. Returns (out, (k_new, v_new) per-node projections).
+        """
+        from genrec_tpu.ops.paged import (
+            merge_attention_stats,
+            paged_attention_stats,
+            tree_suffix_stats,
+        )
+        from genrec_tpu.ops.spec_tree import tree_virtual_cache
+
+        B, N, D = x.shape
+        H, hd = self.num_heads, D // self.num_heads
+        q, k_new, v_new = jnp.split(self.in_proj(x), 3, axis=-1)
+        q = q.reshape(B, N, H, hd)
+        k_new = k_new.reshape(B, N, H, hd)
+        v_new = v_new.reshape(B, N, H, hd)
+        vc_k = tree_virtual_cache(cache["k"], k_new, topo, base_steps)
+        vc_v = tree_virtual_cache(cache["v"], v_new, topo, base_steps)
+        acc_h, m_h, l_h = paged_attention_stats(
+            q, k_pool, v_pool, block_tables, seq_lens
+        )
+        node_slots = base_steps[:, None] + jnp.asarray(topo.level)[None, :]
+        acc_s, m_s, l_s = tree_suffix_stats(q, vc_k, vc_v, node_slots)
+        out = merge_attention_stats(acc_h, m_h, l_h, acc_s, m_s, l_s)
+        out = out.astype(x.dtype).reshape(B, N, D)
+        return self.out_proj(out), (k_new, v_new)
+
     def decode_paged(self, x, k_pool, v_pool, block_tables, seq_lens, cache,
                      steps):
         """`decode` with PAGED history K/V and a per-row suffix slot.
@@ -325,6 +365,13 @@ class _PostNormDecoderLayer(nn.Module):
         )
         return self._post_attn(x, h, True), new_cache
 
+    def decode_tree(self, x, k_pool, v_pool, block_tables, seq_lens, cache,
+                    topo, base_steps):
+        h, kv = self.self_attn.decode_tree(
+            x, k_pool, v_pool, block_tables, seq_lens, cache, topo, base_steps
+        )
+        return self._post_attn(x, h, True), kv
+
 
 class CobraDecoder(nn.Module):
     hidden_dim: int = 768
@@ -380,6 +427,18 @@ class CobraDecoder(nn.Module):
             )
             new_caches.append(nc)
         return x, new_caches
+
+    def decode_tree(self, x, k_pools, v_pools, block_tables, seq_lens,
+                    caches, topo, base_steps):
+        """One parallel verification pass over every tree node, all
+        layers: x (B, N, dim) -> (out, per-layer (k_new, v_new))."""
+        node_kvs = []
+        for layer, kp, vp, cache in zip(self.layers, k_pools, v_pools, caches):
+            x, kv = layer.decode_tree(
+                x, kp, vp, block_tables, seq_lens, cache, topo, base_steps
+            )
+            node_kvs.append(kv)
+        return x, node_kvs
 
 
 class CobraEmbedding(nn.Module):
@@ -469,6 +528,18 @@ class CobraEmbedding(nn.Module):
         h = self.id_embed[offset].astype(self.dtype)
         pos = jnp.clip(base_pos + steps, 0, self.max_len - 1)
         h = h + self.pos_embed[pos][:, None].astype(self.dtype)
+        h = h + self.type_embed[0].astype(self.dtype)
+        return h
+
+    def suffix_token_tree(self, tok, node_slots, base_pos):
+        """`suffix_token_ragged` with PER-NODE suffix slots: tok (B, N),
+        node_slots (B, N) — each candidate-tree node embeds its drafted
+        token at its own speculated position (same per-element math, so
+        an accepted node's embedding is bitwise the plain step's)."""
+        offset = tok + (node_slots % self.n_codebooks) * self.id_vocab_size
+        h = self.id_embed[offset].astype(self.dtype)
+        pos = jnp.clip(base_pos[:, None] + node_slots, 0, self.max_len - 1)
+        h = h + self.pos_embed[pos].astype(self.dtype)
         h = h + self.type_embed[0].astype(self.dtype)
         return h
 
@@ -668,6 +739,22 @@ class Cobra(nn.Module):
         x = self.cobra_emb.suffix_token_ragged(tok, steps, base_pos)
         return self.decoder.decode_paged(
             x, k_pools, v_pools, block_tables, seq_lens, caches, steps
+        )
+
+    def decode_suffix_tree_paged(self, node_tok, topo, base_steps, base_pos,
+                                 k_pools, v_pools, block_tables, seq_lens,
+                                 caches):
+        """Speculative tree verification: hidden states for EVERY
+        candidate-tree node in one parallel suffix pass. ``base_steps``
+        is the level-0 suffix slot (the plain step's ``steps - 1``);
+        node n sits at slot base + level[n]. Returns (h (S, N, d_model),
+        per-layer (k_new, v_new)); the committed caches are read only.
+        """
+        node_slots = base_steps[:, None] + jnp.asarray(topo.level)[None, :]
+        x = self.cobra_emb.suffix_token_tree(node_tok, node_slots, base_pos)
+        return self.decoder.decode_tree(
+            x, k_pools, v_pools, block_tables, seq_lens, caches, topo,
+            base_steps,
         )
 
 
@@ -974,6 +1061,45 @@ def cobra_prefill_paged(model: Cobra, params, input_ids, vecs, block_tables,
     return k_pools, v_pools, init
 
 
+def _cobra_beam_update(model: Cobra, trie, logits_scaled, beam_tokens,
+                       beam_scores, prefix_idx, steps):
+    """One beam selection given this step's temperature-scaled (S, K, V)
+    logits — the post-logits math of the paged suffix step, factored out
+    so the speculative accept scan (`cobra_spec_tree_step`) replays the
+    SAME definition per tree level. Returns (beam_tokens, beam_scores,
+    prefix_idx, parent, tok)."""
+    from genrec_tpu.ops.trie import advance_ragged, legal_mask_ragged
+
+    S_, K, C = beam_tokens.shape
+    V = model.id_vocab_size
+    if trie is None:
+        logp = jax.nn.log_softmax(logits_scaled.astype(jnp.float32), axis=-1)
+    else:
+        legal = legal_mask_ragged(trie, prefix_idx, steps)
+        logp = jax.nn.log_softmax(
+            jnp.where(legal, logits_scaled, -1e32).astype(jnp.float32), axis=-1
+        )
+        logp = jnp.where(legal, logp, -1e32)
+
+    combined = (beam_scores[..., None] + logp).reshape(S_, K * V)
+    new_scores, idx = jax.lax.top_k(combined, K)
+    parent = idx // V
+    tok = idx % V
+    new_tokens = jnp.take_along_axis(beam_tokens, parent[..., None], axis=1)
+    hit = jnp.arange(C)[None, None, :] == steps[:, None, None]
+    new_tokens = jnp.where(hit, tok[..., None], new_tokens)
+    new_prefix = (
+        jnp.zeros_like(prefix_idx)
+        if trie is None
+        else advance_ragged(
+            trie,
+            jnp.take_along_axis(prefix_idx, parent, axis=1),
+            tok, steps,
+        )
+    )
+    return new_tokens, new_scores, new_prefix, parent, tok
+
+
 def cobra_paged_decode_step(
     model: Cobra,
     params,
@@ -992,10 +1118,7 @@ def cobra_paged_decode_step(
     per-row operand: the sparse head, trie tables, suffix slot and token
     write column are all row-selected.
     """
-    from genrec_tpu.ops.trie import advance_ragged, legal_mask_ragged
-
     C = model.n_codebooks
-    V = model.id_vocab_size
     S_, K, _ = state["beam_tokens"].shape
     caches = [
         {"k": state["cache_k"][:, i], "v": state["cache_v"][:, i]}
@@ -1025,32 +1148,9 @@ def cobra_paged_decode_step(
             (steps == c)[:, None, None], lc, logits
         )
     logits = logits / temperature
-    if trie is None:
-        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    else:
-        legal = legal_mask_ragged(trie, state["prefix_idx"], steps)
-        logp = jax.nn.log_softmax(
-            jnp.where(legal, logits, -1e32).astype(jnp.float32), axis=-1
-        )
-        logp = jnp.where(legal, logp, -1e32)
-
-    combined = (state["beam_scores"][..., None] + logp).reshape(S_, K * V)
-    beam_scores, idx = jax.lax.top_k(combined, K)
-    parent = idx // V
-    tok = idx % V
-    beam_tokens = jnp.take_along_axis(
-        state["beam_tokens"], parent[..., None], axis=1
-    )
-    hit = jnp.arange(C)[None, None, :] == steps[:, None, None]
-    beam_tokens = jnp.where(hit, tok[..., None], beam_tokens)
-    prefix_idx = (
-        jnp.zeros_like(state["prefix_idx"])
-        if trie is None
-        else advance_ragged(
-            trie,
-            jnp.take_along_axis(state["prefix_idx"], parent, axis=1),
-            tok, steps,
-        )
+    beam_tokens, beam_scores, prefix_idx, parent, _tok = _cobra_beam_update(
+        model, trie, logits, state["beam_tokens"], state["beam_scores"],
+        state["prefix_idx"], steps,
     )
     from genrec_tpu.models.t5transformer import gather_beam_caches
 
@@ -1068,6 +1168,162 @@ def cobra_paged_decode_step(
         "base_pos": state["base_pos"],
         "h_last": h_last,
     }
+
+
+def cobra_spec_tree_step(
+    model: Cobra,
+    params,
+    trie,
+    state: dict,
+    steps,
+    block_tables,
+    seq_lens,
+    k_pools,
+    v_pools,
+    fanout: int = 4,
+    depth: int | None = None,
+    temperature: float = 1.0,
+    draft_override=None,
+):
+    """Speculative tree decode for the COBRA suffix: commit between 1 and
+    ``depth + 1`` codebook positions per slot in ONE target invocation.
+
+    Same contract as `tiger_spec_tree_step`: draft trie-legal children
+    per beam (weight-ranked; plain code order when trie is None — the
+    free-decode correctness case), verify the whole tree in one parallel
+    suffix pass (`Cobra.decode_suffix_tree_paged`), replay
+    `_cobra_beam_update` — the plain step's own selection math — level
+    by level, and accept while every selection was a drafted edge.
+    Level 0 is exact, so the worst case equals plain decode step for
+    step, bit for bit. Returns (new_state, accept (S,) int32).
+    """
+    from genrec_tpu.ops.spec_tree import (
+        TreeTopology, commit_level_kv, match_drafted,
+    )
+    from genrec_tpu.ops.trie import advance_ragged, legal_topk_ragged
+
+    C = model.n_codebooks
+    S_, K, _ = state["beam_tokens"].shape
+    if depth is None:
+        depth = max(C - 2, 0)
+    depth = max(min(int(depth), C - 2), 0)
+    topo = TreeTopology(K, fanout, depth)
+    caches = [
+        {"k": state["cache_k"][:, i], "v": state["cache_v"][:, i]}
+        for i in range(state["cache_k"].shape[1])
+    ]
+
+    # -- draft ---------------------------------------------------------------
+    tok_prev = jnp.take_along_axis(
+        state["beam_tokens"], jnp.clip(steps - 1, 0, C - 1)[:, None, None], axis=2
+    )[:, :, 0]
+    levels_tok = [tok_prev]
+    draft_toks = []
+    cur_prefix = state["prefix_idx"]  # (S, N_prev), N_0 = K
+    for l in range(1, depth + 1):
+        step_l = jnp.minimum(steps + (l - 1), C - 1)
+        if draft_override is not None:
+            d_tok = jnp.asarray(draft_override[l - 1], jnp.int32)
+        elif trie is None:
+            # Free decode: no legality to expand — draft the first F
+            # codes (correctness-only; acceptance is incidental).
+            d_tok = jnp.broadcast_to(
+                jnp.arange(topo.fanouts[l - 1], dtype=jnp.int32),
+                (S_, cur_prefix.shape[1], topo.fanouts[l - 1]),
+            )
+        else:
+            d_tok, _ = legal_topk_ragged(trie, cur_prefix, step_l,
+                                         topo.fanouts[l - 1])
+        draft_toks.append(d_tok)
+        levels_tok.append(d_tok.reshape(S_, -1))
+        if trie is None:
+            cur_prefix = jnp.zeros(
+                (S_, d_tok.shape[1] * d_tok.shape[2]), jnp.int32)
+        else:
+            cur_prefix = advance_ragged(
+                trie, jnp.broadcast_to(cur_prefix[..., None], d_tok.shape),
+                d_tok, step_l,
+            ).reshape(S_, -1)
+    node_tok = jnp.concatenate(levels_tok, axis=1)  # (S, N)
+
+    # -- verify: one parallel suffix pass over the whole tree ----------------
+    h_nodes, node_kvs = model.apply(
+        {"params": params}, node_tok, topo, steps - 1, state["base_pos"],
+        k_pools, v_pools, block_tables, seq_lens, caches,
+        method=Cobra.decode_suffix_tree_paged,
+    )  # (S, N, d)
+    node_steps = steps[:, None] + jnp.asarray(topo.level)[None, :]
+    c_idx = jnp.clip(node_steps, 0, C - 1)
+    h_tail = jnp.take_along_axis(
+        state["tail_hidden"], c_idx[..., None], axis=1
+    )  # (S, N, d): partial rows read their prefill tail at every level
+    h_c_nodes = jnp.where(
+        state["full"][:, None, None], h_nodes, h_tail.astype(h_nodes.dtype)
+    )
+    logits_nodes = None
+    for c in range(C):  # every sparse head computed, node-selected (C tiny)
+        lc = _apply_head(model, params, c, h_c_nodes)
+        logits_nodes = lc if logits_nodes is None else jnp.where(
+            (node_steps == c)[..., None], lc, logits_nodes
+        )
+    logits_nodes = logits_nodes / temperature
+
+    # -- accept scan: replay the plain update along the drafted tree --------
+    run_tokens = com_tokens = state["beam_tokens"]
+    run_scores = com_scores = state["beam_scores"]
+    run_prefix = com_prefix = state["prefix_idx"]
+    run_ck = com_ck = [c["k"] for c in caches]
+    run_cv = com_cv = [c["v"] for c in caches]
+    com_h_last = state["h_last"]
+    cur_local = jnp.broadcast_to(jnp.arange(K, dtype=jnp.int32)[None], (S_, K))
+    ok = jnp.ones((S_,), bool)
+    accept = jnp.zeros((S_,), jnp.int32)
+    for j in range(depth + 1):
+        applied = ok & (steps + j <= C - 1)
+        step_j = jnp.minimum(steps + j, C - 1)
+        flat_idx = topo.level_offsets[j] + cur_local  # (S, K)
+        logits_j = jnp.take_along_axis(logits_nodes, flat_idx[..., None], axis=1)
+        new_tokens, new_scores, new_prefix, parent, sel_tok = _cobra_beam_update(
+            model, trie, logits_j, run_tokens, run_scores, run_prefix, step_j,
+        )
+        # This level's suffix-cache slot is steps - 1 + j.
+        new_ck, new_cv = commit_level_kv(
+            node_kvs, run_ck, run_cv, flat_idx, parent, step_j - 1
+        )
+        h_c_sel = jnp.take_along_axis(h_c_nodes, flat_idx[..., None], axis=1)
+        new_h_last = jnp.take_along_axis(
+            h_c_sel, parent[..., None], axis=1
+        ).astype(jnp.float32)
+        ap2 = applied[:, None]
+        ap3 = applied[:, None, None]
+        ap5 = applied[:, None, None, None, None]
+        com_tokens = jnp.where(ap3, new_tokens, com_tokens)
+        com_scores = jnp.where(ap2, new_scores, com_scores)
+        com_prefix = jnp.where(ap2, new_prefix, com_prefix)
+        com_h_last = jnp.where(ap3, new_h_last, com_h_last)
+        com_ck = [jnp.where(ap5, n, c) for n, c in zip(new_ck, com_ck)]
+        com_cv = [jnp.where(ap5, n, c) for n, c in zip(new_cv, com_cv)]
+        accept = accept + applied.astype(jnp.int32)
+        if j < depth:
+            parent_local = jnp.take_along_axis(cur_local, parent, axis=1)
+            matched, child_f = match_drafted(draft_toks[j], parent_local, sel_tok)
+            ok = applied & matched
+            cur_local = parent_local * topo.fanouts[j] + child_f
+            run_tokens, run_scores, run_prefix = new_tokens, new_scores, new_prefix
+            run_ck, run_cv = new_ck, new_cv
+
+    new_state = {
+        "beam_tokens": com_tokens,
+        "beam_scores": com_scores,
+        "prefix_idx": com_prefix,
+        "cache_k": jnp.stack(com_ck, axis=1),
+        "cache_v": jnp.stack(com_cv, axis=1),
+        "tail_hidden": state["tail_hidden"],
+        "full": state["full"],
+        "base_pos": state["base_pos"],
+        "h_last": com_h_last,
+    }
+    return new_state, accept
 
 
 def cobra_generate_paged(

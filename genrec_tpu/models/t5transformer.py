@@ -198,6 +198,57 @@ class T5Attention(nn.Module):
         out = jnp.einsum("bkhs,bkshd->bkhd", attn, cv).reshape(B, K, self.d_model)
         return self.o(out), {"k": ck, "v": cv}
 
+    def decode_self_tree(self, x, cache, topo, steps):
+        """Speculative tree-verification self-attention: one parallel
+        pass over every candidate-tree node (ops/spec_tree.py).
+
+        x: (B, N, d_model) — N tree nodes per slot replacing the K-beam
+        axis. cache: the COMMITTED (B, K, S, H, hd) suffix cache (read
+        only — commitment happens in the accept scan, so a rejected
+        branch leaves it untouched). steps: (B,) the slots' current
+        decode positions. Node n attends its root beam's committed
+        prefix plus its ancestors' K/V from THIS pass, overlaid at the
+        speculated slots through the static ancestor tables — the fixed
+        tree-attention mask — with the same score/bias/mask/softmax ops
+        as `decode_self_ragged`, so an accepted path's logits are
+        bitwise the sequential plain steps'.
+
+        Returns (out (B, N, d_model), (k_new, v_new) each (B, N, H, hd))
+        — the per-node K/V the accept scan commits for accepted levels.
+        """
+        from genrec_tpu.ops.spec_tree import tree_virtual_cache
+
+        B, N, _ = x.shape
+        H, hd = self.n_heads, self.d_model // self.n_heads
+        k_new, v_new = jnp.split(self.kv(x), 2, axis=-1)
+        q = self.q(x).reshape(B, N, H, hd)
+        k_new = k_new.reshape(B, N, H, hd)
+        v_new = v_new.reshape(B, N, H, hd)
+        S = cache["k"].shape[2]
+        node_steps = steps[:, None] + jnp.asarray(topo.level)[None, :]
+        vk = tree_virtual_cache(cache["k"], k_new, topo, steps)
+        vv = tree_virtual_cache(cache["v"], v_new, topo, steps)
+        scores = jnp.einsum("bkhd,bkshd->bkhs", q, vk) * (hd**-0.5)
+        scores = scores.astype(jnp.float32)
+        if self.has_relative_bias:
+            rel = jnp.arange(S)[None, None, :] - node_steps[:, :, None]
+            buckets = t5_relative_position_bucket(
+                rel, self.num_relative_buckets, self.max_distance,
+                bidirectional=True,
+            )  # (B, N, S)
+            head_offset = jnp.arange(self.n_heads)[:, None] * self.num_relative_buckets
+            bias = self.rel_bias[
+                buckets[:, :, None, :] + head_offset[None, None], 0
+            ]  # (B, N, H, S)
+            scores = scores + bias
+        scores = jnp.where(
+            jnp.arange(S)[None, None, None, :] > node_steps[:, :, None, None],
+            _NEG, scores,
+        )
+        attn = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+        out = jnp.einsum("bkhs,bkshd->bkhd", attn, vv).reshape(B, N, self.d_model)
+        return self.o(out), (k_new, v_new)
+
     def project_kv(self, memory):
         """Cross-attention K/V from the un-expanded encoder memory, computed
         once per eval batch: (B, Lm, d) -> two (B, H, Lm, hd)."""
@@ -338,6 +389,24 @@ class TransformerBlock(nn.Module):
         h = self.ff(self.norm2(x), deterministic=True)
         return x + h, new_cache
 
+    def decode_step_tree(self, x, cache, k_pool, v_pool, block_tables,
+                         seq_lens, topo, steps):
+        """`decode_step_paged` over tree nodes: tree self-attention
+        against the committed cache + in-pass ancestors; cross-attention
+        reads the SAME paged pages (the node axis rides where the beam
+        axis did — beams/nodes of a slot share its pages, nothing is
+        remapped). Returns (out, (k_new, v_new)) per-node K/V instead of
+        an updated cache — commitment is the accept scan's job."""
+        h, kv = self.self_attn.decode_self_tree(self.norm1(x), cache, topo, steps)
+        x = x + h
+        if self.cross_attn:
+            h = self.cross.decode_cross_paged(
+                self.norm_cross(x), k_pool, v_pool, block_tables, seq_lens
+            )
+            x = x + h
+        h = self.ff(self.norm2(x), deterministic=True)
+        return x + h, kv
+
 
 class TransformerEncoder(nn.Module):
     dim: int
@@ -433,6 +502,19 @@ class TransformerDecoder(nn.Module):
             )
             new_caches.append(nc)
         return x, new_caches
+
+    def decode_tree(self, x, caches, k_pools, v_pools, block_tables,
+                    seq_lens, topo, steps):
+        """One parallel verification pass over every tree node, all
+        layers: x (B, N, dim) -> (out, per-layer (k_new, v_new) node
+        K/V). The committed caches are read, never written."""
+        node_kvs = []
+        for layer, cache, kp, vp in zip(self.layers, caches, k_pools, v_pools):
+            x, kv = layer.decode_step_tree(
+                x, cache, kp, vp, block_tables, seq_lens, topo, steps
+            )
+            node_kvs.append(kv)
+        return x, node_kvs
 
 
 def init_decode_caches(depth: int, batch: int, beams: int, max_len: int,

@@ -322,6 +322,35 @@ class Tiger(nn.Module):
         logits = self._mask_pad_logits(self.output_head(x))
         return logits.astype(jnp.float32), new_caches
 
+    def decode_tree_paged(self, node_tok, topo, steps, caches, k_pools,
+                          v_pools, block_tables, seq_lens):
+        """Speculative tree verification: logits for EVERY candidate-tree
+        node in one parallel decoder pass (ops/spec_tree.py).
+
+        node_tok: (S, N) — level-major flat node inputs: level-0 nodes
+        carry each beam's last committed token (exactly the plain step's
+        input; BOS where the slot is at step 0), level-l nodes carry the
+        drafted step-(t+l-1) candidates. Each node's logits are computed
+        with the same per-element ops as `decode_step_paged` would use
+        at its step, so an accepted path is bitwise the sequential plain
+        steps. Returns (logits (S, N, V) fp32, per-layer (k_new, v_new))
+        — the committed caches in ``caches`` are read, never written.
+        """
+        S_, N = node_tok.shape
+        node_steps = steps[:, None] + jnp.asarray(topo.level)[None, :]
+        bos = jnp.broadcast_to(
+            self.bos_embedding.astype(self.dtype), (S_, N, self.embedding_dim)
+        )
+        tok_type = jnp.clip(node_steps - 1, 0, self.sem_id_dim - 1)
+        emb = self.sem_id_embedding(node_tok, tok_type)
+        x = jnp.where((node_steps == 0)[..., None], bos, emb)
+        x = self.in_proj(self.norm(x))
+        x, node_kvs = self.transformer.decoder.decode_tree(
+            x, caches, k_pools, v_pools, block_tables, seq_lens, topo, steps
+        )
+        logits = self._mask_pad_logits(self.output_head(x))
+        return logits.astype(jnp.float32), node_kvs
+
     def decode_step_paged(self, last_tok, caches, k_pools, v_pools,
                           block_tables, seq_lens, steps):
         """`decode_step_cached` over PAGED cross-attention K/V with a
@@ -492,21 +521,78 @@ def tiger_generate(
 # `tiger_generate` (pinned <=1e-5 in tests/test_paged_parity.py).
 
 
-def init_tiger_paged_state(model: Tiger, n_slots: int, beams: int):
+def init_tiger_paged_state(model: Tiger, n_slots: int, beams: int,
+                           draft_hint: bool = False):
     """Zeroed slot-major decode state. cache_k/cache_v stack the per-layer
     suffix caches on axis 1 so the whole state is a flat dict of arrays
-    (the engine scatters admitted rows into it host-side)."""
+    (the engine scatters admitted rows into it host-side).
+    ``draft_hint=True`` (speculative engines) adds the per-slot step-0
+    logit window the prefill computes for the drafter."""
     nl = model.n_layers // 2
     H = model.num_heads
     hd = model.attn_dim // H
     D = model.sem_id_dim
-    return {
+    state = {
         "beam_seqs": jnp.zeros((n_slots, beams, D), jnp.int32),
         "beam_logps": jnp.zeros((n_slots, beams), jnp.float32),
         "prefix_idx": jnp.zeros((n_slots, beams), jnp.int32),
         "cache_k": jnp.zeros((n_slots, nl, beams, D, H, hd), model.dtype),
         "cache_v": jnp.zeros((n_slots, nl, beams, D, H, hd), model.dtype),
     }
+    if draft_hint:
+        state["logits0"] = jnp.zeros(
+            (n_slots, model.num_item_embeddings), jnp.float32
+        )
+    return state
+
+
+def _tiger_beam_update(model: Tiger, trie, logits, beam_seqs, beam_logps,
+                       prefix_idx, steps, rng, temperature: float,
+                       sample_factor: int):
+    """One constrained-beam selection given this step's (S, K, V) logits
+    — the post-logits math of the paged decode step, factored out so the
+    speculative accept scan (`tiger_spec_tree_step`) replays the SAME
+    definition per tree level: spec == plain is structural, not a
+    parallel implementation kept in sync by hand.
+
+    Returns (beam_seqs, beam_logps, prefix_idx, sel_parent, sel_tok).
+    """
+    from genrec_tpu.ops.trie import advance_ragged, legal_mask_ragged
+
+    S_, K, D = beam_seqs.shape
+    Kcb = model.num_item_embeddings
+    KK = min(K * sample_factor, Kcb)
+    flat = logits.reshape(S_ * K, -1)
+    window = jax.vmap(
+        lambda row, st: jax.lax.dynamic_slice(row, (st * Kcb,), (Kcb,))
+    )(flat, jnp.repeat(steps, K))  # per-row vocab window at its own step
+    legal = legal_mask_ragged(trie, prefix_idx, steps).reshape(S_ * K, Kcb)
+    masked = jnp.where(legal, window, -1e32)
+    logp = jax.nn.log_softmax(masked / temperature, axis=-1)
+
+    perturbed = logp if rng is None else logp + jax.random.gumbel(rng, logp.shape)
+    _, cand_tok = jax.lax.top_k(perturbed, KK)
+    cand_logp = jnp.take_along_axis(logp, cand_tok, axis=1)
+    cand_legal = jnp.take_along_axis(legal, cand_tok, axis=1)
+    cand_logp = jnp.where(cand_legal, cand_logp, -1e32)
+
+    total = (beam_logps.reshape(S_ * K, 1) + cand_logp).reshape(S_, K * KK)
+    toks = cand_tok.reshape(S_, K * KK)
+    parents = jnp.broadcast_to(jnp.arange(K)[:, None], (K, KK)).reshape(1, K * KK)
+    parents = jnp.broadcast_to(parents, (S_, K * KK))
+
+    parent_prefix = jnp.take_along_axis(prefix_idx, parents, axis=1)
+    keys = parent_prefix * Kcb + toks
+    top_scores, top_idx = jax.vmap(lambda s, c: _dedup_top_k(s, c, K))(total, keys)
+
+    sel_parent = jnp.take_along_axis(parents, top_idx, axis=1)  # (S, K)
+    sel_tok = jnp.take_along_axis(toks, top_idx, axis=1)
+    new_seqs = jnp.take_along_axis(beam_seqs, sel_parent[..., None], axis=1)
+    hit = jnp.arange(D)[None, None, :] == steps[:, None, None]
+    new_seqs = jnp.where(hit, sel_tok[..., None], new_seqs)
+    sel_prefix = jnp.take_along_axis(prefix_idx, sel_parent, axis=1)
+    new_prefix = advance_ragged(trie, sel_prefix, sel_tok, steps)
+    return new_seqs, top_scores, new_prefix, sel_parent, sel_tok
 
 
 def tiger_paged_decode_step(
@@ -533,11 +619,7 @@ def tiger_paged_decode_step(
     Inactive/garbage rows (the engine's free slots) compute harmlessly —
     nothing here reduces across rows.
     """
-    from genrec_tpu.ops.trie import advance_ragged, legal_mask_ragged
-
     S_, K, D = state["beam_seqs"].shape
-    Kcb = model.num_item_embeddings
-    KK = min(K * sample_factor, Kcb)
     caches = [
         {"k": state["cache_k"][:, i], "v": state["cache_v"][:, i]}
         for i in range(state["cache_k"].shape[1])
@@ -550,56 +632,185 @@ def tiger_paged_decode_step(
         {"params": params}, last_tok, caches, k_pools, v_pools,
         block_tables, seq_lens, steps, method=Tiger.decode_step_paged,
     )  # (S, K, V)
-    flat = logits.reshape(S_ * K, -1)
-    window = jax.vmap(
-        lambda row, st: jax.lax.dynamic_slice(row, (st * Kcb,), (Kcb,))
-    )(flat, jnp.repeat(steps, K))  # per-row vocab window at its own step
-    legal = legal_mask_ragged(trie, state["prefix_idx"], steps).reshape(S_ * K, Kcb)
-    masked = jnp.where(legal, window, -1e32)
-    logp = jax.nn.log_softmax(masked / temperature, axis=-1)
-
-    perturbed = logp if rng is None else logp + jax.random.gumbel(rng, logp.shape)
-    _, cand_tok = jax.lax.top_k(perturbed, KK)
-    cand_logp = jnp.take_along_axis(logp, cand_tok, axis=1)
-    cand_legal = jnp.take_along_axis(legal, cand_tok, axis=1)
-    cand_logp = jnp.where(cand_legal, cand_logp, -1e32)
-
-    total = (state["beam_logps"].reshape(S_ * K, 1) + cand_logp).reshape(S_, K * KK)
-    toks = cand_tok.reshape(S_, K * KK)
-    parents = jnp.broadcast_to(jnp.arange(K)[:, None], (K, KK)).reshape(1, K * KK)
-    parents = jnp.broadcast_to(parents, (S_, K * KK))
-
-    parent_prefix = jnp.take_along_axis(state["prefix_idx"], parents, axis=1)
-    keys = parent_prefix * Kcb + toks
-    top_scores, top_idx = jax.vmap(lambda s, c: _dedup_top_k(s, c, K))(total, keys)
-
-    sel_parent = jnp.take_along_axis(parents, top_idx, axis=1)  # (S, K)
-    sel_tok = jnp.take_along_axis(toks, top_idx, axis=1)
-    beam_seqs = jnp.take_along_axis(state["beam_seqs"], sel_parent[..., None], axis=1)
-    hit = jnp.arange(D)[None, None, :] == steps[:, None, None]
-    beam_seqs = jnp.where(hit, sel_tok[..., None], beam_seqs)
-    sel_prefix = jnp.take_along_axis(state["prefix_idx"], sel_parent, axis=1)
-    prefix_idx = advance_ragged(trie, sel_prefix, sel_tok, steps)
+    beam_seqs, beam_logps, prefix_idx, sel_parent, _ = _tiger_beam_update(
+        model, trie, logits, state["beam_seqs"], state["beam_logps"],
+        state["prefix_idx"], steps, rng, temperature, sample_factor,
+    )
     caches = gather_beam_caches(caches, sel_parent)
 
     return {
         "beam_seqs": beam_seqs,
-        "beam_logps": top_scores,
+        "beam_logps": beam_logps,
         "prefix_idx": prefix_idx,
         "cache_k": jnp.stack([c["k"] for c in caches], axis=1),
         "cache_v": jnp.stack([c["v"] for c in caches], axis=1),
     }
 
 
+def tiger_spec_tree_step(
+    model: Tiger,
+    params,
+    trie,
+    state: dict,
+    steps,
+    block_tables,
+    seq_lens,
+    k_pools,
+    v_pools,
+    fanout: int = 4,
+    depth: int | None = None,
+    temperature: float = 0.2,
+    sample_factor: int = 6,
+    draft_override=None,
+):
+    """Speculative tree decode: commit between 1 and ``depth + 1``
+    constrained-beam positions per slot in ONE target-model invocation.
+
+    Draft: per beam, the top-``fanout`` trie-legal continuations ranked
+    by the trie's draft weights (`ops.trie.legal_topk_ragged`), expanded
+    ``depth`` levels into a static-topology tree. Verify: one parallel
+    decoder pass over every node (`Tiger.decode_tree_paged`) — level 0
+    is the current step's own forward, always exact. Accept: replay the
+    plain beam update (`_tiger_beam_update`, the same definition the
+    plain step runs) level by level on the verified logits; a level
+    commits only while every selected (parent, token) pair was a drafted
+    tree edge, so the result equals running the plain step accept-many
+    times, bit for bit, and the drafter-disagrees worst case commits
+    exactly 1 (plain decode's rate — never slower in steps, never
+    different in output).
+
+    Deterministic beams only (the serving contract): sampling would need
+    per-level rngs that the plain path draws sequentially.
+    ``draft_override`` (tests) replaces the drafter's level-l candidate
+    arrays, e.g. to force full rejection.
+
+    Returns (new_state, accept (S,) int32 codes committed per slot).
+    """
+    from genrec_tpu.ops.spec_tree import (
+        TreeTopology, commit_level_kv, match_drafted,
+    )
+    from genrec_tpu.ops.trie import advance_ragged, legal_topk_ragged
+
+    S_, K, D = state["beam_seqs"].shape
+    if depth is None:
+        depth = D - 1
+    depth = max(min(int(depth), D - 1), 0)
+    topo = TreeTopology(K, fanout, depth)
+    caches = [
+        {"k": state["cache_k"][:, i], "v": state["cache_v"][:, i]}
+        for i in range(state["cache_k"].shape[1])
+    ]
+
+    # -- draft the candidate tree (trie gathers only — no model work) --------
+    last_tok = jnp.take_along_axis(
+        state["beam_seqs"], jnp.clip(steps - 1, 0, D - 1)[:, None, None], axis=2
+    )[:, :, 0]
+    levels_tok = [last_tok]  # level-0 inputs == the plain step's inputs
+    draft_toks = []
+    cur_prefix = state["prefix_idx"]  # (S, N_prev), N_0 = K
+    for l in range(1, depth + 1):
+        step_l = jnp.minimum(steps + (l - 1), D - 1)  # clip: overdeep levels
+        if draft_override is not None:                # are never accepted
+            d_tok = jnp.asarray(draft_override[l - 1], jnp.int32)
+        else:
+            d_tok, _ = legal_topk_ragged(trie, cur_prefix, step_l,
+                                         topo.fanouts[l - 1])
+            if l == 1 and "logits0" in state:
+                # Step-0 drafting from the model's OWN prefill-computed
+                # logits (see tiger_prefill_paged): the root codebook's
+                # branching carries no popularity signal, but the top-F
+                # of the step-0 window covers the verified beam almost
+                # surely. Rows past step 0 keep the trie-weight draft.
+                _, hint = jax.lax.top_k(state["logits0"],
+                                        topo.fanouts[0])  # (S, F1)
+                d_tok = jnp.where(
+                    (steps == 0)[:, None, None],
+                    jnp.broadcast_to(hint[:, None, :], d_tok.shape
+                                     ).astype(jnp.int32),
+                    d_tok,
+                )
+        draft_toks.append(d_tok)  # (S, N_{l-1}, F)
+        levels_tok.append(d_tok.reshape(S_, -1))
+        cur_prefix = advance_ragged(
+            trie, jnp.broadcast_to(cur_prefix[..., None], d_tok.shape),
+            d_tok, step_l,
+        ).reshape(S_, -1)
+    node_tok = jnp.concatenate(levels_tok, axis=1)  # (S, N)
+
+    # -- verify: one parallel pass over the whole tree -----------------------
+    logits_all, node_kvs = model.apply(
+        {"params": params}, node_tok, topo, steps, caches, k_pools, v_pools,
+        block_tables, seq_lens, method=Tiger.decode_tree_paged,
+    )  # (S, N, V), per-layer (k_new, v_new)
+
+    # -- accept scan: replay the plain update along the drafted tree --------
+    run_seqs = com_seqs = state["beam_seqs"]
+    run_logps = com_logps = state["beam_logps"]
+    run_prefix = com_prefix = state["prefix_idx"]
+    run_ck = com_ck = [c["k"] for c in caches]
+    run_cv = com_cv = [c["v"] for c in caches]
+    cur_local = jnp.broadcast_to(jnp.arange(K, dtype=jnp.int32)[None], (S_, K))
+    ok = jnp.ones((S_,), bool)
+    accept = jnp.zeros((S_,), jnp.int32)
+    for j in range(depth + 1):
+        applied = ok & (steps + j <= D - 1)  # (S,) — per-slot acceptance
+        step_j = jnp.minimum(steps + j, D - 1)
+        flat_idx = topo.level_offsets[j] + cur_local  # (S, K) node ids
+        logits_j = jnp.take_along_axis(logits_all, flat_idx[..., None], axis=1)
+        new_seqs, new_logps, new_prefix, sel_parent, sel_tok = _tiger_beam_update(
+            model, trie, logits_j, run_seqs, run_logps, run_prefix, step_j,
+            None, temperature, sample_factor,
+        )
+        new_ck, new_cv = commit_level_kv(
+            node_kvs, run_ck, run_cv, flat_idx, sel_parent, step_j
+        )
+        ap2 = applied[:, None]
+        ap5 = applied[:, None, None, None, None]
+        com_seqs = jnp.where(applied[:, None, None], new_seqs, com_seqs)
+        com_logps = jnp.where(ap2, new_logps, com_logps)
+        com_prefix = jnp.where(ap2, new_prefix, com_prefix)
+        com_ck = [jnp.where(ap5, n, c) for n, c in zip(new_ck, com_ck)]
+        com_cv = [jnp.where(ap5, n, c) for n, c in zip(new_cv, com_cv)]
+        accept = accept + applied.astype(jnp.int32)
+        if j < depth:
+            parent_local = jnp.take_along_axis(cur_local, sel_parent, axis=1)
+            matched, child_f = match_drafted(draft_toks[j], parent_local, sel_tok)
+            ok = applied & matched
+            cur_local = parent_local * topo.fanouts[j] + child_f
+            run_seqs, run_logps, run_prefix = new_seqs, new_logps, new_prefix
+            run_ck, run_cv = new_ck, new_cv
+
+    new_state = {
+        "beam_seqs": com_seqs,
+        "beam_logps": com_logps,
+        "prefix_idx": com_prefix,
+        "cache_k": jnp.stack(com_ck, axis=1),
+        "cache_v": jnp.stack(com_cv, axis=1),
+    }
+    return new_state, accept
+
+
 def tiger_prefill_paged(model: Tiger, params, user_input_ids, item_input_ids,
                         token_type_ids, seq_mask, block_tables,
-                        k_pools, v_pools):
+                        k_pools, v_pools, trie=None, draft_hint: bool = False):
     """Bucketed prefill that writes its cross-attention K/V straight into
-    the page pools. Returns (k_pools, v_pools, seq_lens) — seq_lens is
-    the per-row valid KV length (user token + real sem-id tokens), which
-    assumes the serving layout's CONTIGUOUS valid prefix in seq_mask.
-    Rows padded beyond their page allocation scatter into the reserved
-    null page (block-table entry 0) and are never read unmasked.
+    the page pools. Returns (k_pools, v_pools, seq_lens, extras) —
+    seq_lens is the per-row valid KV length (user token + real sem-id
+    tokens), which assumes the serving layout's CONTIGUOUS valid prefix
+    in seq_mask. Rows padded beyond their page allocation scatter into
+    the reserved null page (block-table entry 0) and are never read
+    unmasked.
+
+    ``draft_hint=True`` (the speculative engine) additionally runs the
+    single BOS decoder position against the fresh encoder memory and
+    returns ``extras["logits0"]`` — the trie-masked step-0 vocab window.
+    That is the "head's own logits" drafter signal: TIGER's step-0
+    branching is the whole root codebook, where popularity ranking has
+    no model signal, but the model's OWN step-0 scores drafted at
+    prefill cover the verified step-0 beam almost surely (a near-free
+    extra decode position amortized into the prefill pass; it only needs
+    to RANK candidates, so dense-vs-paged float association is
+    harmless).
     """
     from genrec_tpu.ops.paged import write_pages
 
@@ -608,13 +819,29 @@ def tiger_prefill_paged(model: Tiger, params, user_input_ids, item_input_ids,
         seq_mask, method=Tiger.encode_for_decode,
     )
     seq_lens = (~pad).sum(axis=1).astype(jnp.int32)
+    extras = {}
+    if draft_hint:
+        B = pad.shape[0]
+        caches = init_decode_caches(
+            len(cross_kvs), B, 1, model.sem_id_dim, model.num_heads,
+            model.attn_dim, model.dtype,
+        )
+        logits, _ = model.apply(
+            {"params": params}, None, caches, cross_kvs, pad, 0,
+            method=Tiger.decode_step_cached,
+        )  # (B, 1, V)
+        window = logits[:, 0, : model.num_item_embeddings]
+        if trie is not None:
+            legal = trie.legal_mask(jnp.zeros((B,), jnp.int32), 0)
+            window = jnp.where(legal, window, -jnp.inf)
+        extras["logits0"] = window.astype(jnp.float32)
     k_pools = tuple(
         write_pages(pool, block_tables, kv[0]) for pool, kv in zip(k_pools, cross_kvs)
     )
     v_pools = tuple(
         write_pages(pool, block_tables, kv[1]) for pool, kv in zip(v_pools, cross_kvs)
     )
-    return k_pools, v_pools, seq_lens
+    return k_pools, v_pools, seq_lens, extras
 
 
 def tiger_generate_paged(
@@ -654,7 +881,7 @@ def tiger_generate_paged(
     zeros = lambda: tuple(
         jnp.zeros((num_pages, page_size, H, hd), model.dtype) for _ in range(nl)
     )
-    k_pools, v_pools, seq_lens = tiger_prefill_paged(
+    k_pools, v_pools, seq_lens, _ = tiger_prefill_paged(
         model, params, user_input_ids, item_input_ids, token_type_ids,
         seq_mask, block_tables, zeros(), zeros(),
     )

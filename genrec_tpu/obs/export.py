@@ -42,7 +42,15 @@ _COUNTER_LEAVES = frozenset({
     "handoffs_sent", "handoffs_admitted", "handoffs_refused",
     "handoffs_resubmitted", "transfer_bytes", "decode_worker_deaths",
     "prefill_worker_deaths", "prefills", "deferred", "admitted",
-})
+    # Speculative tree decode (genrec_spec_<head>_*): invocation/drafted/
+    # accepted/slot-step totals; codes_per_invocation stays a gauge.
+    "spec_steps", "drafted", "accepted", "slot_steps",
+}) | frozenset(
+    # Accept-length histogram leaves (genrec_spec_<head>_accept_len_hist
+    # _accept_len_N): one bucket per possible accept length — depth is
+    # bounded by the sem-id tuple length, so 16 covers any real head.
+    f"accept_len_{n}" for n in range(1, 17)
+)
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
 

@@ -122,6 +122,36 @@ def _stats_fallback(q, k_pool, v_pool, block_tables, seq_lens):
     return acc, m, l
 
 
+def tree_suffix_stats(q, vc_k, vc_v, node_steps):
+    """Flash stats of per-NODE queries over per-node virtual suffix
+    caches with the tree-causal mask — the speculative-decode twin of
+    the dense suffix partial in COBRA's paged suffix step.
+
+    q: (S, N, H, hd) — one query per tree node (N replaces the beam
+    axis). vc_k/vc_v: (S, N, Sc, H, hd) — each node's virtual cache
+    (committed beam cache + ancestor K/V, ops/spec_tree.
+    tree_virtual_cache). node_steps: (S, N) — the node's own cache slot;
+    positions past it (other branches, garbage tail) score -1e9 inside
+    the softmax, the same additive-mask semantics as the plain step, so
+    an accepted path's stats are bitwise the plain step's.
+
+    Returns (acc, m, l) fp32, mergeable through `merge_attention_stats`
+    with the paged-history partial exactly like the plain suffix step.
+    """
+    hd = q.shape[-1]
+    Sc = vc_k.shape[2]
+    s = jnp.einsum("bkhd,bkshd->bkhs", q, vc_k).astype(jnp.float32) * (hd**-0.5)
+    s = jnp.where(
+        jnp.arange(Sc)[None, None, None, :] > node_steps[:, :, None, None],
+        NEG, s,
+    )
+    m = s.max(axis=-1)
+    e = jnp.exp(s - m[..., None])
+    l = e.sum(axis=-1)
+    acc = jnp.einsum("bkhs,bkshd->bkhd", e, vc_v.astype(jnp.float32))
+    return acc, m, l
+
+
 def merge_attention_stats(acc_a, m_a, l_a, acc_b, m_b, l_b):
     """Combine two flash partials into the jointly-softmaxed output.
 

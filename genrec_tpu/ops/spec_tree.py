@@ -1,0 +1,180 @@
+"""Static tree topology + shared primitives for speculative tree decode.
+
+Sem-id decoding pays one target-model executable invocation per emitted
+code even though tuples are short (D≈3-4) and the legal continuations are
+already materialized on device (the trie). Tree speculation (EAGLE-style
+verification, PAPERS.md arxiv 2603.08088) collapses that: draft a small
+tree of candidate sem-id paths per slot from the trie + its draft
+weights (ops/trie.legal_topk_ragged), run ONE parallel transformer pass
+over every tree node with a fixed ancestor mask (a prefill-style pass —
+node i attends its ancestors' K/V computed in the same call), replay the
+exact beam-update math level by level on the verified logits, and accept
+the longest prefix of levels whose true beam selections were all
+drafted. Level 0 is the CURRENT step's own forward — always exact — so
+every speculative call commits >= 1 code and the drafter-disagrees worst
+case degenerates to plain decode, never diverges from it.
+
+Everything here is SHAPE-STATIC: one `TreeTopology` (beams x fanout x
+depth) per engine head, its node tables baked as numpy constants into
+the compiled verify executable — zero steady-state recompiles, the same
+discipline check_serving_hlo enforces (and check_spec_hlo pins for the
+speculative path: exactly one topology per slot-count rung).
+
+The per-head verify/accept twins live with their models
+(models/tiger.tiger_spec_tree_step, models/cobra.cobra_spec_tree_step);
+this module owns what they share: the topology tables, the virtual
+per-node suffix cache (committed beam cache + ancestor K/V overlaid at
+the speculated positions), and the drafted-child matching that drives
+the accept-length scan.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+
+class TreeTopology:
+    """Flat node tables for a (beams K, fanouts, depth d) candidate tree.
+
+    Nodes are laid out level-major: level 0 holds one node per live beam
+    (the current step's exact forward), level l holds ``fanouts[l-1]``
+    children per level-(l-1) node. ``fanout`` may be one int or a
+    per-level sequence — sem-id trees want a WIDE first speculated level
+    (it must cover the root codebook's beam spread, so >= beams) and
+    narrow deep levels (trie branching collapses after a code or two),
+    and a uniform fanout would pay the wide level's cost at every depth.
+    All tables are host numpy — static constants of the compiled verify
+    step, identical for every call at a given (K, fanouts, d), which is
+    what "one tree topology per rung" means.
+    """
+
+    def __init__(self, beams: int, fanout, depth: int):
+        fanouts = (
+            (int(fanout),) * depth if np.ndim(fanout) == 0
+            else tuple(int(f) for f in fanout)
+        )
+        if len(fanouts) < depth:  # pad a short spec with its last level
+            fanouts = fanouts + (fanouts[-1],) * (depth - len(fanouts))
+        fanouts = fanouts[:depth]
+        if beams <= 0 or depth < 0 or any(f <= 0 for f in fanouts):
+            raise ValueError(
+                f"invalid tree topology K={beams} F={fanouts} d={depth}"
+            )
+        self.beams = int(beams)
+        self.fanouts = fanouts
+        self.depth = int(depth)
+        sizes = [beams]
+        for f in fanouts:
+            sizes.append(sizes[-1] * f)
+        self.level_sizes = sizes
+        self.level_offsets = np.concatenate(
+            [[0], np.cumsum(self.level_sizes)]
+        ).astype(np.int32)
+        self.n_nodes = int(self.level_offsets[-1])
+        level = np.zeros(self.n_nodes, np.int32)
+        root = np.zeros(self.n_nodes, np.int32)
+        parent = np.arange(self.n_nodes, dtype=np.int32)  # self at level 0
+        for l in range(depth + 1):
+            o, n = self.level_offsets[l], self.level_sizes[l]
+            idx = np.arange(n)
+            level[o:o + n] = l
+            root[o:o + n] = idx * beams // n
+            if l > 0:
+                parent[o:o + n] = self.level_offsets[l - 1] + idx // fanouts[l - 1]
+        self.level = level
+        self.root_beam = root
+        self.parent = parent
+        # anc[n, j]: flat index of node n's ancestor at level j (self
+        # where j >= level[n] — those rows only ever land on virtual
+        # positions the attention mask excludes).
+        anc = np.tile(np.arange(self.n_nodes, dtype=np.int32)[:, None],
+                      (1, depth + 1))
+        for j in range(depth, 0, -1):
+            # Walk every node up one level; column j-1 = parent of col j.
+            anc[:, j - 1] = np.where(
+                level >= j, parent[anc[:, j]], anc[:, j - 1]
+            )
+        self.anc = anc
+
+    def signature(self) -> tuple:
+        return (self.beams, self.fanouts, self.depth)
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging nicety
+        return (f"TreeTopology(K={self.beams}, F={self.fanouts}, "
+                f"d={self.depth}, nodes={self.n_nodes})")
+
+
+def tree_virtual_cache(cache, new_kv, topo: TreeTopology, base_steps):
+    """Per-node suffix-cache view for the parallel verify pass.
+
+    cache: (B, K, S, H, hd) — the COMMITTED per-beam suffix cache.
+    new_kv: (B, N, H, hd) — this layer's K (or V) projection of every
+    tree node, computed in the same pass. base_steps: (B,) — the cache
+    slot level-0 nodes write (TIGER: the current step; COBRA: step-1).
+
+    Returns (B, N, S, H, hd): node n's ancestors' K/V overlay the
+    committed cache of its root beam at slots base..base+level[n] (own
+    entry last), exactly the cache a sequential plain step would have
+    built along that path. Slots past base+level[n] hold garbage the
+    caller's causal mask excludes — same contract as the plain ragged
+    step's masked tail.
+    """
+    S = cache.shape[2]
+    vc = cache[:, topo.root_beam]  # (B, N, S, H, hd)
+    pos = jnp.arange(S)
+    for j in range(topo.depth + 1):
+        hit = pos[None, :] == (base_steps[:, None] + j)  # (B, S)
+        anc_kv = new_kv[:, topo.anc[:, j]]  # (B, N, H, hd)
+        vc = jnp.where(hit[:, None, :, None, None], anc_kv[:, :, None], vc)
+    return vc
+
+
+def commit_level_kv(node_kvs, run_ck, run_cv, flat_idx, sel_parent, slot):
+    """One accepted level's suffix-cache commit, in the PLAIN step's
+    exact order: write the selected nodes' K/V at this level's cache
+    slot for every beam, THEN reorder the beam axis by the surviving
+    parents (gather_beam_caches' gather). Shared by both heads' accept
+    scans so the write-then-gather discipline the bitwise spec==plain
+    pin depends on lives in exactly one place.
+
+    node_kvs: per-layer (k_new, v_new), each (B, N, H, hd).
+    run_ck/run_cv: per-layer committed-so-far caches (B, K, S, H, hd).
+    flat_idx: (B, K) flat node id feeding each beam this level.
+    sel_parent: (B, K) surviving parents. slot: (B,) cache write slot
+    (TIGER: the step itself; COBRA: step - 1).
+    Returns (new_ck, new_cv) per-layer lists.
+    """
+    Sc = run_ck[0].shape[2]
+    hit = (jnp.arange(Sc)[None, :] == slot[:, None])[:, None, :, None, None]
+    gidx = sel_parent[:, :, None, None, None]
+    new_ck, new_cv = [], []
+    for (k_nodes, v_nodes), rk, rv in zip(node_kvs, run_ck, run_cv):
+        k_sel = jnp.take_along_axis(
+            k_nodes, flat_idx[..., None, None], axis=1)  # (B, K, H, hd)
+        v_sel = jnp.take_along_axis(v_nodes, flat_idx[..., None, None], axis=1)
+        new_ck.append(jnp.take_along_axis(
+            jnp.where(hit, k_sel[:, :, None], rk), gidx, axis=1))
+        new_cv.append(jnp.take_along_axis(
+            jnp.where(hit, v_sel[:, :, None], rv), gidx, axis=1))
+    return new_ck, new_cv
+
+
+def match_drafted(draft_tok, parent_local, sel_tok):
+    """Which beam selections were drafted, and where.
+
+    draft_tok: (B, N_l, F) — the next level's drafted child codes per
+    level-l node. parent_local: (B, K) — each selection's parent node as
+    a LEVEL-LOCAL index. sel_tok: (B, K) — the selected codes.
+
+    Returns (all_matched (B,) bool, child_f (B, K) int32): a level is
+    accepted only when EVERY surviving beam's (parent, token) pair is a
+    drafted tree edge; child_f is the fanout slot of each match
+    (arbitrary where unmatched — the caller gates on all_matched).
+    """
+    per_parent = jnp.take_along_axis(
+        draft_tok, parent_local[..., None], axis=1
+    )  # (B, K, F)
+    eq = per_parent == sel_tok[..., None]
+    return eq.any(-1).all(-1), jnp.argmax(eq, axis=-1).astype(jnp.int32)

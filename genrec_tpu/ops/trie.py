@@ -174,6 +174,38 @@ def advance_ragged(trie, prefix_idx: jax.Array, token: jax.Array,
         return out
 
 
+def legal_topk_ragged(trie, prefix_idx: jax.Array, steps: jax.Array,
+                      k: int) -> tuple[jax.Array, jax.Array]:
+    """Top-``k`` trie-legal child codes per prefix, per-row step — the
+    k-step legal-expansion primitive the speculative drafter
+    (ops/spec_tree.py) builds its candidate tree from.
+
+    Ranking: descending draft weight where the trie carries one
+    (catalog.TensorTrie's per-node leaf counts / item-score sums), with
+    ties — and weightless tries (DenseTrie/PackedTrie, trie=None-free
+    decode) — broken by ascending code id (jax.lax.top_k is stable, so
+    equal scores resolve to the lowest code first). Fully deterministic:
+    the same state always drafts the same tree, which is what makes a
+    speculative engine's output reproducible call-by-call.
+
+    prefix_idx (S, ...), steps (S,) -> (tokens (S, ..., k) int32,
+    legal (S, ..., k) bool). Prefixes with fewer than ``k`` legal
+    children pad with arbitrary illegal codes flagged False — the
+    verifier masks them to -inf, so they can only "match" selections
+    that were themselves illegal (dead beams), where plain decode is
+    equally degenerate.
+    """
+    legal = legal_mask_ragged(trie, prefix_idx, steps)  # (S, ..., K)
+    weigher = getattr(trie, "child_weights_ragged", None)
+    if weigher is not None:
+        score = jnp.where(legal, weigher(prefix_idx, steps), -jnp.inf)
+    else:
+        score = jnp.where(legal, 0.0, -jnp.inf)
+    _, tok = jax.lax.top_k(score, k)
+    picked_legal = jnp.take_along_axis(legal, tok, axis=-1)
+    return tok.astype(jnp.int32), picked_legal
+
+
 def _clip_prefix(trie, prefix_idx, step: int):
     """Keep foreign-step prefixes in a table's index range. PackedTrie's
     searchsorted accepts any int; DenseTrie's gather would clamp anyway

@@ -100,6 +100,7 @@ compiled surface: shedding is pure host-side admission control.
 from __future__ import annotations
 
 import collections
+import dataclasses
 import logging
 import threading
 import time
@@ -179,9 +180,45 @@ class _PagedRunner:
             )
         self.engine = engine
         self.head = head
+        # Speculative tree decode (docs/SERVING.md "Speculative
+        # decoding"): opt-in per engine (or per head via a name set).
+        # One static topology (beams x fanout x spec_depth) for the
+        # whole runner — every slot-count rung compiles the same tree.
+        spec_cfg = engine._spec_decode
+        want_spec = (
+            head.name in spec_cfg
+            if isinstance(spec_cfg, (set, frozenset, list, tuple))
+            else bool(spec_cfg)
+        )
+        self.spec_topology = None
+        self._spec: dict[int, object] = {}
+        if (want_spec and getattr(head, "supports_spec", False)
+                and head.spec_depth >= 1):
+            from genrec_tpu.ops.spec_tree import TreeTopology
+
+            # BEFORE state/prefill construction: the head may extend its
+            # slot state + prefill with drafter hints.
+            head.enable_spec_drafting()
+            self.spec_topology = TreeTopology(
+                head.top_k, engine._spec_fanout, head.spec_depth
+            )
+            # Scratch-page reservation: the landing zone a TPU
+            # tree-verify kernel appends candidate-tree K/V into, pinned
+            # so speculation can never compete with admissions. The pool
+            # budget is EXTENDED by the reservation (an explicit
+            # paged_config keeps its admission capacity; the ledger sees
+            # the real total).
+            per_slot = -(-self.spec_topology.n_nodes // cfg.page_size)
+            self._scratch_demand = cfg.max_slots * per_slot
+            cfg = dataclasses.replace(
+                cfg, num_pages=cfg.num_pages + self._scratch_demand
+            )
+        else:
+            self._scratch_demand = 0
         self.cfg = cfg
         n_layers, n_heads, head_dim, dtype = head.paged_layout()
         self.pool = KVPagePool(cfg, n_layers, n_heads, head_dim, dtype)
+        self._scratch_tables = self.pool.reserve_scratch(self._scratch_demand)
         self.state = head.paged_state_zeros(cfg.max_slots)
         self.steps = np.zeros(cfg.max_slots, np.int32)
         self.active = np.zeros(cfg.max_slots, bool)
@@ -237,9 +274,16 @@ class _PagedRunner:
         """Decode executables at the handful of (slot-count,
         pages_per_slot) shapes + the prefill bucket grid. Everything else
         the dense path compiled per bucket (the whole generate loop) is
-        gone from the decode side."""
+        gone from the decode side. A speculative runner compiles the
+        tree-verify step INSTEAD of the plain step at every rung (same
+        signature, returns (state, accept); accept >= 1 always — the
+        root level is exact — so no plain-step fallback executable is
+        needed: the verified-rejection worst case IS the plain step)."""
         for S in self.slot_shapes:
-            self._decode[S] = self._compile_decode(S)
+            if self.spec_topology is not None:
+                self._spec[S] = self._compile_spec(S)
+            else:
+                self._decode[S] = self._compile_decode(S)
         for B, L in self.engine._ladder.combos():
             self._prefill[(B, L)] = self._compile_prefill(B, L)
 
@@ -264,6 +308,31 @@ class _PagedRunner:
         # overwrites every row, so the input tree is dead after the call —
         # undonated, XLA would double-buffer the whole slot ladder's
         # decode state (graftlint missing_donation; docs/PERF.md note).
+        compiled = jax.jit(
+            fn, donate_argnums=self._donate(*PAGED_DECODE_DONATE_ARGNUMS)
+        ).lower(*args).compile()
+        eng.metrics.record_compile(catalog=catalog_compile)
+        return compiled
+
+    def _compile_spec(self, S: int, operands=None, catalog_compile=False):
+        """The tree-verify executable at slot rung S: identical operand
+        surface to the plain decode step (slot state donated the same
+        way), returning (state, accept_len). The tree topology is a
+        static constant of the trace — one topology per rung, the
+        check_spec_hlo pin."""
+        eng = self.engine
+        fn = self.head.make_spec_decode_paged_fn(self.engine._spec_fanout)
+        ops = operands if operands is not None else self.head.runtime_operands()
+        args = (
+            eng._select(self.head, eng._params),
+            *(_sds(op) for op in ops),
+            _sds({k: v[:S] for k, v in self.state.items()}),
+            jax.ShapeDtypeStruct((S,), np.int32),
+            jax.ShapeDtypeStruct((S, self.cfg.pages_per_slot), np.int32),
+            jax.ShapeDtypeStruct((S,), np.int32),
+            _sds(self.pool.k_pools),
+            _sds(self.pool.v_pools),
+        )
         compiled = jax.jit(
             fn, donate_argnums=self._donate(*PAGED_DECODE_DONATE_ARGNUMS)
         ).lower(*args).compile()
@@ -561,6 +630,20 @@ class _PagedRunner:
         self._publish_prefix_gauges()
         return n
 
+    def release_scratch(self, reason: str) -> int:
+        """Drop the speculative scratch-page reservation (drain/stop) so
+        the pool accounts clean at shutdown — the same discipline as the
+        prefix cache's drain invalidation. Idempotent."""
+        n = self.pool.release_scratch()
+        if n:
+            self.engine._flight.record(
+                "spec_scratch_released", head=self.head.name,
+                reason=reason, pages=n,
+            )
+            self.engine.metrics.set_pool_gauges(self.head.name,
+                                                self.pool.stats())
+        return n
+
     def _run_prefill(self, entries, slots, L: int,
                      t_pop: float | None = None, keys=None) -> None:
         eng = self.engine
@@ -630,19 +713,25 @@ class _PagedRunner:
     # -- decode (one fixed-shape step over all slots) ------------------------
 
     def step(self) -> bool:
-        """Advance every active slot one decode position; finished slots
-        resolve their futures and free their pages immediately, so the
-        NEXT admit() can reuse them — eviction mid-decode, no batch
-        barrier."""
+        """Advance every active slot — one decode position through the
+        plain step, or 1..(1 + spec_depth) positions through the
+        tree-verify step when speculation is on. Finished slots resolve
+        their futures and free their pages immediately, so the NEXT
+        admit() can reuse them — eviction mid-decode, no batch barrier."""
         if self.idle:
             return False
         eng = self.engine
+        spec = self.spec_topology is not None
         # Smallest compiled slot shape covering the highest active slot
         # (slots fill lowest-first, so this tracks the active count).
         hi = int(np.nonzero(self.active)[0][-1]) + 1
         S = next(s for s in self.slot_shapes if s >= hi)
-        t0 = time.monotonic()
-        out = self._decode[S](
+        # Host-side operand staging. On spec iterations this interval is
+        # the `draft` span: the drafter's trie expansion executes inside
+        # the verify call, so staging is the only host-visible slice of
+        # the draft phase.
+        t_stage = time.monotonic()
+        args = (
             eng._select(self.head, eng._params),
             *self.head.runtime_operands(),
             {k: jnp.asarray(v[:S]) for k, v in self.state.items()},
@@ -652,22 +741,76 @@ class _PagedRunner:
             self.pool.k_pools,
             self.pool.v_pools,
         )
+        t0 = time.monotonic()
+        if spec:
+            out, accept = self._spec[S](*args)
+        else:
+            out = self._decode[S](*args)
         for k, v in out.items():  # write back into the host rows
             self.state[k][:S] = np.asarray(v)
+        active_idx = np.nonzero(self.active)[0]
+        if spec:
+            # Accept lengths ride the same fetch as the state write-back
+            # (device-side bookkeeping — no extra host<->device sync on
+            # the decode step); clamp against remaining codes so a
+            # garbage row can never overshoot a slot's total.
+            total = self.head.paged_total_steps
+            adv = np.minimum(
+                np.asarray(accept)[active_idx],
+                total - self.steps[active_idx],
+            ).astype(np.int32)
+            adv = np.maximum(adv, 1)  # root level is always exact
+        t1 = time.monotonic()
         if eng._tracer.enabled:
             # One fixed-shape step advances EVERY active slot: each
-            # resident request gets the same decode_step interval, tagged
-            # with its own position so the span tree reads per-request.
-            t1 = time.monotonic()
-            for slot in np.nonzero(self.active)[0]:
+            # resident request gets the same interval(s), tagged with its
+            # own position so the span tree reads per-request. Spec
+            # iterations replace the per-code `decode_step` span with
+            # draft -> tree_verify -> accept (scripts/check_obs.py
+            # accepts both shapes).
+            for i, slot in enumerate(active_idx):
                 tr = self.entries[slot][3]
-                if tr is not None:
+                if tr is None:
+                    continue
+                if spec:
+                    tid, root = tr
+                    eng._tracer.record_span(
+                        "draft", tid, t_stage, t0, parent_id=root,
+                        step=int(self.steps[slot]),
+                        drafted=int(self.spec_topology.n_nodes
+                                    - self.spec_topology.beams),
+                    )
+                    eng._tracer.record_span(
+                        "tree_verify", tid, t0, t1, parent_id=root,
+                        step=int(self.steps[slot]), slots=S,
+                        accept_len=int(adv[i]),
+                    )
+                else:
                     eng._tracer.record_span(
                         "decode_step", tr[0], t0, t1, parent_id=tr[1],
                         step=int(self.steps[slot]), slots=S,
                     )
-        self.steps[self.active] += 1
-        eng.metrics.record_decode_step()
+        if spec:
+            self.steps[active_idx] += adv
+            eng.metrics.record_decode_step()
+            eng.metrics.record_spec(
+                self.head.name,
+                drafted=len(active_idx)
+                * (self.spec_topology.n_nodes - self.spec_topology.beams),
+                accept_lens=adv,
+            )
+            if eng._tracer.enabled:
+                t2 = time.monotonic()
+                for i, slot in enumerate(active_idx):
+                    tr = self.entries[slot][3]
+                    if tr is not None:
+                        eng._tracer.record_span(
+                            "accept", tr[0], t1, t2, parent_id=tr[1],
+                            accept_len=int(adv[i]),
+                        )
+        else:
+            self.steps[self.active] += 1
+            eng.metrics.record_decode_step()
         self._sweep_finished()
         # Chaos hook: a real SIGTERM after the Nth decode step exercises
         # drain mid-churn for the continuous-batching loop.
@@ -771,6 +914,8 @@ class ServingEngine:
         paged_config: Optional[PagedConfig] = None,
         prefix_cache: bool = True,
         prefix_cache_entries: int = 4096,
+        spec_decode=False,
+        spec_fanout: int = 8,
         tracer: Optional[SpanTracer] = None,
         hbm_budget_bytes: Optional[int] = None,
         slo_targets=None,
@@ -817,6 +962,29 @@ class ServingEngine:
         # cold baseline bench.py measures against.
         self._prefix_cache = bool(prefix_cache)
         self._prefix_cache_entries = int(prefix_cache_entries)
+        # Speculative tree decode (docs/SERVING.md "Speculative
+        # decoding"): False (default — plain one-code steps), True (every
+        # spec-capable paged head), or a set of head names (mixed
+        # spec/plain heads on one engine). Off by default: speculation
+        # trades redundant tree FLOPs for fewer sequential target
+        # invocations — the right trade on dispatch/latency-bound
+        # serving, measured (serve.spec in bench.py) rather than assumed.
+        self._spec_decode = (
+            frozenset(spec_decode)
+            if isinstance(spec_decode, (set, frozenset, list, tuple))
+            else bool(spec_decode)
+        )
+        # One int, or a per-level tuple (wide first speculated level,
+        # narrow deep levels — TreeTopology normalizes either form).
+        self._spec_fanout = (
+            tuple(int(f) for f in spec_fanout)
+            if isinstance(spec_fanout, (tuple, list))
+            else int(spec_fanout)
+        )
+        if isinstance(self._spec_decode, frozenset):
+            unknown = [n for n in self._spec_decode if n not in self._heads]
+            if unknown:
+                raise ValueError(f"spec_decode names unknown heads {unknown}")
         self._runners: dict[str, _PagedRunner] = {}
         self._ckpt_dir = ckpt_dir
         self._ckpt_poll_secs = ckpt_poll_secs
@@ -1016,6 +1184,8 @@ class ServingEngine:
             )
             for S, ex in runner._decode.items():
                 led.record_executable(head.name, f"decode/S{S}", ex)
+            for S, ex in runner._spec.items():
+                led.record_executable(head.name, f"spec_decode/S{S}", ex)
             for (B, L), ex in runner._prefill.items():
                 led.record_executable(head.name, f"prefill/B{B}/L{L}", ex)
         else:
@@ -1075,6 +1245,8 @@ class ServingEngine:
         self._catalog_watchers = []
         if self._batcher is not None:
             self._batcher.join(timeout)
+        for runner in self._runners.values():
+            runner.release_scratch("stop")  # idempotent drain backstop
         if self._watcher is not None:
             self._watcher.join(timeout)
         if self._guard is not None:
@@ -1260,11 +1432,14 @@ class ServingEngine:
                                 else 0.05
                             )
                     if done:
-                        # Drained: release every retained prefix page so
+                        # Drained: release every retained prefix page —
+                        # and any speculative scratch reservation — so
                         # the pool accounts clean at shutdown ("all pages
-                        # released after drain", check_serving_hlo).
+                        # released after drain", check_serving_hlo /
+                        # check_spec_hlo).
                         for runner in self._runners.values():
                             runner.clear_prefix_cache("drain")
+                            runner.release_scratch("drain")
                         break
                 except Exception:  # noqa: BLE001 — the batcher must survive
                     # Anything escaping _run_batch's own guard (params
@@ -1591,17 +1766,26 @@ class ServingEngine:
         operands = (new_trie,)
         runner = self._runners.get(head.name)
         if runner is not None:
-            decode = {
-                S: runner._compile_decode(S, operands=operands,
-                                          catalog_compile=True)
-                for S in runner.slot_shapes
-            }
+            if runner.spec_topology is not None:
+                decode = {}
+                spec = {
+                    S: runner._compile_spec(S, operands=operands,
+                                            catalog_compile=True)
+                    for S in runner.slot_shapes
+                }
+            else:
+                decode = {
+                    S: runner._compile_decode(S, operands=operands,
+                                              catalog_compile=True)
+                    for S in runner.slot_shapes
+                }
+                spec = {}
             prefill = {
                 (B, L): runner._compile_prefill(B, L, operands=operands,
                                                 catalog_compile=True)
                 for B, L in self._ladder.combos()
             }
-            return None, (decode, prefill)
+            return None, (decode, prefill, spec)
         dense = {
             (head.name, B, L): self._compile(
                 head, B, L, operands=operands, install=False,
@@ -1637,7 +1821,7 @@ class ServingEngine:
                 self._exec.update(dense_exec)
             runner = self._runners.get(name)
             if runner is not None and runner_exec is not None:
-                runner._decode, runner._prefill = runner_exec
+                runner._decode, runner._prefill, runner._spec = runner_exec
             self.metrics.record_catalog_swap()
             # Re-ledger the swapped head: the trie operand changed size
             # and a rung growth installed new executables. Post-warmup

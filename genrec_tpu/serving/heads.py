@@ -83,6 +83,25 @@ class Head:
     #: Heads whose corpus is a swappable CatalogSnapshot (set_catalog /
     #: runtime_operands / catalog_version below).
     supports_catalog = False
+    #: Paged heads that additionally implement speculative tree decode
+    #: (docs/SERVING.md "Speculative decoding"): ``spec_depth`` levels
+    #: speculated past the always-exact root step, verified through
+    #: ``make_spec_decode_paged_fn(fanout)`` — signature identical to
+    #: the plain decode fn but returning (state, accept (S,) int32).
+    #: ``enable_spec_drafting()`` is called by the runner BEFORE state /
+    #: prefill compilation so the head can extend both with drafter
+    #: hints (TIGER's prefill-computed step-0 logits).
+    supports_spec = False
+
+    @property
+    def spec_depth(self) -> int:
+        return 0
+
+    def enable_spec_drafting(self) -> None:
+        return None
+
+    def make_spec_decode_paged_fn(self, fanout: int):
+        raise NotImplementedError(f"head {self.name!r} has no speculative decode")
 
     def on_params(self, params) -> None:  # derived-table refresh hook
         del params
@@ -312,6 +331,20 @@ class TigerGenerativeHead(Head):
     # ---- paged decode protocol ---------------------------------------------
 
     supports_paged = True
+    supports_spec = True
+
+    @property
+    def spec_depth(self) -> int:
+        # Root level is exact; everything past it is speculated — a
+        # fresh slot can finish its whole tuple in one verify call.
+        return self.model.sem_id_dim - 1
+
+    def enable_spec_drafting(self) -> None:
+        """Runner hook (BEFORE paged_state_zeros / prefill compiles):
+        extend the prefill with the step-0 logit window and the slot
+        state with its per-slot row — the drafter's root-step signal
+        (popularity ranking has no model signal at the root codebook)."""
+        self._spec_draft_hint = True
 
     @property
     def paged_init_step(self) -> int:
@@ -336,25 +369,45 @@ class TigerGenerativeHead(Head):
         # numpy view of a jax buffer is read-only.
         return {
             k: np.array(v)
-            for k, v in init_tiger_paged_state(self.model, n_slots, self.top_k).items()
+            for k, v in init_tiger_paged_state(
+                self.model, n_slots, self.top_k,
+                draft_hint=getattr(self, "_spec_draft_hint", False),
+            ).items()
         }
 
     def make_prefill_paged_fn(self, B: int, L: int):
         from genrec_tpu.models.tiger import tiger_prefill_paged
 
         del B, L  # shapes come from make_batch/block_tables
+        draft_hint = getattr(self, "_spec_draft_hint", False)
 
         def fn(params, trie, user, ids, types, mask, block_tables,
                k_pools, v_pools):
-            # TIGER's prefill is trie-free; the operand rides the uniform
-            # paged signature (params, *operands, *batch, ...) and jit
-            # prunes the unused arg.
-            del trie
-            k_pools, v_pools, _ = tiger_prefill_paged(
+            # TIGER's plain prefill is trie-free; the operand rides the
+            # uniform paged signature (params, *operands, *batch, ...)
+            # and jit prunes the unused arg. The SPECULATIVE prefill
+            # reads it: the step-0 draft window is trie-masked.
+            k_pools, v_pools, _, extras = tiger_prefill_paged(
                 self.model, params, user, ids, types, mask, block_tables,
-                k_pools, v_pools,
+                k_pools, v_pools, trie=trie, draft_hint=draft_hint,
             )
-            return k_pools, v_pools, {}
+            return k_pools, v_pools, extras
+
+        return fn
+
+    def make_spec_decode_paged_fn(self, fanout: int):
+        from genrec_tpu.models.tiger import tiger_spec_tree_step
+
+        def fn(params, trie, state, steps, block_tables, seq_lens,
+               k_pools, v_pools):
+            # Deterministic beams only — the same serving contract as
+            # the plain step; one topology (fanout x spec_depth) per
+            # engine rung, compiled at warmup.
+            return tiger_spec_tree_step(
+                self.model, params, trie, state, steps, block_tables,
+                seq_lens, k_pools, v_pools, fanout=fanout,
+                depth=self.spec_depth,
+            )
 
         return fn
 
@@ -578,6 +631,26 @@ class CobraGenerativeHead(Head):
     # ---- paged decode protocol ---------------------------------------------
 
     supports_paged = True
+    supports_spec = True
+
+    @property
+    def spec_depth(self) -> int:
+        # Codebook 0 resolves at prefill; the first suffix step is the
+        # exact root, the remaining C-2 codebooks are speculated.
+        return max(self.model.n_codebooks - 2, 0)
+
+    def make_spec_decode_paged_fn(self, fanout: int):
+        from genrec_tpu.models.cobra import cobra_spec_tree_step
+
+        def fn(params, trie, state, steps, block_tables, seq_lens,
+               k_pools, v_pools):
+            return cobra_spec_tree_step(
+                self.model, params, trie, state, steps, block_tables,
+                seq_lens, k_pools, v_pools, fanout=fanout,
+                depth=self.spec_depth, temperature=1.0,
+            )
+
+        return fn
 
     @property
     def paged_init_step(self) -> int:

@@ -226,6 +226,9 @@ class KVPagePool:
         self.block_tables = np.zeros((cfg.max_slots, cfg.pages_per_slot), np.int32)
         self.seq_lens = np.zeros((cfg.max_slots,), np.int32)
         self._slot_pages: list[list[int] | None] = [None] * cfg.max_slots
+        # Pages pinned by reserve_scratch (speculative tree decode):
+        # held OUTSIDE slot bookkeeping, never admitted against.
+        self._scratch_pages: list[int] = []
         # Min-heap: slots fill LOWEST-INDEX-FIRST so the active set stays
         # quasi-compact and the decode step can run at the smallest slot
         # shape covering max(active index) (the collapsed decode ladder).
@@ -358,6 +361,37 @@ class KVPagePool:
         self.allocator.addref(cover)  # may raise; slot state untouched
         return self._bind_slot(list(cover), n_tokens)
 
+    def reserve_scratch(self, n_pages: int) -> np.ndarray:
+        """Pin ``n_pages`` for speculative tree verification and return
+        them as a block-table-shaped row set — the landing zone the TPU
+        tree-verify kernel appends candidate-tree K/V into (the pure-JAX
+        fallback carries tree K/V as in-call dense arrays and leaves the
+        reserved pages untouched). The pages hold one allocator ref each
+        (reflected in pages_in_use / the HBM ledger's pool bytes) and
+        can never collide with an admission — which is what makes a
+        rejected tree's rollback a no-op on the pool: speculation and
+        slot state share no pages. Idempotence/stacking is the caller's
+        job (the engine reserves once at warmup); ``release_scratch``
+        undoes it (drain/stop, so pools account clean at shutdown)."""
+        if n_pages <= 0:
+            return np.zeros((0,), np.int32)
+        pages = self.allocator.alloc(int(n_pages))  # may raise: size the
+        self._scratch_pages.extend(pages)           # config to include it
+        return np.asarray(pages, np.int32)
+
+    def release_scratch(self) -> int:
+        """Drop every scratch reservation (their last refs). Returns the
+        number of pages released."""
+        n = len(self._scratch_pages)
+        if n:
+            self.allocator.free(self._scratch_pages)
+            self._scratch_pages = []
+        return n
+
+    @property
+    def scratch_page_count(self) -> int:
+        return len(self._scratch_pages)
+
     def check_invariants(self) -> None:
         """Property-test hook: allocator accounting holds AND no page is
         bound by two live slots unless deliberately shared (refcount >=
@@ -379,6 +413,7 @@ class KVPagePool:
         return {
             "pages_in_use": self.allocator.pages_in_use,
             "pages_free": self.allocator.pages_free,
+            "scratch_pages": len(self._scratch_pages),
             "slots_active": self.active_slot_count,
             "slots_total": self.cfg.max_slots,
             "kv_tokens_resident": int(self.seq_lens.sum()),

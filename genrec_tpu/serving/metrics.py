@@ -115,6 +115,20 @@ class ServingMetrics:
         self.prefix_evictions: collections.Counter = collections.Counter()
         self.prefix_invalidations: collections.Counter = collections.Counter()
         self.prefix_gauges: dict[str, dict] = {}
+        # Speculative tree decode (docs/SERVING.md "Speculative
+        # decoding"). Metrics honesty for multi-token steps:
+        # ``decode_steps`` above KEEPS meaning target executable
+        # invocations (a spec call is ONE invocation however many codes
+        # it commits); these counters carry the multi-token story —
+        # drafted speculated tokens, codes committed, slot-steps (one
+        # per active slot per invocation; accepted/slot_steps is the
+        # mean accept length, 1.0 == plain decode's rate), and the
+        # per-step accept-length histogram.
+        self.spec_steps: collections.Counter = collections.Counter()
+        self.spec_drafted: collections.Counter = collections.Counter()
+        self.spec_accepted: collections.Counter = collections.Counter()
+        self.spec_slot_steps: collections.Counter = collections.Counter()
+        self.spec_accept_hist: dict[str, collections.Counter] = {}
         # SLO load shedding (obs/slo.py via the engine): submissions
         # rejected with the typed OverloadError while a head sheds.
         # Separate from `rejected` — that one means draining (terminal);
@@ -191,6 +205,22 @@ class ServingMetrics:
     def record_decode_step(self) -> None:
         with self._lock:
             self.decode_steps += 1
+
+    def record_spec(self, head: str, drafted: int, accept_lens) -> None:
+        """One speculative tree-verify invocation: ``drafted`` speculated
+        tokens proposed across the active slots, ``accept_lens`` the
+        per-active-slot codes committed (>= 1 each: the root level is
+        exact). The caller records the invocation itself through
+        `record_decode_step` — decode_steps stays "target executable
+        invocations" whether or not speculation is on."""
+        lens = [int(x) for x in accept_lens]
+        with self._lock:
+            self.spec_steps[head] += 1
+            self.spec_drafted[head] += int(drafted)
+            self.spec_slot_steps[head] += len(lens)
+            self.spec_accepted[head] += sum(lens)
+            hist = self.spec_accept_hist.setdefault(head, collections.Counter())
+            hist.update(lens)
 
     def record_prefix_lookup(self, head: str, outcome: str,
                              tokens: int = 0) -> None:
@@ -353,6 +383,25 @@ class ServingMetrics:
                 }
                 for h in prefix_heads
             }
+            spec = {}
+            for h in sorted(self.spec_steps):
+                slot_steps = self.spec_slot_steps[h]
+                spec[h] = {
+                    "spec_steps": self.spec_steps[h],
+                    "drafted": self.spec_drafted[h],
+                    "accepted": self.spec_accepted[h],
+                    "slot_steps": slot_steps,
+                    # Mean accept length == accepted codes per target
+                    # invocation per stream (plain decode == 1.0) — the
+                    # bench-gated headline of speculative decode.
+                    "codes_per_invocation": round(
+                        self.spec_accepted[h] / slot_steps, 4
+                    ) if slot_steps else 0.0,
+                    "accept_len_hist": {
+                        f"accept_len_{l}": n
+                        for l, n in sorted(self.spec_accept_hist[h].items())
+                    },
+                }
         return {
             **counts,
             "qps": round(self.qps(), 3),
@@ -367,4 +416,5 @@ class ServingMetrics:
             "oom_deferred_by_head": oom_deferred_by_head,
             "kv_pool": kv_pool,
             "prefix_cache": prefix_cache,
+            "spec": spec,
         }

@@ -62,6 +62,44 @@ def _strict_loads(line: str):
     return json.loads(line, parse_constant=_reject)
 
 
+def check_span_tree(spans) -> list:
+    """A paged request's span tree is complete in either decode shape:
+
+    - plain:       request -> queue_wait / admission / prefill(|warm_admit)
+                   / decode_step+ / finalize
+    - speculative: the per-code ``decode_step`` spans are replaced by
+                   ``draft`` -> ``tree_verify`` -> ``accept`` per spec
+                   iteration (docs/OBSERVABILITY.md)
+
+    Everything must parent onto ONE request root, and the decode phase
+    must actually be present (>= 2 plain steps at sem_id_dim=3, or >= 1
+    complete draft/verify/accept triple)."""
+    names = sorted({s.name for s in spans})
+    base = {"request", "queue_wait", "admission", "finalize"}
+    missing = base - set(names)
+    if missing:
+        raise AssertionError(f"span tree incomplete: missing {missing} "
+                             f"(got {names})")
+    if not ({"prefill", "warm_admit"} & set(names)):
+        raise AssertionError(f"span tree has neither prefill nor warm_admit "
+                             f"(got {names})")
+    root = [s for s in spans if s.name == "request"]
+    if len(root) != 1:
+        raise AssertionError(f"expected ONE root request span, got {len(root)}")
+    for s in spans:
+        if s is not root[0] and s.parent_id != root[0].span_id:
+            raise AssertionError(f"span {s.name} not parented to the request root")
+    n_plain = sum(1 for s in spans if s.name == "decode_step")
+    spec_names = {"draft", "tree_verify", "accept"}
+    have_spec = spec_names & set(names)
+    if have_spec and have_spec != spec_names:
+        raise AssertionError(
+            f"partial speculative span triple: {sorted(have_spec)}")
+    if not have_spec and n_plain < 2:  # sem_id_dim=3, code 0 at prefill
+        raise AssertionError(f"expected >=2 decode_step spans, got {n_plain}")
+    return names
+
+
 def check_serve_trace(tmp: str) -> dict:
     """Paged TIGER engine with tracing on: full span tree + trace schema."""
     import jax
@@ -104,23 +142,9 @@ def check_serve_trace(tmp: str) -> dict:
         if r0.request_id is None:
             raise AssertionError("tracer enabled but request_id is None")
         spans = tracer.spans(r0.request_id)
-        names = sorted({s.name for s in spans})
-        want = {"request", "queue_wait", "admission", "prefill",
-                "decode_step", "finalize"}
-        missing = want - set(names)
-        if missing:
-            raise AssertionError(f"span tree incomplete: missing {missing} "
-                                 f"(got {names})")
-        root = [s for s in spans if s.name == "request"]
-        if len(root) != 1:
-            raise AssertionError(f"expected ONE root request span, got {len(root)}")
-        for s in spans:
-            if s is not root[0] and s.parent_id != root[0].span_id:
-                raise AssertionError(
-                    f"span {s.name} not parented to the request root")
-        n_decode = sum(1 for s in spans if s.name == "decode_step")
-        if n_decode < 2:  # sem_id_dim=3, first code resolved at prefill
-            raise AssertionError(f"expected >=2 decode_step spans, got {n_decode}")
+        names = check_span_tree(spans)
+        n_decode = sum(1 for s in spans
+                       if s.name in ("decode_step", "tree_verify"))
         log(f"span tree OK: {names}, {n_decode} decode steps")
         memory = check_memory_ledger(eng)
     finally:

@@ -144,6 +144,16 @@ if [ "$MODE" = "--smoke" ]; then
     if [ -z "${GENREC_CI_SKIP_DISAGG:-}" ]; then
         run python scripts/check_disagg.py --small --platform cpu
     fi
+    # Speculative-decode smoke: a warmed spec TIGER engine under
+    # staggered churn — zero steady-state recompiles, exactly one tree
+    # topology per slot rung, output bit-identical to a plain engine at
+    # >1 codes per target invocation, pools + scratch clean after
+    # drain. GENREC_CI_SKIP_SPEC=1 skips it for callers whose pytest
+    # pass already runs tests/test_spec_decode.py directly (same
+    # contract as the knobs above).
+    if [ -z "${GENREC_CI_SKIP_SPEC:-}" ]; then
+        run python scripts/check_spec_hlo.py --small --platform cpu
+    fi
     # Obs smoke (traced serve span tree + goodput schema + overhead
     # budget + memory ledger + SLO shed). GENREC_CI_SKIP_OBS=1 skips it
     # for callers whose pytest pass already runs tests/test_obs.py
@@ -204,6 +214,7 @@ else
     run python scripts/check_catalog_hlo.py --write-note
     run python scripts/check_fleet.py --write-note
     run python scripts/check_disagg.py --write-note
+    run python scripts/check_spec_hlo.py --write-note
     run python scripts/check_obs.py
     run python scripts/graftlint.py
     # Perf regression gate: self-test, then the newest committed
@@ -211,11 +222,13 @@ else
     # no run file yet, or a backend-mismatched fallback line).
     run python scripts/bench_gate.py
     # Full serving suite (incl. the slow all-four-heads drain test, the
-    # slow COBRA trie-constraint pins, and the full paged-parity matrix).
+    # slow COBRA trie-constraint pins, the full paged-parity matrix, and
+    # the speculative-decode suite with its slow mixed-churn engine pin).
     run_strict env JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py \
         tests/test_trie_constrained.py tests/test_catalog.py \
         tests/test_kv_pool.py tests/test_fleet.py tests/test_disagg.py \
-        tests/test_paged_parity.py -q -p no:cacheprovider 1>&2
+        tests/test_paged_parity.py tests/test_spec_decode.py \
+        -q -p no:cacheprovider 1>&2
     # Full chaos suite: SIGTERM mid-epoch + exact-resume parity for all
     # seven trainers, ladder fallback, NaN injection — plus the 2-process
     # multi-host chaos (consensus restore, mid-save host kill, init

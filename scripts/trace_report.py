@@ -50,6 +50,7 @@ def percentile(sorted_vals: list[float], q: float) -> float:
 def summarize(data: dict, phase: str | None = None) -> dict:
     by_name: dict[str, list[float]] = defaultdict(list)
     traces = set()
+    accept_lens: list[int] = []
     for ev in data["traceEvents"]:
         if ev.get("ph") != "X":
             continue
@@ -57,9 +58,15 @@ def summarize(data: dict, phase: str | None = None) -> dict:
         if phase is not None and name != phase:
             continue
         by_name[name].append(float(ev.get("dur", 0.0)) / 1e3)  # us -> ms
-        tid = (ev.get("args") or {}).get("trace_id")
+        args = ev.get("args") or {}
+        tid = args.get("trace_id")
         if tid is not None:
             traces.add(tid)
+        # Speculative decode: `accept` spans carry the per-slot accept
+        # length (codes committed by that tree-verify invocation), so
+        # the report shows the multi-token story beside the phase p99s.
+        if name == "accept" and args.get("accept_len") is not None:
+            accept_lens.append(int(args["accept_len"]))
     phases = {}
     for name, durs in sorted(by_name.items()):
         durs.sort()
@@ -72,11 +79,23 @@ def summarize(data: dict, phase: str | None = None) -> dict:
             "max_ms": round(durs[-1], 3),
         }
     other = data.get("otherData") or {}
+    accept = None
+    if accept_lens:
+        hist: dict[str, int] = defaultdict(int)
+        for l in accept_lens:
+            hist[str(l)] += 1
+        accept = {
+            "count": len(accept_lens),
+            "mean": round(sum(accept_lens) / len(accept_lens), 3),
+            "max": max(accept_lens),
+            "hist": dict(sorted(hist.items())),
+        }
     return {
         "n_traces": len(traces),
         "phases": phases,
         "exemplars": other.get("exemplars") or {},
         "goodput": other.get("goodput"),
+        "accept_len": accept,
     }
 
 
@@ -92,6 +111,11 @@ def print_report(report: dict) -> None:
                   f"{s['p99_ms']:>8.2f} {s['max_ms']:>8.2f}")
     else:
         print("no complete ('X') events found")
+    acc = report.get("accept_len")
+    if acc:
+        hist = ", ".join(f"{k}:{v}" for k, v in acc["hist"].items())
+        print(f"speculative accept length: mean {acc['mean']} over "
+              f"{acc['count']} slot-steps (max {acc['max']}; hist {hist})")
     if report["exemplars"]:
         print("slow-request exemplars:")
         for tid, reason in report["exemplars"].items():

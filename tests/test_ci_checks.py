@@ -38,6 +38,13 @@ DISAGG_KEYS = {"backend", "submitted", "completed", "failed", "replays",
                "handoffs_refused", "transfer_bytes", "recompilations",
                "prefill_pages_final", "decode_pages_final",
                "slots_active_final", "parity_ok", "ok"}
+SPEC_KEYS = {"backend", "submitted", "completed", "recompilations", "rungs",
+             "topology", "topologies_per_rung", "spec_steps",
+             "plain_decode_steps", "spec_decode_steps",
+             "codes_per_invocation", "accept_hist",
+             "scratch_pages_reserved", "parity_ok", "spans_ok",
+             "pages_in_use_final", "scratch_pages_final",
+             "slots_active_final", "ok"}
 # bench_gate is the new perf regression gate (one verdict line,
 # graftlint mold); check_obs's grown verdict (memory + slo sections) is
 # exercised by its own full run in ci_checks, not re-run here.
@@ -87,7 +94,7 @@ def test_check_scripts_keep_their_cli():
     for script in ("check_decode_hlo", "check_packed_hlo",
                    "check_fused_ce_hlo", "check_serving_hlo",
                    "check_catalog_hlo", "check_fleet", "check_disagg",
-                   "check_obs"):
+                   "check_spec_hlo", "check_obs"):
         proc = subprocess.run(
             [sys.executable, os.path.join(REPO, "scripts", f"{script}.py"),
              "--help"],
@@ -116,9 +123,15 @@ def test_ci_checks_smoke_entrypoint():
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     # One verdict JSON per check on stdout (decode, fused-ce, packed,
-    # serving, fleet, disagg, bench-gate self-test).
+    # serving, fleet, disagg, spec, bench-gate self-test).
     verdicts = [json.loads(l) for l in proc.stdout.splitlines() if l.strip()]
-    assert len(verdicts) == 7
+    assert len(verdicts) == 8
+    spec = [v for v in verdicts if "codes_per_invocation" in v]
+    assert len(spec) == 1 and set(spec[0]) == SPEC_KEYS
+    assert spec[0]["recompilations"] == 0 and spec[0]["parity_ok"]
+    assert spec[0]["topologies_per_rung"] == 1
+    assert spec[0]["codes_per_invocation"] > 1.0
+    assert spec[0]["scratch_pages_final"] == 0
     serving = [v for v in verdicts if "dense" in v]
     assert len(serving) == 1 and serving[0]["recompilations"] == 0
     assert set(serving[0]) == SERVING_KEYS  # harness migration parity
