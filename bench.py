@@ -670,6 +670,9 @@ def _serve_bench(model, params, valid_ids, rng, batch: int = SERVE_BATCH,
     # them gateable at all).
     try:
         out["fleet"] = _fleet_bench(model, params, valid_ids, rng)
+        # The fleet-path lineage overhead line lives in serve/obs beside
+        # the engine-level one (both gated off the same budget intent).
+        obs.update(out["fleet"].pop("tracing", {}))
     except Exception as e:
         print(f"bench: fleet benchmark failed: {e!r}", file=sys.stderr)
     # Disaggregated serving (genrec_tpu/disagg/): handoff latency
@@ -1034,7 +1037,7 @@ def _fleet_bench(model, params, valid_ids, rng, batch: int = 8) -> dict:
     from genrec_tpu.fleet import Burst, FleetRouter, TraceConfig, \
         generate_trace, replay
     from genrec_tpu.serving import (
-        BucketLadder, PagedConfig, ServingEngine, SLOTarget,
+        BucketLadder, PagedConfig, Request, ServingEngine, SLOTarget,
     )
     from genrec_tpu.serving.heads import TigerGenerativeHead
 
@@ -1055,6 +1058,46 @@ def _fleet_bench(model, params, valid_ids, rng, batch: int = 8) -> dict:
         )
 
     router = FleetRouter(make_replica, initial_replicas=2).start()
+
+    # Fleet-path lineage overhead, on the warmed (pre-burst, un-shed)
+    # fleet: closed-loop qps tracing-off vs tracing-on through the
+    # ROUTER (router route/reroute spans + replica request trees, the
+    # full per-request lineage of docs/OBSERVABILITY.md), swapped live
+    # via set_tracer. Gated (serve/obs/fleet_tracing_on_overhead_pct)
+    # with the same intent as the engine-level line: turning lineage on
+    # must not silently tax the hot path — the engine-level tracing-OFF
+    # path keeps its deterministic <2% pin in scripts/check_obs.py.
+    import numpy as np
+
+    from genrec_tpu.obs import SpanTracer
+
+    lat_rng = np.random.default_rng(3)
+
+    def fleet_closed_loop(window_s: float) -> float:
+        n = 0
+        t_end = time.perf_counter() + window_s
+        while time.perf_counter() < t_end:
+            req = Request(
+                head="tiger",
+                history=lat_rng.integers(0, len(valid_ids), items),
+                user_id=int(lat_rng.integers(0, 1_000_000)),
+            )
+            router.submit(req).result(300)
+            n += 1
+        return n / window_s
+
+    fleet_qps_off = fleet_closed_loop(1.5)
+    router.set_tracer(SpanTracer(capacity=16384))
+    fleet_qps_on = fleet_closed_loop(1.5)
+    router.set_tracer(None)
+    tracing = dict(
+        fleet_closed_qps_tracing_off=round(fleet_qps_off, 2),
+        fleet_closed_qps_tracing_on=round(fleet_qps_on, 2),
+        fleet_tracing_on_overhead_pct=round(
+            100.0 * (1.0 - fleet_qps_on / max(fleet_qps_off, 1e-9)), 2
+        ),
+    )
+
     trace_cfg = TraceConfig(
         n_requests=280, n_users=1_000_000, max_items=items,
         corpus_size=len(valid_ids), head="tiger", seed=12,
@@ -1089,6 +1132,7 @@ def _fleet_bench(model, params, valid_ids, rng, batch: int = 8) -> dict:
         fleet_shed_rejected=agg["fleet_shed_rejected"],
         rerouted=agg["rerouted"],
         recompilations_steady=agg["recompilations"],
+        tracing=tracing,
         note=(
             "2-replica FleetRouter of paged TIGER engines, seeded "
             "Zipfian open-loop trace over a 1M-user id space with "

@@ -42,6 +42,7 @@ deterministically for tests and the chaos harness.
 from __future__ import annotations
 
 import collections
+import dataclasses
 import logging
 import threading
 import time
@@ -60,6 +61,7 @@ from genrec_tpu.disagg.transport import (
 from genrec_tpu.disagg.workers import DecodeWorker, Flight, PrefillWorker
 from genrec_tpu.obs.flight_recorder import get_flight_recorder
 from genrec_tpu.obs.slo import SLOMonitor, SLOTarget
+from genrec_tpu.obs.spans import NULL_TRACER, SpanTracer, TraceContext
 from genrec_tpu.serving.buckets import BucketLadder, default_ladder
 from genrec_tpu.serving.kv_pool import KVPagePool, PagedConfig
 from genrec_tpu.serving.metrics import LatencyHistogram, ServingMetrics
@@ -68,6 +70,7 @@ from genrec_tpu.serving.types import (
     OverloadError,
     Request,
     UnknownHeadError,
+    normalize_spec_config,
 )
 
 
@@ -75,9 +78,9 @@ class _HeadGroup:
     """One head's role pools + in-flight handoffs."""
 
     __slots__ = ("head", "bank", "transport", "prefill", "decode",
-                 "pending", "seq")
+                 "pending", "seq", "spec_topology")
 
-    def __init__(self, head, bank, transport):
+    def __init__(self, head, bank, transport, spec_topology=None):
         self.head = head
         self.bank: Optional[KVPagePool] = bank
         self.transport: KVTransport = transport
@@ -88,6 +91,10 @@ class _HeadGroup:
         # a kill in between strands nothing that is still re-routable.
         self.pending: collections.deque = collections.deque()
         self.seq = {"prefill": 0, "decode": 0}
+        # ops.spec_tree.TreeTopology when this head speculates: shared
+        # by every decode worker in the group (one topology per rung —
+        # the check_spec_hlo pin, held across the split).
+        self.spec_topology = spec_topology
 
 
 class _RolePool:
@@ -135,6 +142,9 @@ class DisaggFront:
         params_step: Optional[int] = None,
         params_by_head: Optional[bool] = None,
         replica_id: Optional[str] = None,
+        spec_decode=False,
+        spec_fanout=8,
+        tracer: Optional[SpanTracer] = None,
         handle_signals: bool = False,
         guard=None,
         logger: Optional[logging.Logger] = None,
@@ -180,10 +190,25 @@ class DisaggFront:
         self._prefill_budget = prefill_hbm_budget_bytes
         self._decode_budget = decode_hbm_budget_bytes
         self.replica_id = replica_id
+        # Speculative decode on the decode POOL (the engine's exact
+        # opt-in surface, per front): True/False, or a set of head
+        # names. The decode workers compile the tree-verify rung
+        # ladder; prefill workers are untouched beyond the drafter-hint
+        # state enable_spec_drafting() adds to the head.
+        self._spec_decode, self._spec_fanout = normalize_spec_config(
+            spec_decode, spec_fanout, self._heads
+        )
         self._handle_signals = handle_signals
         self._guard = guard
         self._log = logger or logging.getLogger("genrec_tpu")
-        self._flight = get_flight_recorder()
+        self._flight = get_flight_recorder().scoped(
+            "disagg_front", replica_id=lambda: self.replica_id
+        )
+        # Request lineage: adopt an incoming Request.trace (a fleet
+        # router upstream) or mint one here — either way every worker
+        # span parents under this front's per-request span. Workers
+        # share THIS tracer (one span-id space per process).
+        self._tracer = tracer if tracer is not None else NULL_TRACER
         self.metrics = ServingMetrics()
         # Role-level SLO guard: {"prefill": SLOTarget, "decode":
         # SLOTarget} applied per head; the monitor keys on
@@ -243,6 +268,32 @@ class DisaggFront:
             pages_per_slot=-(-max_kv // page_size),
         )
 
+    def _spec_topology_for(self, head, cfg: PagedConfig):
+        """One TreeTopology per spec-enabled head group (every decode
+        worker's rungs compile the same tree). Calling
+        ``enable_spec_drafting()`` HERE — before any worker builds
+        state or compiles prefill — lets the head extend its slot
+        state/prefill with drafter hints, exactly the engine's
+        construction order."""
+        want = (
+            head.name in self._spec_decode
+            if isinstance(self._spec_decode, frozenset)
+            else bool(self._spec_decode)
+        )
+        if not (want and getattr(head, "supports_spec", False)
+                and head.spec_depth >= 1):
+            return None
+        from genrec_tpu.ops.spec_tree import TreeTopology
+
+        head.enable_spec_drafting()
+        return TreeTopology(head.top_k, self._spec_fanout, head.spec_depth)
+
+    @staticmethod
+    def _scratch_pages_per_worker(topo, cfg: PagedConfig) -> int:
+        if topo is None:
+            return 0
+        return cfg.max_slots * (-(-topo.n_nodes // cfg.page_size))
+
     def _build_group(self, head) -> _HeadGroup:
         cfg = self._paged_config or self._default_config(head)
         max_kv = head.paged_kv_tokens(10**9, self._ladder.history_buckets[-1])
@@ -252,24 +303,29 @@ class DisaggFront:
                 f"but head {head.name!r} needs {max_kv} at the largest "
                 "history bucket"
             )
+        topo = self._spec_topology_for(head, cfg)
         n_layers, n_heads, head_dim, dtype = head.paged_layout()
         if self._transport_kind == "inprocess":
             # One shared page bank per head: decode workers are slot
             # VIEWS over it, prefill writes raw runs into it — the
             # zero-copy handoff substrate. Sized for every decode slot
             # plus in-flight prefill staging (retained prefix pages ride
-            # inside and reclaim under pressure).
-            bank_pages = self._bank_num_pages or (
+            # inside and reclaim under pressure), EXTENDED by each
+            # speculative decode worker's scratch reservation so
+            # speculation never eats admission capacity.
+            bank_pages = (self._bank_num_pages or (
                 1 + cfg.pages_per_slot
                 * (self._n_decode * cfg.max_slots + 2 * self._max_batch)
-            )
+            )) + self._n_decode * self._scratch_pages_per_worker(topo, cfg)
             bank_cfg = PagedConfig(
                 max_slots=1, page_size=cfg.page_size,
                 pages_per_slot=cfg.pages_per_slot, num_pages=bank_pages,
             )
             bank = KVPagePool(bank_cfg, n_layers, n_heads, head_dim, dtype)
-            return _HeadGroup(head, bank, InProcessTransport(bank))
-        return _HeadGroup(head, None, SerializingTransport())
+            return _HeadGroup(head, bank, InProcessTransport(bank),
+                              spec_topology=topo)
+        return _HeadGroup(head, None, SerializingTransport(),
+                          spec_topology=topo)
 
     def _make_prefill(self, group: _HeadGroup) -> PrefillWorker:
         head = group.head
@@ -292,10 +348,13 @@ class DisaggFront:
             ladder=self._ladder, transport=group.transport, pool=pool,
             owns_pool=owns, max_batch=self._max_batch,
             max_wait_s=self._max_wait_s, metrics=self.metrics,
-            flight_recorder=self._flight, params_step=self._step,
+            flight_recorder=self._flight.scoped("prefill_worker",
+                                                worker_id=wid),
+            params_step=self._step,
             prefix_cache=self._prefix_cache,
             prefix_cache_entries=self._prefix_cache_entries,
-            hbm_budget_bytes=self._prefill_budget, logger=self._log,
+            hbm_budget_bytes=self._prefill_budget,
+            tracer=self._tracer, logger=self._log,
         )
 
     def _make_decode(self, group: _HeadGroup) -> DecodeWorker:
@@ -303,6 +362,7 @@ class DisaggFront:
         wid = f"{head.name}:d{group.seq['decode']}"
         group.seq["decode"] += 1
         cfg = self._paged_config or self._default_config(head)
+        scratch = self._scratch_pages_per_worker(group.spec_topology, cfg)
         n_layers, n_heads, head_dim, dtype = head.paged_layout()
         if group.bank is not None:
             view_cfg = PagedConfig(
@@ -314,16 +374,28 @@ class DisaggFront:
                               bank=group.bank)
             owns = False
         else:
+            # Serializing tier: each decode worker owns its pool —
+            # extend it by the scratch reservation (an explicit
+            # paged_config keeps its admission capacity; the ledger
+            # sees the real total — the engine's discipline).
+            if scratch:
+                cfg = dataclasses.replace(
+                    cfg, num_pages=cfg.num_pages + scratch
+                )
             pool = KVPagePool(cfg, n_layers, n_heads, head_dim, dtype)
             owns = True
         return DecodeWorker(
             wid, head, self._select(head, self._params),
             transport=group.transport, pool=pool, owns_pool=owns,
             ladder=self._ladder, metrics=self.metrics,
-            flight_recorder=self._flight,
+            flight_recorder=self._flight.scoped("decode_worker",
+                                                worker_id=wid),
             slot_floor=min(self._max_batch, cfg.max_slots),
             params_step=self._step, replica_id=self.replica_id,
-            hbm_budget_bytes=self._decode_budget, logger=self._log,
+            hbm_budget_bytes=self._decode_budget,
+            spec_topology=group.spec_topology,
+            spec_fanout=self._spec_fanout,
+            tracer=self._tracer, logger=self._log,
         )
 
     # -- lifecycle -----------------------------------------------------------
@@ -418,6 +490,17 @@ class DisaggFront:
     def params_step(self) -> Optional[int]:
         return self._step
 
+    def set_tracer(self, tracer: Optional[SpanTracer]) -> None:
+        """Swap lineage tracing live, front-wide: the front's own spans
+        and every worker's. The workers read their ``tracer`` attribute
+        per call, so this is a plain reference swap (the engine's
+        set_tracer contract, one level down)."""
+        self._tracer = tracer if tracer is not None else NULL_TRACER
+        with self._runtime:
+            for group in self._groups.values():
+                for w in group.prefill + group.decode:
+                    w.tracer = self._tracer
+
     # -- request path --------------------------------------------------------
 
     def submit(self, req: Request) -> Future:
@@ -444,6 +527,7 @@ class DisaggFront:
                     f"head {req.head!r} disagg pools are load-shedding; "
                     "back off and retry or fail over"
                 )
+            self._attach_trace(flight)
             try:
                 self._enqueue_locked(flight)
             except WorkerLostError as e:
@@ -460,6 +544,48 @@ class DisaggFront:
             self._work.notify()
         self.metrics.record_submit(head=req.head)
         return flight.fut
+
+    def _attach_trace(self, flight: Flight) -> None:
+        """Adopt the request's incoming lineage (a fleet router above
+        us) or mint it here, and pre-allocate this front's per-request
+        span: the prefill worker's admission/prefill spans, the
+        handoff's wire spans and the decode worker's residency span all
+        parent onto it, and it is recorded — submit to future-resolve,
+        reroutes included — when the caller's future settles."""
+        req = flight.req
+        ctx_in = req.trace
+        tracer = self._tracer
+        if not tracer.enabled:
+            if ctx_in is not None:
+                # Tracing off on this front but the request IS traced:
+                # carry the id (Response.request_id provenance); span
+                # recording no-ops downstream.
+                flight.trace = ctx_in
+            return
+        tid = ctx_in.trace_id if ctx_in is not None else tracer.new_trace()
+        parent = ctx_in.parent_span_id if ctx_in is not None else None
+        origin = ctx_in.origin if ctx_in is not None else "disagg_front"
+        fspan = tracer.allocate_span_id()
+        flight.trace = TraceContext(tid, fspan, origin)
+        t_sub = flight.t_enq
+        ident = {"component": "disagg_front"}
+        if self.replica_id is not None:
+            ident["replica"] = self.replica_id
+
+        def _record_request(f, tid=tid, fspan=fspan, parent=parent,
+                            t_sub=t_sub, head_name=req.head,
+                            origin=origin, ident=ident):
+            try:
+                outcome = "error" if f.exception() else "ok"
+            except Exception:  # noqa: BLE001 — cancelled future
+                outcome = "cancelled"
+            tracer.record_span(
+                "request", tid, t_sub, time.monotonic(), span_id=fspan,
+                parent_id=parent, head=head_name, origin=origin,
+                outcome=outcome, **ident,
+            )
+
+        flight.fut.add_done_callback(_record_request)
 
     def serve(self, req: Request, timeout: Optional[float] = 60.0):
         return self.submit(req).result(timeout)
@@ -569,6 +695,7 @@ class DisaggFront:
                 group.transport.release(handoff)
                 continue
             tb = handoff.transfer_bytes
+            t_adm0 = time.monotonic()
             try:
                 target.validate(handoff)
                 admitted = target.admit(fl, handoff)
@@ -597,6 +724,22 @@ class DisaggFront:
             if not admitted:
                 group.pending.appendleft((fl, handoff, t_sent))
                 break
+            if fl.trace is not None and self._tracer.enabled:
+                tr = fl.trace
+                # The tail's two disagg-specific segments: time the
+                # handoff sat waiting for a free decode slot, and the
+                # receive side of the wire (unpack + scatter + bind).
+                self._tracer.record_span(
+                    "decode_slot_wait", tr.trace_id, t_sent, t_adm0,
+                    parent_id=tr.parent_span_id, component="disagg_front",
+                    worker=target.worker_id,
+                )
+                self._tracer.record_span(
+                    "handoff_wire", tr.trace_id, t_adm0, time.monotonic(),
+                    parent_id=tr.parent_span_id, side="admit",
+                    transport=group.transport.name, transfer_bytes=tb,
+                    component="decode_worker", worker=target.worker_id,
+                )
             self._counters["handoffs_admitted"] += 1
             self._counters["transfer_bytes"] += tb
             self.transfer.record(time.monotonic() - t_sent)
@@ -624,13 +767,21 @@ class DisaggFront:
         )
 
     def _finish_drain(self) -> None:
-        # Release every retained prefix page so the banks/pools account
-        # clean at shutdown (pages released after drain — the
-        # check_disagg bar, both sides).
+        # Release every retained prefix page — and every speculative
+        # scratch reservation — so the banks/pools account clean at
+        # shutdown (pages released after drain — the check_disagg bar,
+        # both sides; scratch_pages == 0 is the check_spec bar).
         with self._runtime:
             for group in self._groups.values():
                 for pw in group.prefill:
                     pw.clear_prefix_cache("drain")
+                for dw in group.decode:
+                    n = dw.pool.release_scratch()
+                    if n:
+                        self._flight.record(
+                            "spec_scratch_released", head=group.head.name,
+                            worker_id=dw.worker_id, reason="drain", pages=n,
+                        )
         self._flight.record("disagg_stopped",
                             completed=self.metrics.completed)
         self._drained.set()
@@ -764,6 +915,8 @@ class DisaggFront:
         self._flight.record(
             "handoff_resubmitted", head=group.head.name,
             worker_from=from_worker,
+            trace_id=flight.trace.trace_id
+            if flight.trace is not None else None,
         )
 
     def _find(self, worker_id: str, role: str):
@@ -856,6 +1009,9 @@ class DisaggFront:
                 )
             with self._runtime:
                 group.decode.remove(worker)
+                # A removed worker's scratch reservation leaves with it
+                # (its refs would pin shared-bank pages forever).
+                worker.pool.release_scratch()
         group.transport.forget(worker.pool)
         final = worker.stats()
         self._flight.record(
@@ -926,6 +1082,7 @@ class DisaggFront:
             }
         snap["headroom"] = headroom
         snap["kv_pool"] = kv_pool
+        snap["tracing"] = self._tracer.stats()
         snap["disagg"] = {
             "transport": self._transport_kind,
             **dict(self._counters),

@@ -15,8 +15,9 @@ trie.
 
 The WIRE format (`pack_handoff`/`unpack_handoff`) is the cross-host
 contract, pinned by ``WIRE_VERSION`` and tests/test_disagg.py: a JSON
-header (provenance + layout + array manifest) followed by raw
-little-endian array bytes, framed inside one ``.npz`` container. The
+header (provenance + layout + request lineage + array manifest)
+followed by raw little-endian array bytes, framed inside one ``.npz``
+container. The
 serializing in-process transport round-trips every handoff through it,
 so a future cross-host backend is a transport swap — the bytes already
 mean the same thing on both sides.
@@ -31,11 +32,18 @@ from typing import Optional
 
 import numpy as np
 
+from genrec_tpu.obs.spans import TraceContext
 from genrec_tpu.serving.types import ServingError
 
 #: Bump when the pack/unpack layout changes; unpack refuses other
 #: versions (typed) instead of misreading bytes.
-WIRE_VERSION = 1
+#: v2: the header carries the request's lineage (``trace`` —
+#: obs.TraceContext as {trace_id, parent_span_id, origin}), so the
+#: decode side of a cross-host hop attaches its spans to the SAME
+#: rooted trace the router/prefill side started (docs/OBSERVABILITY.md
+#: "Request lineage"). v1 payloads are refused typed like any other
+#: version skew.
+WIRE_VERSION = 2
 
 
 class DisaggError(ServingError):
@@ -92,6 +100,11 @@ class KVHandoff:
     catalog_version: Optional[str]
     prefill_worker_id: str
     warm: bool = False          # served from the prefill worker's prefix cache
+    #: Request lineage (obs.TraceContext): rides the handoff by
+    #: reference on the in-process tier and inside the wire header on
+    #: the serializing tier, so the receiving decode worker's spans
+    #: attach under the same trace the prefill side recorded into.
+    trace: Optional[TraceContext] = None
     pages: Optional[list] = None
     wire: Optional[bytes] = None
 
@@ -126,6 +139,8 @@ def pack_handoff(handoff: KVHandoff, k_content, v_content) -> bytes:
         "catalog_version": handoff.catalog_version,
         "prefill_worker_id": handoff.prefill_worker_id,
         "warm": bool(handoff.warm),
+        "trace": (handoff.trace.to_header()
+                  if handoff.trace is not None else None),
         "n_layers": len(k_content),
         "state_keys": sorted(handoff.init) if handoff.init else [],
     }
@@ -168,6 +183,7 @@ def unpack_handoff(data: bytes) -> tuple[KVHandoff, tuple, tuple]:
         catalog_version=header["catalog_version"],
         prefill_worker_id=header["prefill_worker_id"],
         warm=bool(header["warm"]),
+        trace=TraceContext.from_header(header.get("trace")),
         wire=data,
     )
     return handoff, k_content, v_content

@@ -52,6 +52,7 @@ from genrec_tpu.disagg.handoff import (
     layout_of,
 )
 from genrec_tpu.obs.memory import MemoryLedger, tree_nbytes
+from genrec_tpu.obs.spans import NULL_TRACER
 from genrec_tpu.serving.aot import donate_argnums as _donate, sds_tree as _sds
 from genrec_tpu.serving.kv_pool import (
     KVPagePool,
@@ -64,7 +65,7 @@ from genrec_tpu.serving.types import HBMBudgetError, Response
 class Flight:
     """One accepted request moving through the role pipeline."""
 
-    __slots__ = ("req", "fut", "t_enq", "retried")
+    __slots__ = ("req", "fut", "t_enq", "retried", "trace")
 
     def __init__(self, req, fut: Optional[Future] = None,
                  t_enq: Optional[float] = None, retried: bool = False):
@@ -72,6 +73,11 @@ class Flight:
         self.fut = fut if fut is not None else Future()
         self.t_enq = t_enq if t_enq is not None else time.monotonic()
         self.retried = retried  # at-most-once worker-loss re-submit spent
+        # Request lineage (obs.TraceContext), parented under the front's
+        # per-request span — set by DisaggFront.submit; every worker
+        # span for this flight attaches here. Survives re-submit after
+        # a worker death, so the retry stays in the ORIGINAL trace.
+        self.trace = None
 
 
 class PrefillWorker:
@@ -93,6 +99,7 @@ class PrefillWorker:
                  params_step: Optional[int] = None, prefix_cache: bool = True,
                  prefix_cache_entries: int = 4096,
                  hbm_budget_bytes: Optional[int] = None,
+                 tracer=None,
                  logger: Optional[logging.Logger] = None):
         self.worker_id = worker_id
         self.head = head
@@ -106,6 +113,7 @@ class PrefillWorker:
         self.metrics = metrics
         self._flight = flight_recorder
         self.params_step = params_step
+        self.tracer = tracer if tracer is not None else NULL_TRACER
         self._log = logger or logging.getLogger("genrec_tpu")
         # Guarded by the FRONT's lock: submit threads append, the front
         # runtime thread pops.
@@ -276,6 +284,7 @@ class PrefillWorker:
                 return []
             group = [self.queue.popleft()
                      for _ in range(min(len(self.queue), self.max_batch))]
+        t_pop = time.monotonic()
         head = self.head
         max_hist = self.ladder.history_buckets[-1]
         out: list[tuple[Flight, KVHandoff]] = []
@@ -288,6 +297,7 @@ class PrefillWorker:
                    if self.prefix is not None else None)
             entry = None
             if key is not None:
+                t0 = time.monotonic()
                 entry, matched = self.prefix.lookup(key)
                 if entry is not None and entry.n_tokens != n_tok:
                     entry = None  # same key, different KV footprint: cold
@@ -298,16 +308,28 @@ class PrefillWorker:
                         head.name, outcome,
                         tokens=entry.n_tokens if entry is not None else 0,
                     )
+                    if fl.trace is not None:
+                        self.tracer.record_span(
+                            "prefix_lookup", fl.trace.trace_id, t0,
+                            time.monotonic(),
+                            parent_id=fl.trace.parent_span_id,
+                            outcome=outcome, matched_tokens=int(matched),
+                            **self._span_ident(),
+                        )
             if entry is not None:
                 warm.append((fl, entry))
             else:
                 cold.append((fl, key, n_tok))
         for fl, entry in warm:
             self._oom_counted.discard(id(fl))
+            t0 = time.monotonic()
             handoff = self._make_handoff(
-                entry.n_tokens, entry.bucket, entry.init, warm=True)
+                entry.n_tokens, entry.bucket, entry.init, warm=True,
+                trace=fl.trace)
             try:
+                tw0 = time.monotonic()
                 self.transport.send(self.pool, entry.pages, handoff)
+                tw1 = time.monotonic()
             except Exception as e:  # noqa: BLE001 — fail THIS flight only
                 # The flight is already popped from the queue: anything
                 # escaping pump() would strand its future unresolved
@@ -322,25 +344,66 @@ class PrefillWorker:
                 continue
             self.prefix.touch(entry.key)
             entry.hits += 1
+            if fl.trace is not None:
+                self._record_handoff_spans(
+                    fl, t_pop, warm_t0=t0,
+                    wire=(tw0, tw1, handoff.transfer_bytes))
             out.append((fl, handoff))
         if cold:
-            out.extend(self._prefill_cold(cold, lock))
+            out.extend(self._prefill_cold(cold, lock, t_pop))
         self._publish_reclaimable()
         return out
 
-    def _make_handoff(self, n_tokens: int, bucket, init, warm: bool):
+    def _span_ident(self) -> dict:
+        return {"component": "prefill_worker", "worker": self.worker_id}
+
+    def _record_handoff_spans(self, fl: Flight, t_pop: float, *,
+                              warm_t0: float | None = None,
+                              admission=None, prefill=None,
+                              wire=None) -> None:
+        """One flight's prefill-side span set, attached under the
+        front's per-request span (fl.trace.parent_span_id):
+        queue_wait, then warm_admit OR admission+prefill, then the
+        send side of handoff_wire."""
+        tr = fl.trace
+        ident = self._span_ident()
+        rs = self.tracer.record_span
+        rs("queue_wait", tr.trace_id, fl.t_enq, t_pop,
+           parent_id=tr.parent_span_id, **ident)
+        if warm_t0 is not None:
+            rs("warm_admit", tr.trace_id, warm_t0, time.monotonic(),
+               parent_id=tr.parent_span_id, **ident)
+        if admission is not None:
+            rs("admission", tr.trace_id, admission[0], admission[1],
+               parent_id=tr.parent_span_id, **ident)
+        if prefill is not None:
+            t0, t1, B, L = prefill
+            rs("prefill", tr.trace_id, t0, t1,
+               parent_id=tr.parent_span_id, bucket_b=B, bucket_l=L,
+               **ident)
+        if wire is not None:
+            tw0, tw1, nbytes = wire
+            rs("handoff_wire", tr.trace_id, tw0, tw1,
+               parent_id=tr.parent_span_id, side="send",
+               transport=self.transport.name, transfer_bytes=int(nbytes),
+               **ident)
+
+    def _make_handoff(self, n_tokens: int, bucket, init, warm: bool,
+                      trace=None):
         return KVHandoff(
             head=self.head.name, n_tokens=int(n_tokens), bucket=bucket,
             layout=layout_of(self.head), init=init,
             params_step=self.params_step,
             catalog_version=self.head.catalog_version,
-            prefill_worker_id=self.worker_id, warm=warm,
+            prefill_worker_id=self.worker_id, warm=warm, trace=trace,
         )
 
-    def _prefill_cold(self, cold, lock) -> list[tuple[Flight, KVHandoff]]:
+    def _prefill_cold(self, cold, lock,
+                      t_pop: float) -> list[tuple[Flight, KVHandoff]]:
         import jax.numpy as jnp
 
         head = self.head
+        t_alloc0 = time.monotonic()
         runs, admitted = [], []
         for fl, key, n_tok in cold:
             try:
@@ -372,6 +435,7 @@ class PrefillWorker:
         bt = np.zeros((B, self.pool.cfg.pages_per_slot), np.int32)
         for i, run in enumerate(runs):
             bt[i, : len(run)] = run
+        t_run0 = time.monotonic()
         try:
             args = head.make_batch(reqs, B, L)
             k_pools, v_pools, init = compiled(
@@ -389,6 +453,7 @@ class PrefillWorker:
                     fl.fut.set_exception(e)
             self.metrics.record_failure(len(admitted))
             return []
+        t_run1 = time.monotonic()
         self.prefills += len(admitted)
         self.metrics.record_batch(head.name, (B, L))
         out = []
@@ -401,9 +466,12 @@ class PrefillWorker:
                 self.prefix.insert(key, n_tokens=n_tok, pages=run,
                                    init=snapshot, bucket=(B, L))
                 self.metrics.record_prefix_insert(head.name)
-            handoff = self._make_handoff(n_tok, (B, L), snapshot, warm=False)
+            handoff = self._make_handoff(n_tok, (B, L), snapshot, warm=False,
+                                         trace=fl.trace)
             try:
+                tw0 = time.monotonic()
                 self.transport.send(self.pool, run, handoff)
+                tw1 = time.monotonic()
             except Exception as e:  # noqa: BLE001 — fail THIS flight only
                 # Same guarantee as the warm loop: the temp alloc ref
                 # still drops (no page leak in the staging pool) and the
@@ -419,6 +487,11 @@ class PrefillWorker:
                 self.metrics.record_failure(1)
                 continue
             self.pool.allocator.free(run)  # drop the temp alloc ref
+            if fl.trace is not None:
+                self._record_handoff_spans(
+                    fl, t_pop, admission=(t_alloc0, t_run0),
+                    prefill=(t_run0, t_run1, B, L),
+                    wire=(tw0, tw1, handoff.transfer_bytes))
             out.append((fl, handoff))
         return out
 
@@ -464,7 +537,14 @@ class PrefillWorker:
 
 
 class DecodeWorker:
-    """Slot-level continuous batching over decode-only executables."""
+    """Slot-level continuous batching over decode-only executables.
+
+    With ``spec_topology`` set (the front computes one `TreeTopology`
+    per spec-enabled head group), the worker compiles the tree-verify
+    step INSTEAD of the plain decode step at every slot rung — the
+    engine's speculative path, per worker — and reserves the scratch
+    pages the tree's candidate K/V lands in out of its pool, so
+    speculation never competes with handoff admissions."""
 
     role = "decode"
 
@@ -474,6 +554,8 @@ class DecodeWorker:
                  params_step: Optional[int] = None,
                  replica_id: Optional[str] = None,
                  hbm_budget_bytes: Optional[int] = None,
+                 spec_topology=None, spec_fanout=8,
+                 tracer=None,
                  logger: Optional[logging.Logger] = None):
         self.worker_id = worker_id
         self.head = head
@@ -486,12 +568,48 @@ class DecodeWorker:
         self._flight = flight_recorder
         self.params_step = params_step
         self.replica_id = replica_id
+        self.tracer = tracer if tracer is not None else NULL_TRACER
         self._log = logger or logging.getLogger("genrec_tpu")
         cfg = pool.cfg
+        self.spec_topology = spec_topology
+        self.spec_fanout = spec_fanout
+        if spec_topology is not None:
+            # Scratch-page reservation (the engine's discipline, per
+            # worker): the pool/bank the front built at CONSTRUCTION
+            # already includes the demand, so reserving here never eats
+            # admission capacity for the initial workers.
+            per_slot = -(-spec_topology.n_nodes // cfg.page_size)
+            self._scratch_demand = cfg.max_slots * per_slot
+        else:
+            self._scratch_demand = 0
+        try:
+            self._scratch_tables = pool.reserve_scratch(self._scratch_demand)
+        except PoolExhausted:
+            # A bank-backed worker added PAST the group's initial sizing
+            # (decode role scale-out on the in-process tier): the shared
+            # bank was provisioned for the construction-time worker
+            # count, so this reservation may not fit. Degrade narrated
+            # instead of failing the scale-out mid-construction — the
+            # pure-JAX tree-verify fallback never touches the scratch
+            # pages (they are the TPU kernel's landing zone), so serving
+            # on this tier is unaffected; a real TPU deployment
+            # re-provisions the bank instead. (On the serializing tier
+            # the worker owns its pool, sized to include the demand, so
+            # this path cannot fire there.)
+            wanted, self._scratch_demand = self._scratch_demand, 0
+            self._scratch_tables = pool.reserve_scratch(0)
+            self._flight.record("spec_scratch_unreserved",
+                                worker_id=worker_id, pages_wanted=wanted)
+            self._log.warning(
+                f"disagg: decode worker {worker_id} joined a shared bank "
+                "with no room for its speculative scratch reservation — "
+                "proceeding unreserved (CPU fallback unaffected)"
+            )
         self.state = head.paged_state_zeros(cfg.max_slots)
         self.steps = np.zeros(cfg.max_slots, np.int32)
         self.active = np.zeros(cfg.max_slots, bool)
-        # (flight, handoff, t_admit) per slot
+        # (flight, handoff, t_admit, span_ctx) per slot; span_ctx is
+        # (trace_id, slot_residency_span_id, parent_span_id) or None.
         self.entries: list = [None] * cfg.max_slots
         shapes = []
         s = cfg.max_slots
@@ -503,6 +621,7 @@ class DecodeWorker:
             s = max(s // 2, floor)
         self.slot_shapes = sorted(set(shapes))
         self._decode: dict[int, object] = {}
+        self._spec: dict[int, object] = {}
         self._transport_execs: list = []
         self.warmup_compiles = 0
         self.recompilations = 0
@@ -536,6 +655,19 @@ class DecodeWorker:
 
         fn = self.head.make_decode_paged_fn()
         ops = self.head.runtime_operands()
+        return self._compile_step_fn(fn, ops, S, jax)
+
+    def _compile_spec(self, S: int):
+        """The tree-verify executable at rung S (engine's
+        _PagedRunner._compile_spec, per worker): identical operand
+        surface to the plain step, returns (state, accept_len)."""
+        import jax
+
+        fn = self.head.make_spec_decode_paged_fn(self.spec_fanout)
+        ops = self.head.runtime_operands()
+        return self._compile_step_fn(fn, ops, S, jax)
+
+    def _compile_step_fn(self, fn, ops, S: int, jax):
         args = (
             self.params,
             *(_sds(op) for op in ops),
@@ -557,10 +689,16 @@ class DecodeWorker:
 
     def warmup(self) -> None:
         # Operands-first (see PrefillWorker.warmup): an impossible
-        # decode-side budget refuses before any compile is paid.
+        # decode-side budget refuses before any compile is paid. A
+        # speculative worker compiles the tree-verify step INSTEAD of
+        # the plain step at every rung (the verified-rejection worst
+        # case IS the plain step — the engine's discipline).
         self._ledger(operands_only=True)
         for S in self.slot_shapes:
-            self._decode[S] = self._compile_decode(S)
+            if self.spec_topology is not None:
+                self._spec[S] = self._compile_spec(S)
+            else:
+                self._decode[S] = self._compile_decode(S)
         self.transport.prepare_admit(self.pool, self._count_transport_compile)
         self._ledger()
         self._warm = True
@@ -590,6 +728,8 @@ class DecodeWorker:
                            tree_nbytes(self.state))
         for S, ex in self._decode.items():
             led.record_executable(self.worker_id, f"decode/S{S}", ex)
+        for S, ex in self._spec.items():
+            led.record_executable(self.worker_id, f"spec_decode/S{S}", ex)
         for i, ex in enumerate(self._transport_execs):
             led.record_executable(self.worker_id, f"transport/{i}", ex)
         if self._hbm_budget is not None:
@@ -686,7 +826,19 @@ class DecodeWorker:
             ) from e
         self.steps[slot] = self.head.paged_init_step
         self.active[slot] = True
-        self.entries[slot] = (flight, handoff, time.monotonic())
+        # Slot-residency span: pre-allocate its id so the decode/spec
+        # step spans recorded BEFORE the slot finishes can parent onto
+        # it (the engine's allocate-before-record discipline). The
+        # lineage comes off the HANDOFF — on a cross-host hop the wire
+        # header is the only carrier — falling back to the flight's.
+        ctx = handoff.trace if handoff.trace is not None else flight.trace
+        span_ctx = None
+        if ctx is not None and self.tracer.enabled:
+            span_ctx = (ctx.trace_id, self.tracer.allocate_span_id(),
+                        ctx.parent_span_id)
+        elif ctx is not None:
+            span_ctx = (ctx.trace_id, None, ctx.parent_span_id)
+        self.entries[slot] = (flight, handoff, time.monotonic(), span_ctx)
         self.transport.release(handoff)
         self.admitted += 1
         self.metrics.record_admit(1)
@@ -694,16 +846,23 @@ class DecodeWorker:
 
     # -- decode --------------------------------------------------------------
 
+    def _decode_span_ident(self) -> dict:
+        return {"component": "decode_worker", "worker": self.worker_id}
+
     def step(self) -> bool:
-        """Advance every active slot one decode position (the engine's
+        """Advance every active slot — one decode position through the
+        plain step, or 1..(1 + spec_depth) positions through the
+        tree-verify step when this worker speculates (the engine's
         fixed-shape step, per worker)."""
         if self.idle:
             return False
         import jax.numpy as jnp
 
+        spec = self.spec_topology is not None
         hi = int(np.nonzero(self.active)[0][-1]) + 1
         S = next(s for s in self.slot_shapes if s >= hi)
-        out = self._decode[S](
+        t_stage = time.monotonic()
+        args = (
             self.params,
             *self.head.runtime_operands(),
             {k: jnp.asarray(v[:S]) for k, v in self.state.items()},
@@ -714,11 +873,73 @@ class DecodeWorker:
             self.pool.k_pools,
             self.pool.v_pools,
         )
+        t0 = time.monotonic()
+        if spec:
+            out, accept = self._spec[S](*args)
+        else:
+            out = self._decode[S](*args)
         for k, v in out.items():
             self.state[k][:S] = np.asarray(v)
-        self.steps[self.active] += 1
+        active_idx = np.nonzero(self.active)[0]
+        if spec:
+            # Accept-length clamp: exactly the engine's (root level is
+            # always exact, never overshoot a slot's remaining codes).
+            total = self.head.paged_total_steps
+            adv = np.minimum(
+                np.asarray(accept)[active_idx],
+                total - self.steps[active_idx],
+            ).astype(np.int32)
+            adv = np.maximum(adv, 1)
+        t1 = time.monotonic()
+        if self.tracer.enabled:
+            ident = self._decode_span_ident()
+            for i, slot in enumerate(active_idx):
+                span_ctx = self.entries[slot][3]
+                if span_ctx is None:
+                    continue
+                tid, sid = span_ctx[0], span_ctx[1]
+                if spec:
+                    self.tracer.record_span(
+                        "draft", tid, t_stage, t0, parent_id=sid,
+                        step=int(self.steps[slot]),
+                        drafted=int(self.spec_topology.n_nodes
+                                    - self.spec_topology.beams),
+                        **ident,
+                    )
+                    self.tracer.record_span(
+                        "tree_verify", tid, t0, t1, parent_id=sid,
+                        step=int(self.steps[slot]), slots=S,
+                        accept_len=int(adv[i]), **ident,
+                    )
+                else:
+                    self.tracer.record_span(
+                        "decode_step", tid, t0, t1, parent_id=sid,
+                        step=int(self.steps[slot]), slots=S, **ident,
+                    )
+        if spec:
+            self.steps[active_idx] += adv
+            self.metrics.record_decode_step()
+            self.metrics.record_spec(
+                self.head.name,
+                drafted=len(active_idx)
+                * (self.spec_topology.n_nodes - self.spec_topology.beams),
+                accept_lens=adv,
+            )
+            if self.tracer.enabled:
+                t2 = time.monotonic()
+                ident = self._decode_span_ident()
+                for i, slot in enumerate(active_idx):
+                    span_ctx = self.entries[slot][3]
+                    if span_ctx is not None:
+                        self.tracer.record_span(
+                            "accept", span_ctx[0], t1, t2,
+                            parent_id=span_ctx[1],
+                            accept_len=int(adv[i]), **ident,
+                        )
+        else:
+            self.steps[self.active] += 1
+            self.metrics.record_decode_step()
         self.decode_steps += 1
-        self.metrics.record_decode_step()
         self.sweep_finished()
         return True
 
@@ -727,7 +948,7 @@ class DecodeWorker:
         done = np.nonzero(self.active
                           & (self.steps >= head.paged_total_steps))[0]
         for slot in done:
-            flight, handoff, t_admit = self.entries[slot]
+            flight, handoff, t_admit, span_ctx = self.entries[slot]
             now = time.monotonic()
             try:
                 payload = head.paged_finalize(
@@ -745,6 +966,7 @@ class DecodeWorker:
                     queue_wait_s=t_admit - flight.t_enq,
                     compute_s=now - t_admit,
                     total_s=now - flight.t_enq,
+                    request_id=span_ctx[0] if span_ctx is not None else None,
                     replica_id=self.replica_id,
                     prefill_worker_id=handoff.prefill_worker_id,
                     decode_worker_id=self.worker_id,
@@ -761,6 +983,21 @@ class DecodeWorker:
                     resp.queue_wait_s, resp.compute_s, resp.total_s,
                     head=head.name,
                 )
+                if span_ctx is not None:
+                    tid, sid, parent = span_ctx
+                    t_final = time.monotonic()
+                    ident = self._decode_span_ident()
+                    self.tracer.record_span(
+                        "finalize", tid, now, t_final, parent_id=sid,
+                        **ident,
+                    )
+                    # The residency umbrella: admit -> evict, parenting
+                    # every decode/spec step span this slot recorded.
+                    self.tracer.record_span(
+                        "slot_residency", tid, t_admit, t_final,
+                        span_id=sid, parent_id=parent, slot=int(slot),
+                        **ident,
+                    )
                 if not flight.fut.done():
                     flight.fut.set_result(resp)
             self.pool.evict(int(slot))
@@ -779,12 +1016,26 @@ class DecodeWorker:
         self.dead = True
         stranded = []
         for slot in np.nonzero(self.active)[0]:
-            flight, _handoff, _t = self.entries[slot]
+            flight, _handoff, t_admit, span_ctx = self.entries[slot]
             if not flight.fut.done():
                 stranded.append(flight)
+            if span_ctx is not None:
+                # Close the residency span typed: the trace shows WHERE
+                # the request was when its worker died, and the reroute
+                # span the front records next stays in the same tree.
+                tid, sid, parent = span_ctx
+                self.tracer.record_span(
+                    "slot_residency", tid, t_admit, time.monotonic(),
+                    span_id=sid, parent_id=parent, slot=int(slot),
+                    outcome="worker_killed", **self._decode_span_ident(),
+                )
             self.pool.evict(int(slot))
             self.active[slot] = False
             self.entries[slot] = None
+        # The emulated device dies with the worker: drop the scratch
+        # reservation's refs too, or the shared bank would leak the
+        # casualty's pinned pages forever.
+        self.pool.release_scratch()
         return stranded
 
     def stats(self) -> dict:
@@ -795,6 +1046,7 @@ class DecodeWorker:
             "headroom": self.headroom(),
             "admitted": self.admitted,
             "decode_steps": self.decode_steps,
+            "scratch_pages": self.pool.scratch_page_count,
             "warmup_compiles": self.warmup_compiles,
             "recompilations": self.recompilations,
             "hbm": self.memory.summary(budget_bytes=self._hbm_budget),

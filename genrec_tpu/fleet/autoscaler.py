@@ -72,7 +72,7 @@ class Autoscaler:
         self.router = router
         self.config = config or AutoscalerConfig()
         self._log = logger or logging.getLogger("genrec_tpu")
-        self._flight = get_flight_recorder()
+        self._flight = get_flight_recorder().scoped("autoscaler")
         self._lock = threading.Lock()
         self._breach_since: Optional[float] = None
         self._idle_since: Optional[float] = None

@@ -51,6 +51,7 @@ the process-level signal path belongs to whoever owns the fleet (one
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 import threading
 import time
@@ -58,6 +59,7 @@ from concurrent.futures import Future
 from typing import Callable, Optional
 
 from genrec_tpu.obs.flight_recorder import get_flight_recorder
+from genrec_tpu.obs.spans import NULL_TRACER, SpanTracer, TraceContext
 from genrec_tpu.serving.types import (
     DrainingError,
     OverloadError,
@@ -117,6 +119,7 @@ class FleetRouter:
         *,
         initial_replicas: int = 2,
         headroom_refresh_s: float = 0.05,
+        tracer: Optional[SpanTracer] = None,
         logger: Optional[logging.Logger] = None,
     ):
         if initial_replicas < 1:
@@ -125,7 +128,13 @@ class FleetRouter:
         self._initial = initial_replicas
         self._refresh_s = float(headroom_refresh_s)
         self._log = logger or logging.getLogger("genrec_tpu")
-        self._flight = get_flight_recorder()
+        self._flight = get_flight_recorder().scoped("fleet_router")
+        # Request lineage (docs/OBSERVABILITY.md "Request lineage"): the
+        # router is the OUTERMOST traced component — it mints the
+        # TraceContext every downstream hop attaches to. Replicas must
+        # share THIS tracer instance (build engines/fronts with
+        # ``tracer=router_tracer``) so span ids stay one id space.
+        self._tracer = tracer if tracer is not None else NULL_TRACER
         self._lock = threading.Lock()
         self._replicas: dict[str, _Replica] = {}
         self._seq = 0
@@ -180,6 +189,16 @@ class FleetRouter:
         engine = self._make_replica(rid)
         if getattr(engine, "replica_id", None) is None:
             engine.replica_id = rid
+        if self._tracer.enabled:
+            # A replica added AFTER a live set_tracer swap (autoscaler
+            # backfill) must join the router's tracer/id space, or its
+            # requests would trace as route-span-only fragments — and a
+            # factory-baked different tracer instance would collide two
+            # span-id counters inside one trace. Router tracing OFF
+            # leaves the factory's choice alone.
+            set_t = getattr(engine, "set_tracer", None)
+            if set_t is not None:
+                set_t(self._tracer)
         t0 = time.monotonic()
         if not getattr(engine, "_started", False):
             engine.start()
@@ -370,6 +389,19 @@ class FleetRouter:
     def draining(self) -> bool:
         return self._draining
 
+    def set_tracer(self, tracer: Optional[SpanTracer]) -> None:
+        """Swap lineage tracing LIVE, fleet-wide: the router's own
+        route/reroute spans and every live replica's engine/front spans
+        (all sharing one tracer id space). None turns tracing off —
+        the bench harness measures exactly this toggle."""
+        self._tracer = tracer if tracer is not None else NULL_TRACER
+        with self._lock:
+            reps = [r for r in self._replicas.values() if not r.dead]
+        for r in reps:
+            set_t = getattr(r.engine, "set_tracer", None)
+            if set_t is not None:
+                set_t(tracer)
+
     def replica_ids(self) -> list[str]:
         with self._lock:
             return sorted(self._replicas)
@@ -385,8 +417,61 @@ class FleetRouter:
                 "fleet is draining; request rejected — fail over"
             )
         fut = Future()
-        self._dispatch(req, fut, retried=False)
+        tracer = self._tracer
+        if req.trace is None and tracer.enabled:
+            # Outermost submit: mint the request's lineage. The root
+            # "request" span is recorded when the CALLER's future
+            # resolves (the whole routed life, reroutes included); the
+            # pre-allocated span id is the attach point every
+            # downstream hop parents onto via Request.trace.
+            tid = tracer.new_trace()
+            root = tracer.allocate_span_id()
+            req = dataclasses.replace(
+                req, trace=TraceContext(tid, root, "fleet_router")
+            )
+            t_sub = time.monotonic()
+            head = req.head
+
+            def _record_root(f, tid=tid, root=root, t_sub=t_sub,
+                             head=head):
+                try:
+                    outcome = "error" if f.exception() else "ok"
+                except Exception:  # noqa: BLE001 — cancelled future
+                    outcome = "cancelled"
+                tracer.record_span(
+                    "request", tid, t_sub, time.monotonic(),
+                    span_id=root, head=head, origin="fleet_router",
+                    component="fleet_router", outcome=outcome,
+                )
+
+            fut.add_done_callback(_record_root)
+        self._traced_dispatch(req, fut, retried=False)
         return fut
+
+    def _traced_dispatch(self, req: Request, fut: Future,
+                         retried: bool) -> str:
+        """_dispatch wrapped in the routing-decision span: which replica
+        took the request (or that the whole fleet shed) becomes part of
+        the request's own trace, not just a log line."""
+        ctx = req.trace
+        if ctx is None or not self._tracer.enabled:
+            return self._dispatch(req, fut, retried)
+        t0 = time.monotonic()
+        try:
+            rid = self._dispatch(req, fut, retried)
+        except ServingError as e:
+            self._tracer.record_span(
+                "route", ctx.trace_id, t0, time.monotonic(),
+                parent_id=ctx.parent_span_id, component="fleet_router",
+                outcome=type(e).__name__,
+            )
+            raise
+        self._tracer.record_span(
+            "route", ctx.trace_id, t0, time.monotonic(),
+            parent_id=ctx.parent_span_id, component="fleet_router",
+            replica=rid, outcome="ok",
+        )
+        return rid
 
     def _ranked(self, head: str) -> list[_Replica]:
         now = time.monotonic()
@@ -480,10 +565,29 @@ class FleetRouter:
             flight.fut.set_exception(exc)
 
     def _reroute(self, flight: _Flight, from_replica: str) -> None:
-        """Typed, at-most-once re-submit of a stranded flight."""
+        """Typed, at-most-once re-submit of a stranded flight. The
+        flight's `Request.trace` rides the re-submit unchanged, so the
+        surviving replica ADOPTS the original trace/request id —
+        `Response.request_id` provenance survives the death, and the
+        episode shows in the original trace as a typed ``reroute`` span
+        (never a fresh orphan trace)."""
         if flight.fut.done():
             return
+        ctx = flight.req.trace if self._tracer.enabled else None
+        t0 = time.monotonic()
+
+        def _span(outcome: str, to: Optional[str] = None) -> None:
+            if ctx is None:
+                return
+            self._tracer.record_span(
+                "reroute", ctx.trace_id, t0, time.monotonic(),
+                parent_id=ctx.parent_span_id, component="fleet_router",
+                rerouted_from=from_replica, replica_to=to,
+                outcome=outcome,
+            )
+
         if flight.retried:
+            _span("retry_exhausted")
             flight.fut.set_exception(ReplicaLostError(
                 f"request lost replica {from_replica} after already being "
                 "re-routed once (at-most-once retry exhausted)"
@@ -492,16 +596,20 @@ class FleetRouter:
         try:
             to = self._dispatch(flight.req, flight.fut, retried=True)
         except ServingError as e:
+            _span("no_capacity")
             flight.fut.set_exception(ReplicaLostError(
                 f"replica {from_replica} died mid-flight and the re-submit "
                 f"found no capacity: {e}"
             ))
             return
+        _span("ok", to)
         with self._lock:
             self._counters["rerouted"] += 1
         self._flight.record(
             "rerouted", head=flight.req.head,
             replica_from=from_replica, replica_to=to,
+            trace_id=flight.req.trace.trace_id
+            if flight.req.trace is not None else None,
         )
 
     # -- autoscaler / observability surface ----------------------------------
@@ -597,4 +705,6 @@ class FleetRouter:
             "by_head": by_head,
             "prefix_cache": prefix,
             "replicas": replicas,
+            # Fleet-level tracer self-metering (lineage liveness).
+            "tracing": self._tracer.stats(),
         }

@@ -38,7 +38,7 @@ from genrec_tpu.obs.memory import (
     tree_nbytes,
 )
 from genrec_tpu.obs.slo import SLOMonitor, SLOTarget
-from genrec_tpu.obs.spans import NULL_TRACER, Span, SpanTracer
+from genrec_tpu.obs.spans import NULL_TRACER, Span, SpanTracer, TraceContext
 
 __all__ = [
     "BUCKETS",
@@ -51,6 +51,7 @@ __all__ = [
     "SLOTarget",
     "Span",
     "SpanTracer",
+    "TraceContext",
     "device_memory_stats",
     "executable_memory_stats",
     "fleet_goodput",

@@ -45,6 +45,10 @@ _COUNTER_LEAVES = frozenset({
     # Speculative tree decode (genrec_spec_<head>_*): invocation/drafted/
     # accepted/slot-step totals; codes_per_invocation stays a gauge.
     "spec_steps", "drafted", "accepted", "slot_steps",
+    # Tracer self-metering (SpanTracer.stats(), the "tracing" section of
+    # engine/front stats): lifetime recording totals; ring occupancy/
+    # capacity/enabled stay gauges.
+    "spans_recorded", "traces_started",
 }) | frozenset(
     # Accept-length histogram leaves (genrec_spec_<head>_accept_len_hist
     # _accept_len_N): one bucket per possible accept length — depth is

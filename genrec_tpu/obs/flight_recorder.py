@@ -151,6 +151,19 @@ class FlightRecorder:
             out = [e for e in out if e["kind"] == kind]
         return out
 
+    def scoped(self, component: str, **identity) -> "ScopedFlightRecorder":
+        """A recording view that stamps owner identity on every event.
+
+        The recorder is a process singleton, so a multi-replica fleet or
+        a per-role disagg front interleaves events with no owner unless
+        each component stamps itself. ``identity`` values may be
+        callables, evaluated at record time — a replica learns its
+        ``replica_id`` AFTER construction (the router assigns it), so
+        ``scoped("engine", replica_id=lambda: self.replica_id)`` stays
+        correct without re-scoping. Explicit fields passed to ``record``
+        win over the scope's."""
+        return ScopedFlightRecorder(self, component, identity)
+
     def clear(self) -> None:
         with self._lock:
             self._ring.clear()
@@ -191,6 +204,44 @@ class FlightRecorder:
             return path
         except Exception:
             return None
+
+
+class ScopedFlightRecorder:
+    """Identity-stamping view over a `FlightRecorder` (see
+    :meth:`FlightRecorder.scoped`). Only the recording/reading surface —
+    configure/dump stay on the singleton, which owns the destination."""
+
+    __slots__ = ("_inner", "_component", "_identity")
+
+    def __init__(self, inner: FlightRecorder, component: str,
+                 identity: dict):
+        self._inner = inner
+        self._component = component
+        self._identity = dict(identity)
+
+    def scoped(self, component: str, **identity) -> "ScopedFlightRecorder":
+        """Narrow further (a front scopes per worker): inherited identity
+        merges under the new fields."""
+        return ScopedFlightRecorder(
+            self._inner, component, {**self._identity, **identity}
+        )
+
+    def record(self, kind: str, **fields) -> None:
+        stamp = {
+            k: (v() if callable(v) else v)
+            for k, v in self._identity.items()
+        }
+        # Explicit fields win over the scope's (incl. "component").
+        self._inner.record(
+            kind, **{"component": self._component, **stamp, **fields}
+        )
+
+    def events(self, kind: str | None = None) -> list[dict]:
+        return self._inner.events(kind)
+
+    def dump(self, path: str | None = None,
+             reason: str = "manual") -> str | None:
+        return self._inner.dump(path, reason)
 
 
 _RECORDER = FlightRecorder()

@@ -18,6 +18,12 @@ Two recording APIs:
   interval recording with explicit parentage; the serving engine's shape
   (one request's spans recorded from whichever thread observed them).
 
+`TraceContext` is the cross-COMPONENT contract on top: minted once at
+the outermost submit (fleet router / disagg front / bare engine) and
+carried on the Request — and across the KVHandoff wire header — so every
+hop's spans join one rooted tree (docs/OBSERVABILITY.md "Request
+lineage"; `scripts/trace_report.py --critical-path` decomposes it).
+
 Tracing off is the default everywhere and must stay ~free: a disabled
 tracer's ``span()`` is one attribute check returning a shared no-op
 context manager, and ``record_span`` returns immediately —
@@ -56,6 +62,54 @@ class Span:
     @property
     def duration(self) -> float:
         return self.t1 - self.t0
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceContext:
+    """One request's lineage, handed from component to component.
+
+    Minted ONCE at the outermost ``submit()`` — a `FleetRouter`, a
+    `DisaggFront`, or a bare `ServingEngine` — and carried on the
+    `Request` (and across the `KVHandoff` wire header) through every
+    hop, so a routed, disaggregated, speculative request's spans land in
+    ONE rooted tree instead of N per-component fragments.
+
+    ``parent_span_id`` is the attach point for the NEXT hop's spans:
+    each component that handles the request records its own request-level
+    span under the incoming parent and forwards ``child(own_span_id)``
+    downstream. ``origin`` names the minting component (provenance for
+    the exported trace and the critical-path report). Span ids are only
+    meaningful within one `SpanTracer`'s id space — in-process lineage
+    shares one tracer across router/front/engine/workers; a cross-host
+    hop carries the ids as opaque ints back to the same collector.
+    """
+
+    trace_id: str
+    parent_span_id: int | None
+    origin: str
+
+    def child(self, parent_span_id: int | None) -> "TraceContext":
+        """The context the next hop sees: same trace, re-parented."""
+        return dataclasses.replace(self, parent_span_id=parent_span_id)
+
+    def to_header(self) -> dict:
+        """JSON-safe dict for wire headers (disagg/handoff.py)."""
+        return {
+            "trace_id": self.trace_id,
+            "parent_span_id": self.parent_span_id,
+            "origin": self.origin,
+        }
+
+    @classmethod
+    def from_header(cls, header) -> "TraceContext | None":
+        if not header or header.get("trace_id") is None:
+            return None
+        pid = header.get("parent_span_id")
+        return cls(
+            trace_id=str(header["trace_id"]),
+            parent_span_id=int(pid) if pid is not None else None,
+            origin=str(header.get("origin", "unknown")),
+        )
 
 
 class _NullCtx:
@@ -135,6 +189,8 @@ class SpanTracer:
         self._lock = threading.Lock()
         self._span_ids = itertools.count(1)
         self._trace_ids = itertools.count(1)
+        self._spans_recorded = 0
+        self._traces_started = 0
         self._local = threading.local()
         # trace_id -> (reason, [Span]) — slow-request span trees copied out
         # of the ring the moment they are flagged, so ring eviction cannot
@@ -149,6 +205,8 @@ class SpanTracer:
 
     def new_trace(self, prefix: str = "req") -> str:
         """Mint a trace ID (itertools.count is atomic under the GIL)."""
+        with self._lock:
+            self._traces_started += 1
         return f"{prefix}-{next(self._trace_ids)}"
 
     def span(self, name: str, trace_id: str | None = None, **attrs):
@@ -194,7 +252,25 @@ class SpanTracer:
 
     def _commit(self, span: Span) -> None:
         with self._lock:
+            self._spans_recorded += 1
             self._ring.append(span)
+
+    def stats(self) -> dict:
+        """Tracer self-metering for the stats()/Prometheus surface:
+        lifetime counters (spans_recorded / traces_started) plus the
+        live ring occupancy, so "is lineage actually being collected,
+        and is the ring deep enough" is a scrapeable question."""
+        with self._lock:
+            ring_len = len(self._ring)
+            recorded = self._spans_recorded
+            traces = self._traces_started
+        return {
+            "enabled": self.enabled,
+            "spans_recorded": recorded,
+            "traces_started": traces,
+            "ring_spans": ring_len,
+            "ring_capacity": self._ring.maxlen or 0,
+        }
 
     # -- reading -------------------------------------------------------------
 
@@ -225,10 +301,14 @@ class SpanTracer:
 
     # -- export --------------------------------------------------------------
 
-    def _lane(self, cache: dict, trace_id: str) -> int:
-        # Stable small ints per trace: Perfetto renders each trace as its
-        # own track instead of one thread-id soup.
-        return cache.setdefault(trace_id, len(cache) + 1)
+    def _lane(self, cache: dict, key) -> int:
+        # Stable small ints per (trace, component): Perfetto renders each
+        # trace as its own track — and a lineage trace (spans stamped
+        # with a ``component`` attr by router/front/workers) fans out
+        # into one lane per component, so the cross-component life of a
+        # routed request reads as parallel swimlanes instead of one
+        # thread-id soup.
+        return cache.setdefault(key, len(cache) + 1)
 
     def _event(self, span: Span, lanes: dict) -> dict:
         return {
@@ -238,7 +318,9 @@ class SpanTracer:
             "ts": round((span.t0 + self._wall_offset) * 1e6, 3),
             "dur": round(span.duration * 1e6, 3),
             "pid": os.getpid(),
-            "tid": self._lane(lanes, span.trace_id),
+            "tid": self._lane(
+                lanes, (span.trace_id, span.attrs.get("component", ""))
+            ),
             "args": {
                 "trace_id": span.trace_id,
                 "span_id": span.span_id,

@@ -131,6 +131,7 @@ from genrec_tpu.serving.types import (
     Request,
     Response,
     UnknownHeadError,
+    normalize_spec_config,
 )
 
 
@@ -223,7 +224,8 @@ class _PagedRunner:
         self.steps = np.zeros(cfg.max_slots, np.int32)
         self.active = np.zeros(cfg.max_slots, bool)
         # (req, fut, t_enq, trace_ctx, t_admit); trace_ctx is the
-        # (trace_id, root_span_id) minted at submit(), or None (tracing off).
+        # (trace_id, request_span_id, upstream_parent_span_id) adopted/
+        # minted at submit(), or None (tracing off, no incoming trace).
         self.entries: list = [None] * cfg.max_slots
         self.buckets: list = [None] * cfg.max_slots  # prefill (B, L) per slot
         # The collapsed decode-side ladder: a handful of slot-count
@@ -523,7 +525,7 @@ class _PagedRunner:
                     eng._tracer.record_span(
                         "prefix_lookup", tr[0], t0, time.monotonic(),
                         parent_id=tr[1], outcome=outcome,
-                        matched_tokens=int(matched),
+                        matched_tokens=int(matched), **eng._span_ident(),
                     )
             if centry is not None:
                 warm.append((e, centry, own_L))
@@ -564,14 +566,16 @@ class _PagedRunner:
             # Same span tree as the cold path, with `warm_admit` where
             # `prefill` would be — trace_report shows warm-vs-cold
             # prefill phases side by side.
-            tid, root = tr
+            tid, root = tr[0], tr[1]
             tracer = eng._tracer
-            tracer.record_span("queue_wait", tid, e[2], t_pop, parent_id=root)
+            ident = eng._span_ident()
+            tracer.record_span("queue_wait", tid, e[2], t_pop,
+                               parent_id=root, **ident)
             tracer.record_span("admission", tid, t_pop, t0,
-                               parent_id=root, slot=int(slot))
+                               parent_id=root, slot=int(slot), **ident)
             tracer.record_span("warm_admit", tid, t0, t_admit,
                                parent_id=root,
-                               warm_tokens=int(centry.n_tokens))
+                               warm_tokens=int(centry.n_tokens), **ident)
         eng.metrics.record_admit(1)
 
     def _admit_pages(self, n_tok: int) -> int:
@@ -698,14 +702,17 @@ class _PagedRunner:
             if tr is not None:
                 # queue_wait: submit -> popped; admission: slot+page
                 # grab; prefill: the compiled bucket call + state write.
-                tid, root = tr
+                tid, root = tr[0], tr[1]
                 tracer = eng._tracer
+                ident = eng._span_ident()
                 t0 = t_pop if t_pop is not None else t_admit
-                tracer.record_span("queue_wait", tid, e[2], t0, parent_id=root)
+                tracer.record_span("queue_wait", tid, e[2], t0,
+                                   parent_id=root, **ident)
                 tracer.record_span("admission", tid, t0, t_admit,
-                                   parent_id=root, slot=int(slot))
+                                   parent_id=root, slot=int(slot), **ident)
                 tracer.record_span("prefill", tid, t_admit, t_prefilled,
-                                   parent_id=root, bucket_b=B, bucket_l=L)
+                                   parent_id=root, bucket_b=B, bucket_l=L,
+                                   **ident)
         eng.metrics.record_admit(n)
         eng.metrics.record_batch(head.name, (B, L))
         self._sweep_finished()  # heads whose init step == total finish here
@@ -768,27 +775,29 @@ class _PagedRunner:
             # iterations replace the per-code `decode_step` span with
             # draft -> tree_verify -> accept (scripts/check_obs.py
             # accepts both shapes).
+            ident = eng._span_ident()
             for i, slot in enumerate(active_idx):
                 tr = self.entries[slot][3]
                 if tr is None:
                     continue
                 if spec:
-                    tid, root = tr
+                    tid, root = tr[0], tr[1]
                     eng._tracer.record_span(
                         "draft", tid, t_stage, t0, parent_id=root,
                         step=int(self.steps[slot]),
                         drafted=int(self.spec_topology.n_nodes
                                     - self.spec_topology.beams),
+                        **ident,
                     )
                     eng._tracer.record_span(
                         "tree_verify", tid, t0, t1, parent_id=root,
                         step=int(self.steps[slot]), slots=S,
-                        accept_len=int(adv[i]),
+                        accept_len=int(adv[i]), **ident,
                     )
                 else:
                     eng._tracer.record_span(
                         "decode_step", tr[0], t0, t1, parent_id=tr[1],
-                        step=int(self.steps[slot]), slots=S,
+                        step=int(self.steps[slot]), slots=S, **ident,
                     )
         if spec:
             self.steps[active_idx] += adv
@@ -801,12 +810,13 @@ class _PagedRunner:
             )
             if eng._tracer.enabled:
                 t2 = time.monotonic()
+                ident = eng._span_ident()
                 for i, slot in enumerate(active_idx):
                     tr = self.entries[slot][3]
                     if tr is not None:
                         eng._tracer.record_span(
                             "accept", tr[0], t1, t2, parent_id=tr[1],
-                            accept_len=int(adv[i]),
+                            accept_len=int(adv[i]), **ident,
                         )
         else:
             self.steps[self.active] += 1
@@ -871,14 +881,20 @@ class _PagedRunner:
                     head=head.name,
                 )
                 if tr is not None:
-                    tid, root = tr
+                    tid, root = tr[0], tr[1]
+                    ident = eng._span_ident()
                     eng._tracer.record_span(
-                        "finalize", tid, t_done, now, parent_id=root
+                        "finalize", tid, t_done, now, parent_id=root,
+                        **ident,
                     )
+                    # This engine's request-level span: the trace ROOT
+                    # when the request arrived untraced, a child of the
+                    # upstream router/front span when a TraceContext
+                    # came in (tr[2] — one rooted tree per request).
                     eng._tracer.record_span(
                         "request", tid, t_enq, now, span_id=root,
-                        head=head.name, slot=int(slot),
-                        params_step=step_id,
+                        parent_id=tr[2], head=head.name, slot=int(slot),
+                        params_step=step_id, **ident,
                     )
                     eng._maybe_exemplar(tid, resp)
                 if not fut.done():
@@ -969,22 +985,12 @@ class ServingEngine:
         # trades redundant tree FLOPs for fewer sequential target
         # invocations — the right trade on dispatch/latency-bound
         # serving, measured (serve.spec in bench.py) rather than assumed.
-        self._spec_decode = (
-            frozenset(spec_decode)
-            if isinstance(spec_decode, (set, frozenset, list, tuple))
-            else bool(spec_decode)
+        # spec_fanout: one int, or a per-level tuple (wide first
+        # speculated level, narrow deep levels — TreeTopology
+        # normalizes either form).
+        self._spec_decode, self._spec_fanout = normalize_spec_config(
+            spec_decode, spec_fanout, self._heads
         )
-        # One int, or a per-level tuple (wide first speculated level,
-        # narrow deep levels — TreeTopology normalizes either form).
-        self._spec_fanout = (
-            tuple(int(f) for f in spec_fanout)
-            if isinstance(spec_fanout, (tuple, list))
-            else int(spec_fanout)
-        )
-        if isinstance(self._spec_decode, frozenset):
-            unknown = [n for n in self._spec_decode if n not in self._heads]
-            if unknown:
-                raise ValueError(f"spec_decode names unknown heads {unknown}")
         self._runners: dict[str, _PagedRunner] = {}
         self._ckpt_dir = ckpt_dir
         self._ckpt_poll_secs = ckpt_poll_secs
@@ -1006,7 +1012,13 @@ class ServingEngine:
         # default NULL_TRACER keeps every hot-path check to one attribute
         # read. The flight recorder is always on (bounded ring).
         self._tracer = tracer if tracer is not None else NULL_TRACER
-        self._flight = get_flight_recorder()
+        # Every flight event this engine records is stamped with its
+        # owner identity (component + replica_id, evaluated at record
+        # time — the fleet router assigns replica_id AFTER construction),
+        # so multi-replica rings stay attributable post-mortem.
+        self._flight = get_flight_recorder().scoped(
+            "engine", replica_id=lambda: self.replica_id
+        )
         # Device-memory ledger (obs/memory.py): populated at warmup from
         # every compiled executable's XLA memory analysis + the logical
         # runtime operands; hbm_budget_bytes makes it a hard gate —
@@ -1280,6 +1292,15 @@ class ServingEngine:
         site guards on that per-entry context, so mixing is safe."""
         self._tracer = tracer if tracer is not None else NULL_TRACER
 
+    def _span_ident(self) -> dict:
+        """Identity attrs stamped on every span this engine records:
+        the component lane for the Perfetto export and the blame label
+        for trace_report's critical path. Evaluated per record — the
+        fleet router assigns replica_id after construction."""
+        if self.replica_id is not None:
+            return {"component": "engine", "replica": self.replica_id}
+        return {"component": "engine"}
+
     def _maybe_exemplar(self, trace_id: str, resp: Response) -> None:
         """Slow-request exemplars: a p99-outlier request persists its full
         span tree past ring eviction, so the trace export always holds a
@@ -1321,6 +1342,11 @@ class ServingEngine:
         # snapshot, so log_serving_stats / write_prometheus expose them
         # with the pool gauges.
         snap["hbm"] = self.memory.summary(budget_bytes=self._hbm_budget)
+        # Tracer self-metering (lineage liveness: spans/traces recorded,
+        # ring occupancy) — typed counter/gauge by leaf name in
+        # obs/export.py, so a scrape can tell "tracing on but ring too
+        # shallow for the traffic" from "tracing off".
+        snap["tracing"] = self._tracer.stats()
         if self._slo is not None:
             snap["slo"] = self._slo.snapshot()
         return snap
@@ -1362,13 +1388,29 @@ class ServingEngine:
                     f"({self._slo.shed_reason(req.head)}); back off and "
                     "retry or fail over to another replica"
                 )
-            # Trace context minted AT submit: (request/trace id, pre-
-            # allocated root span id) so spans recorded before the root
-            # completes can already parent onto it.
-            tr = (
-                (self._tracer.new_trace(), self._tracer.allocate_span_id())
-                if self._tracer.enabled else None
-            )
+            # Trace context AT submit: (trace id, pre-allocated span id
+            # for this engine's request-level span — children recorded
+            # before it completes can already parent onto it, and the
+            # span id of the incoming parent). An incoming
+            # Request.trace (a fleet router / disagg front upstream)
+            # is ADOPTED: same trace id, our request span parented
+            # under the upstream's — one rooted tree per request — and
+            # the trace id rides Response.request_id even when this
+            # engine's own tracer is off (lineage provenance survives a
+            # partially instrumented fleet).
+            ctx = req.trace
+            if ctx is not None:
+                tr = (
+                    ctx.trace_id,
+                    self._tracer.allocate_span_id()
+                    if self._tracer.enabled else None,
+                    ctx.parent_span_id,
+                )
+            elif self._tracer.enabled:
+                tr = (self._tracer.new_trace(),
+                      self._tracer.allocate_span_id(), None)
+            else:
+                tr = None
             entry = (req, Future(), time.monotonic(), tr)
             self._queues[req.head].append(entry)
             self._work.notify()
@@ -1557,16 +1599,19 @@ class ServingEngine:
             if tr is not None:
                 # Dense whole-batch span tree: queue -> compute (the
                 # shared executable call, host sync included) -> finalize.
-                tid, root = tr
+                tid, root = tr[0], tr[1]
+                ident = self._span_ident()
                 self._tracer.record_span("queue_wait", tid, t_enq, t_start,
-                                         parent_id=root)
+                                         parent_id=root, **ident)
                 self._tracer.record_span("compute", tid, t_start, t_done,
-                                         parent_id=root, bucket_b=B, bucket_l=L)
+                                         parent_id=root, bucket_b=B,
+                                         bucket_l=L, **ident)
                 self._tracer.record_span("finalize", tid, t_done, t_final,
-                                         parent_id=root)
+                                         parent_id=root, **ident)
                 self._tracer.record_span(
                     "request", tid, t_enq, now, span_id=root,
-                    head=head.name, params_step=step,
+                    parent_id=tr[2], head=head.name, params_step=step,
+                    **ident,
                 )
                 self._maybe_exemplar(tid, resp)
             if not fut.done():  # a cancelled Future must not kill the loop

@@ -15,6 +15,30 @@ from typing import Optional
 import numpy as np
 
 
+def normalize_spec_config(spec_decode, spec_fanout, head_names):
+    """The one normalization of the speculative-decode opt-in surface,
+    shared by `ServingEngine` and `disagg.DisaggFront` so the two
+    serving paths can never drift: ``spec_decode`` is True/False or a
+    set of head names (validated against ``head_names``), ``spec_fanout``
+    one int or a per-level tuple. Returns (spec_decode, spec_fanout)
+    normalized to (bool | frozenset, int | tuple[int, ...])."""
+    spec = (
+        frozenset(spec_decode)
+        if isinstance(spec_decode, (set, frozenset, list, tuple))
+        else bool(spec_decode)
+    )
+    if isinstance(spec, frozenset):
+        unknown = [n for n in spec if n not in head_names]
+        if unknown:
+            raise ValueError(f"spec_decode names unknown heads {unknown}")
+    fanout = (
+        tuple(int(f) for f in spec_fanout)
+        if isinstance(spec_fanout, (tuple, list))
+        else int(spec_fanout)
+    )
+    return spec, fanout
+
+
 class ServingError(RuntimeError):
     """Base class for engine-surface errors."""
 
@@ -55,12 +79,22 @@ class Request:
     vocabulary ids (1-based, 0 = pad). Histories longer than the largest
     history bucket keep their NEWEST items. ``timestamps`` feeds HSTU's
     temporal bias when the head was built with use_timestamps=True.
+
+    ``trace`` is the request's lineage (`obs.TraceContext`), stamped by
+    the OUTERMOST traced component (fleet router / disagg front) before
+    the request is forwarded — callers leave it None. A component that
+    receives a non-None trace adopts the incoming trace id (one rooted
+    span tree per request, docs/OBSERVABILITY.md "Request lineage")
+    instead of minting its own, and `Response.request_id` carries that
+    id even when the inner component's own tracer is disabled.
     """
 
     head: str
     history: np.ndarray
     user_id: int = 0
     timestamps: Optional[np.ndarray] = None
+    #: Cross-component lineage (obs/spans.TraceContext) — see class doc.
+    trace: Optional[object] = None
 
 
 @dataclasses.dataclass

@@ -71,6 +71,13 @@ GATED_METRICS: dict[str, tuple[str, float]] = {
     "serve/max_concurrent_decode_streams_per_chip": ("higher", 10.0),
     "serve/catalog_swap/swap_to_visible_ms_p50": ("lower", 30.0),
     "serve/obs/tracing_on_overhead_pct": ("lower", 50.0),
+    # Fleet-path lineage overhead (request lineage PR): closed-loop qps
+    # through a 2-replica router, tracing-off vs tracing-on (router
+    # route/reroute spans + full per-replica request trees). Same
+    # budget intent as the engine-level line above — lineage must not
+    # silently tax the hot path; the tracing-OFF fast path keeps its
+    # deterministic <2% pin in scripts/check_obs.py.
+    "serve/obs/fleet_tracing_on_overhead_pct": ("lower", 50.0),
     # Cross-request prefix cache (PR 11): hit rate and the warm-vs-cold
     # prefill ratio are same-backend and tight-ish; absolute latency and
     # the fixed-HBM stream ratio breathe more on shared CPU hosts.

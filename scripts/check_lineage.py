@@ -1,0 +1,299 @@
+"""Request-lineage check (shared analysis/ir.py harness: one verdict
+JSON on stdout, rc 0 ok / 1 failed, --small/--platform/--write-note CLI
+like every check_* script).
+
+What it proves, end to end, on the FULL serving path — a 2-replica
+`FleetRouter` whose replicas are `DisaggFront`s (1 prefill + 1 decode
+worker, serializing KV transport) serving a SPECULATIVE paged TIGER
+head, with one shared `SpanTracer` across every component:
+
+1. **One rooted tree per request** — every completed request's spans
+   form a single tree rooted at the router's ``request`` span: the
+   route decision, the front's request span, the prefill worker's
+   queue/admission/prefill spans, both sides of the ``handoff_wire``
+   hop, the decode worker's ``slot_residency`` with its
+   draft -> tree_verify -> accept spec triple, and finalize — all under
+   ONE trace id (the `TraceContext` minted at the router's submit and
+   carried through `Request.trace` and the `KVHandoff` header).
+2. **Spanning >= 3 components** — the tree crosses fleet_router,
+   disagg_front, prefill_worker and decode_worker lanes (the Perfetto
+   export shows them as per-component swimlanes).
+3. **Critical-path attribution is exact** — `trace_report.py
+   --critical-path` decomposes every root span into exclusive-time
+   segments that sum back to the root duration within epsilon (the
+   deepest-cover partition makes this true by construction; the check
+   pins that the construction holds on real traces).
+4. **Zero steady-state recompiles** fleet-wide — lineage instrumentation
+   adds nothing to the compile surface.
+5. **The wire carries the context** — a packed handoff round-trips its
+   `TraceContext` through the pinned WIRE_VERSION format (the cross-host
+   contract: the decode side of a real RPC hop can re-attach spans).
+
+The exported Perfetto trace (out/lineage/trace.json, flight events
+embedded) is the acceptance artifact: open it in ui.perfetto.dev to see
+one routed, disaggregated, speculative request end to end.
+
+Usage: python scripts/check_lineage.py [--small] [--platform cpu]
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from genrec_tpu.analysis import ir  # noqa: E402
+
+
+def check_lineage_tree(spans, min_components: int = 3) -> dict:
+    """One request's spans must form ONE rooted tree crossing at least
+    ``min_components`` component lanes. Raises AssertionError with the
+    failure; returns {root, components, names} on success."""
+    ids = {s.span_id for s in spans}
+    roots = [s for s in spans
+             if s.name == "request"
+             and (s.parent_id is None or s.parent_id not in ids)]
+    if len(roots) != 1:
+        raise AssertionError(
+            f"expected ONE root request span, got {len(roots)} "
+            f"(names: {sorted({s.name for s in spans})})"
+        )
+    root = roots[0]
+    by_id = {s.span_id: s for s in spans}
+    for s in spans:
+        # Every span must reach the root by parent chains.
+        seen, cur = set(), s
+        while cur is not root:
+            if cur.span_id in seen:
+                raise AssertionError(f"parent cycle at span {cur.name}")
+            seen.add(cur.span_id)
+            if cur.parent_id is None or cur.parent_id not in by_id:
+                raise AssertionError(
+                    f"span {cur.name} (id {cur.span_id}) does not reach "
+                    f"the request root (dangling parent {cur.parent_id})"
+                )
+            cur = by_id[cur.parent_id]
+    components = sorted({s.attrs.get("component") for s in spans
+                         if s.attrs.get("component")})
+    if len(components) < min_components:
+        raise AssertionError(
+            f"trace spans only {components}; need >= {min_components} "
+            "components for cross-component lineage"
+        )
+    return {"root": root, "components": components,
+            "names": sorted({s.name for s in spans})}
+
+
+def main(argv=None):
+    args = ir.check_args(argv)
+
+    import jax
+
+    if args.platform:
+        from genrec_tpu.parallel.mesh import pin_platform
+
+        pin_platform(args.platform)
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from genrec_tpu.disagg import DisaggFront, KVHandoff, pack_handoff, \
+        unpack_handoff
+    from genrec_tpu.disagg.handoff import layout_of
+    from genrec_tpu.fleet import FleetRouter
+    from genrec_tpu.models.tiger import Tiger
+    from genrec_tpu.obs import SpanTracer, TraceContext
+    from genrec_tpu.obs.flight_recorder import get_flight_recorder
+    from genrec_tpu.serving import BucketLadder, PagedConfig, Request
+    from genrec_tpu.serving.heads import TigerGenerativeHead
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import trace_report
+
+    backend = jax.default_backend()
+    if args.small:
+        n_corpus = 50
+        arch = dict(embedding_dim=16, attn_dim=32, dropout=0.0, num_heads=4,
+                    n_layers=2, num_item_embeddings=8, num_user_embeddings=20,
+                    sem_id_dim=3)
+        ladder = BucketLadder((1, 2), (8,))
+        max_batch = 2
+        n_requests = 16
+    else:
+        n_corpus = 500
+        arch = dict(embedding_dim=32, attn_dim=64, dropout=0.0, num_heads=4,
+                    n_layers=2, num_item_embeddings=16,
+                    num_user_embeddings=1000, sem_id_dim=3)
+        ladder = BucketLadder((1, 4), (8,))
+        max_batch = 4
+        n_requests = 40
+    D = arch["sem_id_dim"]
+    Kcb = arch["num_item_embeddings"]
+    max_hist = ladder.history_buckets[-1]
+
+    model = Tiger(**arch)
+    rng = np.random.default_rng(0)
+    valid_ids = np.unique(rng.integers(0, Kcb, (n_corpus, D)), axis=0)
+    B0, L0 = 2, 2 * D
+    params = model.init(
+        jax.random.key(0),
+        jnp.zeros((B0,), jnp.int32), jnp.zeros((B0, L0), jnp.int32),
+        jnp.zeros((B0, L0), jnp.int32), jnp.zeros((B0, D), jnp.int32),
+        jnp.zeros((B0, D), jnp.int32), jnp.ones((B0, L0), jnp.int32),
+    )["params"]
+
+    n_tok = 1 + max_hist * D
+    cfg = PagedConfig(max_slots=max_batch, page_size=8,
+                      pages_per_slot=-(-n_tok // 8))
+    tracer = SpanTracer(capacity=65536)
+
+    def make_replica(rid):
+        head = TigerGenerativeHead(model, valid_ids, top_k=5, name="tiger")
+        return DisaggFront(
+            [head], params, ladder=ladder, max_batch=max_batch,
+            max_wait_ms=1.0, transport="serializing",
+            paged_config=cfg, params_step=1, replica_id=rid,
+            spec_decode=True, spec_fanout=min(8, Kcb),
+            tracer=tracer, handle_signals=False,
+        )
+
+    router = FleetRouter(make_replica, initial_replicas=2,
+                         tracer=tracer).start()
+
+    reqs = [
+        Request(head="tiger",
+                history=rng.integers(0, len(valid_ids),
+                                     int(rng.integers(1, max_hist + 1))),
+                user_id=int(rng.integers(0, 20)))
+        for _ in range(n_requests)
+    ]
+    inflight = collections.deque()
+    window = 2 * max_batch + 1
+    resps = []
+    i = 0
+    while i < len(reqs) or inflight:
+        while i < len(reqs) and len(inflight) < window:
+            inflight.append(router.submit(reqs[i]))
+            i += 1
+        resps.append(inflight.popleft().result(300))
+
+    # Snapshot spans per request BEFORE stop() (drain records nothing
+    # per-request, but keep the read close to the traffic).
+    trees = {r.request_id: tracer.spans(r.request_id) for r in resps}
+    final = router.stop()
+
+    rooted_ok = True
+    components_ok = True
+    spec_spans_ok = True
+    wire_spans_ok = True
+    min_comps = 99
+    err = None
+    for rid_, spans in trees.items():
+        try:
+            info = check_lineage_tree(spans, min_components=3)
+            min_comps = min(min_comps, len(info["components"]))
+            need = {"fleet_router", "disagg_front", "prefill_worker",
+                    "decode_worker"}
+            if not need <= set(info["components"]):
+                components_ok = False
+                err = err or (f"{rid_}: components {info['components']} "
+                              f"missing {need - set(info['components'])}")
+            if not {"draft", "tree_verify", "accept"} <= set(info["names"]):
+                spec_spans_ok = False
+                err = err or (f"{rid_}: spec triple missing from "
+                              f"{info['names']}")
+            if "handoff_wire" not in info["names"]:
+                wire_spans_ok = False
+                err = err or f"{rid_}: no handoff_wire span"
+        except AssertionError as e:
+            rooted_ok = False
+            err = err or f"{rid_}: {e}"
+
+    # Export the acceptance artifact + run the critical-path analyzer
+    # over it (the segment partition must sum to every root span).
+    out_path = os.path.join(REPO, "out", "lineage", "trace.json")
+    fr = get_flight_recorder()
+    tracer.dump(out_path, metadata={
+        "flight_events": fr.events()[-200:],
+        "scenario": "fleet->disagg->spec lineage check",
+    })
+    cp = trace_report.critical_path_report(trace_report.load_trace(out_path))
+    segment_sum_ok = (
+        cp["n_requests"] >= len(resps)
+        and cp["max_segment_sum_error_ms"] <= 0.01
+    )
+    segments = sorted(cp["segments"])
+
+    # The cross-host contract: a packed handoff round-trips its
+    # TraceContext through the pinned wire format.
+    head_probe = TigerGenerativeHead(model, valid_ids, top_k=5,
+                                     name="tiger")
+    ctx = TraceContext("req-wire-probe", 123, "fleet_router")
+    probe = KVHandoff(
+        head="tiger", n_tokens=4, bucket=(1, 8),
+        layout=layout_of(head_probe), init=None, params_step=1,
+        catalog_version=head_probe.catalog_version,
+        prefill_worker_id="tiger:p0", trace=ctx,
+    )
+    shape = (1, 8) + tuple(int(x) for x in probe.layout[1:3])
+    k = tuple(np.zeros(shape, np.float32)
+              for _ in range(int(probe.layout[0])))
+    unpacked, _k, _v = unpack_handoff(pack_handoff(probe, k, k))
+    wire_trace_ok = unpacked.trace == ctx
+
+    ok = (
+        len(resps) == n_requests
+        and rooted_ok
+        and components_ok
+        and spec_spans_ok
+        and wire_spans_ok
+        and segment_sum_ok
+        and wire_trace_ok
+        and final["recompilations"] == 0
+    )
+    verdict = {
+        "backend": backend,
+        "submitted": n_requests,
+        "completed": len(resps),
+        "traces_checked": len(trees),
+        "rooted_ok": rooted_ok,
+        "components_ok": components_ok,
+        "min_components": min_comps if min_comps != 99 else 0,
+        "spec_spans_ok": spec_spans_ok,
+        "wire_spans_ok": wire_spans_ok,
+        "segment_sum_ok": segment_sum_ok,
+        "max_segment_sum_error_ms": cp["max_segment_sum_error_ms"],
+        "segments": segments,
+        "wire_trace_ok": wire_trace_ok,
+        "recompilations": final["recompilations"],
+        "trace_path": os.path.relpath(out_path, REPO),
+        "ok": ok,
+    }
+    if err is not None:
+        verdict["error"] = err
+    ir.emit_verdict(verdict)
+
+    if args.write_note:
+        if ok:
+            msg = (
+                f"OK: {n_requests} requests through a 2-replica fleet of "
+                "speculative disagg fronts each produced ONE rooted span "
+                f"tree crossing >= {verdict['min_components']} components "
+                "(router -> prefill -> wire -> spec decode), critical-path "
+                "segments sum to the root span within "
+                f"{cp['max_segment_sum_error_ms']}ms, 0 recompiles"
+            )
+        else:
+            msg = "ATTENTION: request lineage broke (orphan spans, missing components, or segment-sum drift)"
+        ir.append_perf_note(
+            f"\n- Lineage check (scripts/check_lineage.py, "
+            f"backend={backend}): {msg}\n"
+        )
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

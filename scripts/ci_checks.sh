@@ -26,6 +26,12 @@
 #                            steady-state recompiles, answers
 #                            bit-identical to a co-located engine, all
 #                            pages on BOTH pools released after drain
+#   check_lineage.py       — request lineage: a routed 2-replica
+#                            disagg+spec fleet with tracing on yields
+#                            ONE rooted span tree per request crossing
+#                            router/prefill/handoff/decode components,
+#                            critical-path segments sum to the root
+#                            span, zero recompiles
 #   check_obs.py           — obs smoke: a traced serve loop yields a
 #                            complete per-request span tree + valid
 #                            Chrome-trace JSON, a traced train loop's
@@ -154,6 +160,15 @@ if [ "$MODE" = "--smoke" ]; then
     if [ -z "${GENREC_CI_SKIP_SPEC:-}" ]; then
         run python scripts/check_spec_hlo.py --small --platform cpu
     fi
+    # Request-lineage smoke: a routed 2-replica disagg+spec fleet with
+    # tracing on — every completed request's spans form ONE rooted tree
+    # spanning >=3 components (router -> prefill worker -> handoff wire
+    # -> spec decode worker), critical-path segments sum to the root
+    # span within epsilon, zero recompiles. GENREC_CI_SKIP_LINEAGE=1
+    # skips it (same contract as the knobs above).
+    if [ -z "${GENREC_CI_SKIP_LINEAGE:-}" ]; then
+        run python scripts/check_lineage.py --small --platform cpu
+    fi
     # Obs smoke (traced serve span tree + goodput schema + overhead
     # budget + memory ledger + SLO shed). GENREC_CI_SKIP_OBS=1 skips it
     # for callers whose pytest pass already runs tests/test_obs.py
@@ -215,6 +230,7 @@ else
     run python scripts/check_fleet.py --write-note
     run python scripts/check_disagg.py --write-note
     run python scripts/check_spec_hlo.py --write-note
+    run python scripts/check_lineage.py --write-note
     run python scripts/check_obs.py
     run python scripts/graftlint.py
     # Perf regression gate: self-test, then the newest committed

@@ -45,6 +45,11 @@ SPEC_KEYS = {"backend", "submitted", "completed", "recompilations", "rungs",
              "scratch_pages_reserved", "parity_ok", "spans_ok",
              "pages_in_use_final", "scratch_pages_final",
              "slots_active_final", "ok"}
+LINEAGE_KEYS = {"backend", "submitted", "completed", "traces_checked",
+                "rooted_ok", "components_ok", "min_components",
+                "spec_spans_ok", "wire_spans_ok", "segment_sum_ok",
+                "max_segment_sum_error_ms", "segments", "wire_trace_ok",
+                "recompilations", "trace_path", "ok"}
 # bench_gate is the new perf regression gate (one verdict line,
 # graftlint mold); check_obs's grown verdict (memory + slo sections) is
 # exercised by its own full run in ci_checks, not re-run here.
@@ -94,7 +99,7 @@ def test_check_scripts_keep_their_cli():
     for script in ("check_decode_hlo", "check_packed_hlo",
                    "check_fused_ce_hlo", "check_serving_hlo",
                    "check_catalog_hlo", "check_fleet", "check_disagg",
-                   "check_spec_hlo", "check_obs"):
+                   "check_spec_hlo", "check_lineage", "check_obs"):
         proc = subprocess.run(
             [sys.executable, os.path.join(REPO, "scripts", f"{script}.py"),
              "--help"],
@@ -116,16 +121,22 @@ def test_ci_checks_smoke_entrypoint():
     # coverage. The (jax-free, sub-second) bench_gate self-test stays.
     proc = subprocess.run(
         ["bash", os.path.join(REPO, "scripts", "ci_checks.sh"), "--smoke"],
-        capture_output=True, text=True, timeout=600,
+        capture_output=True, text=True, timeout=900,
         env={**os.environ, "JAX_PLATFORMS": "cpu",
              "GENREC_CI_SKIP_CHAOS": "1", "GENREC_CI_SKIP_OBS": "1",
              "GENREC_CI_SKIP_LINT": "1", "GENREC_CI_SKIP_CATALOG": "1"},
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     # One verdict JSON per check on stdout (decode, fused-ce, packed,
-    # serving, fleet, disagg, spec, bench-gate self-test).
+    # serving, fleet, disagg, spec, lineage, bench-gate self-test).
     verdicts = [json.loads(l) for l in proc.stdout.splitlines() if l.strip()]
-    assert len(verdicts) == 8
+    assert len(verdicts) == 9
+    lineage = [v for v in verdicts if "segment_sum_ok" in v]
+    assert len(lineage) == 1 and set(lineage[0]) == LINEAGE_KEYS
+    assert lineage[0]["rooted_ok"] and lineage[0]["components_ok"]
+    assert lineage[0]["min_components"] >= 3
+    assert lineage[0]["segment_sum_ok"] and lineage[0]["wire_trace_ok"]
+    assert lineage[0]["recompilations"] == 0
     spec = [v for v in verdicts if "codes_per_invocation" in v]
     assert len(spec) == 1 and set(spec[0]) == SPEC_KEYS
     assert spec[0]["recompilations"] == 0 and spec[0]["parity_ok"]

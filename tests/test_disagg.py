@@ -131,19 +131,30 @@ def test_handoff_wire_roundtrip_and_version_refusal():
     assert back.bucket == (2, 8) and back.layout == (1, 4, 8, "float32")
     assert back.params_step == 5 and back.catalog_version == "abc123"
     assert back.prefill_worker_id == "tiger:p0" and back.warm
+    assert back.trace is None  # untraced requests stay untraced
     np.testing.assert_array_equal(k2[0], k[0])
     np.testing.assert_array_equal(v2[0], v[0])
     np.testing.assert_array_equal(back.init["base_pos"], init["base_pos"])
     np.testing.assert_array_equal(back.init["beam"], init["beam"])
-    # A future wire version must be REFUSED typed, not misread.
+    # v2: the header carries the request lineage (TraceContext) — the
+    # cross-host decode side re-attaches spans to the SAME trace.
+    from genrec_tpu.obs import TraceContext
+
+    ctx = TraceContext("req-41", 77, "fleet_router")
+    h.trace = ctx
+    traced, _k3, _v3 = unpack_handoff(pack_handoff(h, k, v))
+    assert traced.trace == ctx
+    # Version skew must be REFUSED typed, not misread — both a FUTURE
+    # layout and the pre-lineage v1 layout.
     import io
     import json
 
-    bad_header = json.dumps({"wire_version": 99}).encode()
-    buf = io.BytesIO()
-    np.savez(buf, __header__=np.frombuffer(bad_header, np.uint8))
-    with pytest.raises(HandoffRefusedError, match="wire version"):
-        unpack_handoff(buf.getvalue())
+    for bad_version in (99, 1):
+        bad_header = json.dumps({"wire_version": bad_version}).encode()
+        buf = io.BytesIO()
+        np.savez(buf, __header__=np.frombuffer(bad_header, np.uint8))
+        with pytest.raises(HandoffRefusedError, match="wire version"):
+            unpack_handoff(buf.getvalue())
 
 
 # ---- parity: disagg == co-located, mixed warm/cold churn --------------------
@@ -277,6 +288,74 @@ def test_cobra_disagg_parity_serializing_wire(corpus, rng):
 
 
 # ---- typed refusal on provenance skew ---------------------------------------
+
+
+@pytest.mark.serving_smoke
+def test_spec_disagg_parity_and_request_lineage(tiger_setup, corpus, rng):
+    """The disagg decode pool speculates (`DisaggFront(spec_decode=)`):
+    answers stay pinned to a PLAIN front on the same solo sequence
+    (sem_ids/items bit-identical, scores <= 1e-5 — the repo's
+    spec==plain bar) at strictly fewer target invocations, and with a
+    tracer attached every response's spans form ONE rooted tree crossing
+    front / prefill worker / decode worker, the spec
+    draft->tree_verify->accept triple parented under the slot-residency
+    umbrella. Pools AND the scratch reservation account clean after
+    drain."""
+    from genrec_tpu.obs import SpanTracer
+
+    model, params = tiger_setup
+    valid, _ = corpus
+    reqs = [_req(rng, valid) for _ in range(6)]
+    tracer = SpanTracer(capacity=16384)
+    front = _tiger_front(model, valid, params, spec_decode=True,
+                         spec_fanout=8, tracer=tracer).start()
+    try:
+        spec_resps = [front.serve(r, 120) for r in reqs]
+    finally:
+        spec_stats = front.stop()
+    plain = _tiger_front(model, valid, params).start()
+    try:
+        plain_resps = [plain.serve(r, 120) for r in reqs]
+    finally:
+        plain_stats = plain.stop()
+
+    for a, b in zip(spec_resps, plain_resps):
+        np.testing.assert_array_equal(a.sem_ids, b.sem_ids)
+        np.testing.assert_array_equal(a.items, b.items)
+        np.testing.assert_allclose(a.scores, b.scores, atol=1e-5, rtol=0)
+    assert spec_stats["recompilations"] == 0
+    assert plain_stats["recompilations"] == 0
+    assert spec_stats["decode_steps"] < plain_stats["decode_steps"]
+    spec_sec = spec_stats["spec"]["tiger"]
+    assert spec_sec["codes_per_invocation"] > 1.0
+    pool = spec_stats["kv_pool"]["tiger"]
+    assert pool["pages_in_use"] == 0 and pool["slots_active"] == 0
+    # Scratch reservation released at drain (per decode worker).
+    roles = spec_stats["disagg"]["roles"]["tiger"]["decode"]["per_worker"]
+    assert all(w["scratch_pages"] == 0 for w in roles.values())
+    assert spec_stats["tracing"]["spans_recorded"] > 0
+
+    for r in spec_resps:
+        assert r.request_id is not None
+        spans = tracer.spans(r.request_id)
+        ids = {s.span_id for s in spans}
+        roots = [s for s in spans
+                 if s.name == "request"
+                 and (s.parent_id is None or s.parent_id not in ids)]
+        assert len(roots) == 1
+        assert roots[0].attrs["component"] == "disagg_front"
+        assert roots[0].attrs["origin"] == "disagg_front"
+        comps = {s.attrs.get("component") for s in spans} - {None}
+        assert {"disagg_front", "prefill_worker", "decode_worker"} <= comps
+        names = {s.name for s in spans}
+        assert {"queue_wait", "handoff_wire", "decode_slot_wait",
+                "slot_residency", "draft", "tree_verify", "accept",
+                "finalize"} <= names
+        assert "decode_step" not in names  # spec replaces the plain step
+        sid = [s for s in spans if s.name == "slot_residency"][0].span_id
+        assert all(s.parent_id == sid for s in spans
+                   if s.name in ("draft", "tree_verify", "accept",
+                                 "finalize"))
 
 
 @pytest.mark.serving_smoke

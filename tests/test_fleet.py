@@ -237,6 +237,63 @@ def test_replica_kill_mid_burst_loses_nothing(sas, rng):
         router.stop()
 
 
+def test_reroute_keeps_original_trace_and_request_id(sas, rng):
+    """Satellite pin (request lineage): a killed replica's re-submitted
+    request keeps its ORIGINAL trace/request id — `Response.request_id`
+    provenance survives the death instead of being orphaned by a fresh
+    engine-minted id — and the episode shows inside the SAME trace as a
+    typed `reroute` span stamped `rerouted_from`, with the `rerouted`
+    flight event carrying the trace id."""
+    from genrec_tpu.obs import SpanTracer
+
+    tracer = SpanTracer(capacity=8192)
+    model, params = sas
+
+    def make(rid):
+        return ServingEngine(
+            [RetrievalHead("sasrec", model, top_k=5)], params,
+            ladder=BucketLadder((1, 4), (8,)), max_batch=4,
+            max_wait_ms=250.0, handle_signals=False, replica_id=rid,
+            tracer=tracer,
+        )
+
+    fr = get_flight_recorder()
+    before = len(fr.events("rerouted"))
+    router = FleetRouter(make, initial_replicas=2, tracer=tracer).start()
+    try:
+        futs = [router.submit(_req(rng)) for _ in range(6)]
+        stranded = router.kill_replica("r0")
+        assert stranded >= 1
+        resps = [f.result(60) for f in futs]
+        ids = [r.request_id for r in resps]
+        assert all(i is not None for i in ids)
+        assert len(set(ids)) == 6  # no re-minted ids after the reroute
+        rerouted = fr.events("rerouted")[before:]
+        assert len(rerouted) == stranded
+        for e in rerouted:
+            assert e["component"] == "fleet_router"
+            assert e["trace_id"] in set(ids)
+            spans = tracer.spans(e["trace_id"])
+            roots = [s for s in spans
+                     if s.name == "request" and s.parent_id is None]
+            assert len(roots) == 1
+            assert roots[0].attrs["component"] == "fleet_router"
+            rr = [s for s in spans if s.name == "reroute"]
+            assert len(rr) == 1
+            assert rr[0].attrs["rerouted_from"] == "r0"
+            assert rr[0].attrs["replica_to"] == "r1"
+            assert rr[0].attrs["outcome"] == "ok"
+            assert rr[0].parent_id == roots[0].span_id
+            # The SURVIVOR's engine-level request span sits in the same
+            # tree, under the fleet root.
+            eng_req = [s for s in spans
+                       if s.name == "request"
+                       and s.parent_id == roots[0].span_id]
+            assert any(s.attrs.get("replica") == "r1" for s in eng_req)
+    finally:
+        router.stop()
+
+
 def test_kill_with_no_survivor_fails_typed_not_silent(sas, rng):
     """At-most-once + typed surfacing: when the re-submit has nowhere to
     go, the future fails with ReplicaLostError — never hangs, never

@@ -800,6 +800,112 @@ def test_trace_report_compare_two_traces(tmp_path, capsys):
         trace_report.main([])
 
 
+def test_trace_context_header_roundtrip_and_child():
+    """Request lineage: the TraceContext survives the wire-header
+    round-trip (the KVHandoff v2 contract) and re-parents via child()."""
+    from genrec_tpu.obs import TraceContext
+
+    ctx = TraceContext("req-5", 7, "fleet_router")
+    assert TraceContext.from_header(ctx.to_header()) == ctx
+    child = ctx.child(11)
+    assert child.trace_id == "req-5" and child.parent_span_id == 11
+    assert child.origin == "fleet_router"
+    assert TraceContext.from_header(None) is None
+    assert TraceContext.from_header({"trace_id": None}) is None
+    # A root context (no parent yet) keeps parent None through the wire.
+    root = TraceContext("req-6", None, "disagg_front")
+    assert TraceContext.from_header(root.to_header()) == root
+
+
+def test_scoped_flight_recorder_stamps_identity():
+    """Satellite: every flight event carries its owner — component plus
+    replica/worker identity, with callables evaluated at RECORD time
+    (a replica learns its id after construction)."""
+    fr = get_flight_recorder()
+    rid = {"v": None}
+    scoped = fr.scoped("engine", replica_id=lambda: rid["v"])
+    scoped.record("lineage_test_event", foo=1)
+    rid["v"] = "r9"
+    worker = scoped.scoped("decode_worker", worker_id="tiger:d0")
+    worker.record("lineage_test_event", foo=2)
+    evs = fr.events("lineage_test_event")[-2:]
+    assert evs[0]["component"] == "engine" and evs[0]["replica_id"] is None
+    assert evs[1]["component"] == "decode_worker"
+    assert evs[1]["replica_id"] == "r9"
+    assert evs[1]["worker_id"] == "tiger:d0"
+    # Explicit fields win over the scope's.
+    worker.record("lineage_test_event", component="override")
+    assert fr.events("lineage_test_event")[-1]["component"] == "override"
+
+
+def test_tracer_stats_and_component_lanes():
+    """Tracer self-metering counters + per-(trace, component) export
+    lanes: a lineage trace fans into one Perfetto track per component."""
+    tracer = SpanTracer(capacity=64)
+    tid = tracer.new_trace()
+    root = tracer.allocate_span_id()
+    tracer.record_span("route", tid, 0.0, 1.0, parent_id=root,
+                       component="fleet_router")
+    tracer.record_span("prefill", tid, 1.0, 2.0, parent_id=root,
+                       component="prefill_worker")
+    tracer.record_span("request", tid, 0.0, 3.0, span_id=root,
+                       component="fleet_router")
+    s = tracer.stats()
+    assert s["enabled"] and s["spans_recorded"] == 3
+    assert s["traces_started"] == 1 and s["ring_spans"] == 3
+    assert s["ring_capacity"] == 64
+    lanes = {
+        (e["args"]["trace_id"], e["args"].get("component")): e["tid"]
+        for e in tracer.to_chrome_trace()["traceEvents"]
+    }
+    assert len(set(lanes.values())) == 2  # two component lanes, one trace
+
+
+def test_critical_path_segments_sum_to_root(tmp_path):
+    """The deepest-cover partition attributes every instant of the root
+    span to exactly one segment, so segments sum to the root duration —
+    including nested containers (slot_residency) and untraced gaps."""
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+    import trace_report
+
+    tracer = SpanTracer(capacity=128)
+    tid = tracer.new_trace()
+    root = tracer.allocate_span_id()
+    sid = tracer.allocate_span_id()
+    t = 100.0
+    tracer.record_span("queue_wait", tid, t, t + 0.010, parent_id=root,
+                       component="prefill_worker")
+    tracer.record_span("prefill", tid, t + 0.010, t + 0.030,
+                       parent_id=root, component="prefill_worker")
+    tracer.record_span("decode_step", tid, t + 0.032, t + 0.040,
+                       parent_id=sid, component="decode_worker")
+    tracer.record_span("slot_residency", tid, t + 0.030, t + 0.045,
+                       span_id=sid, parent_id=root,
+                       component="decode_worker")
+    tracer.record_span("request", tid, t, t + 0.050, span_id=root,
+                       component="fleet_router")
+    path = tracer.dump(str(tmp_path / "lineage.json"))
+    rep = trace_report.critical_path_report(trace_report.load_trace(path))
+    assert rep["n_requests"] == 1 and rep["unrooted_traces"] == 0
+    segs = {k: v["total_ms"] for k, v in rep["segments"].items()}
+    assert segs["queue_wait"] == pytest.approx(10.0, abs=1e-3)
+    assert segs["prefill"] == pytest.approx(20.0, abs=1e-3)
+    assert segs["decode"] == pytest.approx(8.0, abs=1e-3)
+    # residency minus its decode child = the scheduler gap
+    assert segs["slot_gap"] == pytest.approx(7.0, abs=1e-3)
+    # root time no child covers
+    assert segs["untraced"] == pytest.approx(5.0, abs=1e-3)
+    assert sum(segs.values()) == pytest.approx(50.0, abs=1e-3)
+    assert rep["max_segment_sum_error_ms"] <= 1e-3
+    assert rep["segments"]["decode"]["components"] == ["decode_worker"]
+    # tail blame ranks the dominant segment first
+    assert rep["tail"]["blame"][0]["segment"] == "prefill"
+    # --compare --critical-path: identical files diff to zero
+    cmp = trace_report.compare_critical_paths(rep, rep)
+    assert cmp["segments"]["prefill"]["p50_ms_delta"] == 0.0
+
+
 def test_log_serving_stats_hbm_line_per_head():
     """Satellite: one HBM line per head (ledger total vs budget,
     headroom %) beside the pool gauges."""
