@@ -688,6 +688,12 @@ def _serve_bench(model, params, valid_ids, rng, batch: int = SERVE_BATCH,
         out["spec"] = _spec_serve_bench(model, params, valid_ids, rng)
     except Exception as e:
         print(f"bench: spec serve benchmark failed: {e!r}", file=sys.stderr)
+    # Quantized serving: resident decode streams at a fixed HBM budget,
+    # fp32 vs int8 page pools (ledger-verified), with qps/p99 beside.
+    try:
+        out["quant"] = _quant_serve_bench(model, params, valid_ids, rng)
+    except Exception as e:
+        print(f"bench: quant serve benchmark failed: {e!r}", file=sys.stderr)
     return out
 
 
@@ -1154,8 +1160,11 @@ def _disagg_bench(model, params, valid_ids, rng, batch: int = 8) -> dict:
       the gated one: it bounds what the transport swap costs before any
       network enters the picture.
     - **wire_bytes_per_handoff**: mean serialized handoff size on the
-      deterministic trace — pure shape math (KV pages + state snapshot
-      + header), so the gate catches wire-format growth.
+      deterministic trace, measured off the ACTUAL packed payloads
+      (``len(pack_handoff(...))`` per admitted handoff — KV pages at
+      their storage dtype + scales when quantized + state snapshot +
+      header), so the gate catches wire-format growth and the number
+      shrinks when the pool is int8.
     - **qps at parity traffic**: the same seeded Zipfian repeat-user
       trace through the in-process front (1 prefill + 2 decode workers)
       and through a co-located paged engine. On ONE host the split buys
@@ -1625,6 +1634,128 @@ def _paged_serve_bench(model, params, valid_ids, rng,
             + (
                 " (compute-bound CPU host: the capacity win is the HBM lever "
                 "and does not convert to CPU throughput — see sweeps)"
+                if backend != "tpu" else ""
+            )
+        ),
+    )
+
+
+def _quant_serve_bench(model, params, valid_ids, rng,
+                       batch: int = SERVE_BATCH, window_s: float = 3.0) -> dict:
+    """Quantized serving (int8 KV page pool) vs fp32, same engine
+    geometry and traffic:
+
+    - **streams at a fixed HBM budget** (ledger-verified): the budget is
+      what the fp32 pool actually costs for ``max_slots`` resident
+      decode streams, read off the engine's own MemoryLedger (the same
+      ``kv_page_pool`` operand that warmup refusal math gates on — not
+      hand shape math). int8 streams in that budget follow from the
+      int8 pool's measured per-stream ledger bytes; the gated
+      ``streams_improvement`` is the ratio, expected >= 2x (int8 rows +
+      one fp32 scale per page row vs fp32 rows).
+    - **qps / p99** (measured): both engines driven closed-loop by
+      ``2*batch`` submitters over the same request distribution —
+      dequant-at-read must not tax the decode path. On a CPU host both
+      numbers are compute-bound and CPU-labeled; the capacity ratio is
+      the HBM lever and holds on any backend.
+    """
+    import threading
+
+    import jax
+
+    from genrec_tpu.serving import BucketLadder, PagedConfig, Request, ServingEngine
+    from genrec_tpu.serving.heads import TigerGenerativeHead
+
+    items = BENCH_ITEMS
+    n_chips = max(jax.device_count(), 1)
+    ladder = BucketLadder((1, batch), (items,))
+    n_tok = 1 + items * model.sem_id_dim
+    geometry = dict(max_slots=2 * batch, page_size=16,
+                    pages_per_slot=-(-n_tok // 16))
+
+    def mkreq() -> "Request":
+        return Request(
+            head="tiger",
+            history=rng.integers(0, len(valid_ids), items),
+            user_id=int(rng.integers(0, 10_000)),
+        )
+
+    def run(kv_dtype: str) -> dict:
+        engine = ServingEngine(
+            [TigerGenerativeHead(model, valid_ids, top_k=DECODE_BEAM_K,
+                                 name="tiger")],
+            params, ladder=ladder, max_batch=batch, max_wait_ms=2.0,
+            handle_signals=False,
+            paged_config=PagedConfig(kv_dtype=kv_dtype, **geometry),
+        ).start()
+        try:
+            lat: list[float] = []
+            lock = threading.Lock()
+            stop = threading.Event()
+
+            def worker() -> None:
+                while not stop.is_set():
+                    t0 = time.perf_counter()
+                    engine.serve(mkreq(), timeout=600)
+                    with lock:
+                        lat.append(time.perf_counter() - t0)
+
+            threads = [threading.Thread(target=worker, daemon=True)
+                       for _ in range(2 * batch)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            time.sleep(window_s)
+            stop.set()
+            for t in threads:
+                t.join(600)
+            wall = time.perf_counter() - t0
+            hbm = engine.stats()["hbm"]["heads"]["tiger"]["operands"]
+            pool_bytes = hbm["kv_page_pool"]
+        finally:
+            stats = engine.stop()
+        lat.sort()
+        pct = lambda q: round(
+            lat[min(len(lat) - 1, int(q * len(lat)))] * 1e3, 2) if lat else None
+        return dict(
+            qps=round(len(lat) / wall, 2),
+            p50_ms=pct(0.50),
+            p99_ms=pct(0.99),
+            requests=len(lat),
+            ledger_pool_bytes=int(pool_bytes),
+            recompilations_steady=stats["recompilations"],
+        )
+
+    fp32 = run("float32")
+    int8 = run("int8")
+    # Fixed budget = the fp32 pool's LEDGER cost for max_slots streams;
+    # per-stream cost for each dtype is its own ledger total / max_slots.
+    budget = fp32["ledger_pool_bytes"]
+    streams_fp32 = geometry["max_slots"]
+    streams_int8 = int(budget // (int8["ledger_pool_bytes"] / streams_fp32))
+    backend = jax.default_backend()
+    return dict(
+        backend=backend,
+        traffic=f"{items}-item histories, {2 * batch} closed-loop submitters",
+        fp32=fp32,
+        int8=int8,
+        hbm_budget_bytes=int(budget),
+        kv_bytes_per_stream_fp32=int(fp32["ledger_pool_bytes"] / streams_fp32),
+        kv_bytes_per_stream_int8=int(int8["ledger_pool_bytes"] / streams_fp32),
+        max_resident_decode_streams_fp32=round(streams_fp32 / n_chips, 2),
+        max_resident_decode_streams_int8=round(streams_int8 / n_chips, 2),
+        streams_improvement=round(streams_int8 / max(streams_fp32, 1), 2),
+        int8_vs_fp32_qps=round(int8["qps"] / max(fp32["qps"], 1e-9), 3),
+        recompilations_steady=(fp32["recompilations_steady"]
+                               + int8["recompilations_steady"]),
+        note=(
+            "budget = the fp32 pool's MemoryLedger kv_page_pool bytes for "
+            "max_slots resident decode streams; int8 streams follow from "
+            "the int8 pool's own ledger bytes (per-page-row fp32 scales "
+            f"included); backend={backend}"
+            + (
+                " (compute-bound CPU host: the capacity win is the HBM "
+                "lever and does not convert to CPU throughput)"
                 if backend != "tpu" else ""
             )
         ),

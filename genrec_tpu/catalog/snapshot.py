@@ -80,6 +80,7 @@ class CatalogSnapshot:
         self._trie: Optional[TensorTrie] = None
         self._device_trie: Optional[TensorTrie] = None
         self._item_index: Optional[dict] = None
+        self._quantized_vecs = None
 
     # -- construction --------------------------------------------------------
 
@@ -127,6 +128,26 @@ class CatalogSnapshot:
         if self._device_trie is None:
             self._device_trie = self.trie().device()
         return self._device_trie
+
+    def quantized_item_vecs(self):
+        """``item_vecs`` as an int8 per-row-quantized ``QuantizedTable``
+        (cached) — the compact scoring operand for quantized retrieval
+        towers. Built ONCE per catalog version (snapshots are immutable,
+        so the cache can never serve a stale quantization), on the
+        staging thread like the device trie, never on the batcher.
+        Raises if the snapshot carries no dense item vectors."""
+        if self.item_vecs is None:
+            raise ValueError(
+                f"catalog {self.version or '<unversioned>'} has no "
+                "item_vecs to quantize"
+            )
+        if self._quantized_vecs is None:
+            from genrec_tpu.ops.quant import QuantizedTable
+
+            self._quantized_vecs = QuantizedTable.from_array(
+                np.asarray(self.item_vecs, np.float32)
+            )
+        return self._quantized_vecs
 
     def item_index(self) -> dict:
         """sem-id tuple -> corpus item id (cached; O(N) Python, built on

@@ -158,9 +158,18 @@ class TensorTrie:
         # The dead-prefix sentinel C must still produce int32 candidate
         # keys below PAD_KEY: (C + 1) * K is the largest candidate formed.
         if (C + 1) * K > PAD_KEY:
+            max_c = PAD_KEY // K - 1
+            rung = MIN_CAPACITY
+            while rung * CAPACITY_GROWTH <= max_c:
+                rung *= CAPACITY_GROWTH
             raise ValueError(
-                f"capacity {C} x codebook {K} overflows int32 keys; "
-                "a wider key dtype is needed for this corpus"
+                f"capacity {C} x codebook {K} overflows int32 keys: the "
+                f"largest candidate key (C + 1) * K = {(C + 1) * K} exceeds "
+                f"PAD_KEY = {PAD_KEY}. The largest usable capacity for this "
+                f"codebook is {max_c} (ladder rung {rung}); rebuild with "
+                f"capacity <= {rung} (which must still cover the widest "
+                "step), shrink the catalog snapshot, or wait for wider "
+                "(int64) trie keys — tracked on the ROADMAP."
             )
         keys = np.full((D, C), PAD_KEY, np.int32)
         offsets = np.zeros((D, C + 1), np.int32)

@@ -320,6 +320,7 @@ class DisaggFront:
             bank_cfg = PagedConfig(
                 max_slots=1, page_size=cfg.page_size,
                 pages_per_slot=cfg.pages_per_slot, num_pages=bank_pages,
+                kv_dtype=cfg.kv_dtype,
             )
             bank = KVPagePool(bank_cfg, n_layers, n_heads, head_dim, dtype)
             return _HeadGroup(head, bank, InProcessTransport(bank),
@@ -340,6 +341,7 @@ class DisaggFront:
                 max_slots=1, page_size=cfg.page_size,
                 pages_per_slot=cfg.pages_per_slot,
                 num_pages=1 + cfg.pages_per_slot * 3 * self._max_batch,
+                kv_dtype=cfg.kv_dtype,
             )
             pool = KVPagePool(staging_cfg, n_layers, n_heads, head_dim, dtype)
             owns = True
@@ -369,6 +371,7 @@ class DisaggFront:
                 max_slots=cfg.max_slots, page_size=cfg.page_size,
                 pages_per_slot=cfg.pages_per_slot,
                 num_pages=group.bank.cfg.num_pages,
+                kv_dtype=cfg.kv_dtype,
             )
             pool = KVPagePool(view_cfg, n_layers, n_heads, head_dim, dtype,
                               bank=group.bank)

@@ -43,7 +43,12 @@ from genrec_tpu.serving.types import ServingError
 #: rooted trace the router/prefill side started (docs/OBSERVABILITY.md
 #: "Request lineage"). v1 payloads are refused typed like any other
 #: version skew.
-WIRE_VERSION = 2
+#: v3: quantized KV (docs/SERVING.md "Quantized serving") — the header
+#: carries ``kv_dtype``, and int8 payloads ship per-layer
+#: ``k_scale{i}``/``v_scale{i}`` fp32 per-page-row scale planes beside
+#: the int8 page content (the 2-4x wire shrink the quantized pool buys
+#: travels the wire too). v2 payloads are refused typed.
+WIRE_VERSION = 3
 
 
 class DisaggError(ServingError):
@@ -105,6 +110,11 @@ class KVHandoff:
     #: the serializing tier, so the receiving decode worker's spans
     #: attach under the same trace the prefill side recorded into.
     trace: Optional[TraceContext] = None
+    #: Page-pool storage dtype ("float32" | "int8") of the KV this
+    #: handoff carries. Both sides must agree — a decode pool reading
+    #: int8 rows as fp32 (or vice versa) would be silent garbage, so
+    #: ``DecodeWorker.validate`` refuses skew typed.
+    kv_dtype: str = "float32"
     pages: Optional[list] = None
     wire: Optional[bytes] = None
 
@@ -128,13 +138,18 @@ def pack_handoff(handoff: KVHandoff, k_content, v_content) -> bytes:
     format. ``k_content``/``v_content`` are per-layer host arrays shaped
     ``(n_pages_used, page_size, n_heads, head_dim)`` — exactly the pages
     the run covers, no padding (the receiving side re-pads to its own
-    fixed scatter shape)."""
+    fixed scatter shape). For an int8 handoff (``handoff.kv_dtype ==
+    "int8"``) each layer entry is a ``(data, scale)`` pair — int8 page
+    rows plus their fp32 ``(n_pages_used, page_size)`` scale plane —
+    and the scales ship as ``k_scale{i}``/``v_scale{i}`` arrays."""
+    quantized = handoff.kv_dtype == "int8"
     header = {
         "wire_version": WIRE_VERSION,
         "head": handoff.head,
         "n_tokens": int(handoff.n_tokens),
         "bucket": list(handoff.bucket),
         "layout": list(handoff.layout),
+        "kv_dtype": handoff.kv_dtype,
         "params_step": handoff.params_step,
         "catalog_version": handoff.catalog_version,
         "prefill_worker_id": handoff.prefill_worker_id,
@@ -147,8 +162,14 @@ def pack_handoff(handoff: KVHandoff, k_content, v_content) -> bytes:
     arrays = {"__header__": np.frombuffer(
         json.dumps(header).encode("utf-8"), np.uint8)}
     for i, (k, v) in enumerate(zip(k_content, v_content)):
-        arrays[f"k{i}"] = np.ascontiguousarray(k)
-        arrays[f"v{i}"] = np.ascontiguousarray(v)
+        if quantized:
+            arrays[f"k{i}"] = np.ascontiguousarray(k[0])
+            arrays[f"k_scale{i}"] = np.ascontiguousarray(k[1])
+            arrays[f"v{i}"] = np.ascontiguousarray(v[0])
+            arrays[f"v_scale{i}"] = np.ascontiguousarray(v[1])
+        else:
+            arrays[f"k{i}"] = np.ascontiguousarray(k)
+            arrays[f"v{i}"] = np.ascontiguousarray(v)
     for key in header["state_keys"]:
         arrays[f"s_{key}"] = np.ascontiguousarray(handoff.init[key])
     buf = io.BytesIO()
@@ -170,8 +191,17 @@ def unpack_handoff(data: bytes) -> tuple[KVHandoff, tuple, tuple]:
                 "the wrong layout"
             )
         n_layers = int(header["n_layers"])
-        k_content = tuple(z[f"k{i}"] for i in range(n_layers))
-        v_content = tuple(z[f"v{i}"] for i in range(n_layers))
+        kv_dtype = header.get("kv_dtype", "float32")
+        if kv_dtype == "int8":
+            k_content = tuple(
+                (z[f"k{i}"], z[f"k_scale{i}"]) for i in range(n_layers)
+            )
+            v_content = tuple(
+                (z[f"v{i}"], z[f"v_scale{i}"]) for i in range(n_layers)
+            )
+        else:
+            k_content = tuple(z[f"k{i}"] for i in range(n_layers))
+            v_content = tuple(z[f"v{i}"] for i in range(n_layers))
         init = {key: z[f"s_{key}"] for key in header["state_keys"]} or None
     handoff = KVHandoff(
         head=header["head"],
@@ -184,6 +214,7 @@ def unpack_handoff(data: bytes) -> tuple[KVHandoff, tuple, tuple]:
         prefill_worker_id=header["prefill_worker_id"],
         warm=bool(header["warm"]),
         trace=TraceContext.from_header(header.get("trace")),
+        kv_dtype=kv_dtype,
         wire=data,
     )
     return handoff, k_content, v_content

@@ -141,8 +141,15 @@ class SerializingTransport(KVTransport):
         if id(pool) in self._gather:
             return
         P = pool.cfg.pages_per_slot
+        quantized = pool.cfg.kv_dtype == "int8"
 
         def gather(k_pools, v_pools, pages_vec):
+            # Quantized pools gather (data, scale) row pairs — the wire
+            # moves the int8 content WITH its per-page-row scales, never
+            # a dequantized copy.
+            if quantized:
+                return (tuple(k.take_rows(pages_vec) for k in k_pools),
+                        tuple(v.take_rows(pages_vec) for v in v_pools))
             return (tuple(k[pages_vec] for k in k_pools),
                     tuple(v[pages_vec] for v in v_pools))
 
@@ -160,21 +167,35 @@ class SerializingTransport(KVTransport):
         if id(pool) in self._scatter:
             return
         P = pool.cfg.pages_per_slot
+        quantized = pool.cfg.kv_dtype == "int8"
 
         def scatter(k_pools, v_pools, pages_vec, k_content, v_content):
             # Padding rows target the reserved null page 0 — attention
             # never reads it unmasked (ops/paged.py contract), so
             # duplicate index-0 writes are harmless.
+            if quantized:
+                k_pools = tuple(k.put_rows(pages_vec, c[0], c[1])
+                                for k, c in zip(k_pools, k_content))
+                v_pools = tuple(v.put_rows(pages_vec, c[0], c[1])
+                                for v, c in zip(v_pools, v_content))
+                return k_pools, v_pools
             k_pools = tuple(k.at[pages_vec].set(c)
                             for k, c in zip(k_pools, k_content))
             v_pools = tuple(v.at[pages_vec].set(c)
                             for v, c in zip(v_pools, v_content))
             return k_pools, v_pools
 
-        page_shape = jax.ShapeDtypeStruct(
-            (P,) + tuple(np.shape(pool.k_pools[0])[1:]),
-            np.result_type(pool.k_pools[0]),
-        )
+        if quantized:
+            geo = tuple(pool.k_pools[0].data.shape[1:])
+            page_shape = (
+                jax.ShapeDtypeStruct((P,) + geo, np.int8),
+                jax.ShapeDtypeStruct((P, pool.cfg.page_size), np.float32),
+            )
+        else:
+            page_shape = jax.ShapeDtypeStruct(
+                (P,) + tuple(np.shape(pool.k_pools[0])[1:]),
+                np.result_type(pool.k_pools[0]),
+            )
         args = (
             _sds_tree(pool.k_pools), _sds_tree(pool.v_pools),
             jax.ShapeDtypeStruct((P,), np.int32),
@@ -198,8 +219,14 @@ class SerializingTransport(KVTransport):
             src_pool.k_pools, src_pool.v_pools, jnp.asarray(vec)
         )
         n = len(pages)
-        k_host = tuple(np.asarray(k)[:n] for k in k_content)
-        v_host = tuple(np.asarray(v)[:n] for v in v_content)
+        if src_pool.cfg.kv_dtype == "int8":
+            k_host = tuple((np.asarray(d)[:n], np.asarray(s)[:n])
+                           for d, s in k_content)
+            v_host = tuple((np.asarray(d)[:n], np.asarray(s)[:n])
+                           for d, s in v_content)
+        else:
+            k_host = tuple(np.asarray(k)[:n] for k in k_content)
+            v_host = tuple(np.asarray(v)[:n] for v in v_content)
         handoff.wire = pack_handoff(handoff, k_host, v_host)
         handoff.pages = None  # nothing pinned on the sender side
 
@@ -222,10 +249,19 @@ class SerializingTransport(KVTransport):
                 )
             parsed = handoff._parsed = (k_content, v_content)
         k_content, v_content = parsed
-        n = k_content[0].shape[0]
-        if k_content[0].shape[1] != dst_pool.cfg.page_size:
+        quantized = handoff.kv_dtype == "int8"
+        if handoff.kv_dtype != dst_pool.cfg.kv_dtype:
+            # Backstop behind DecodeWorker.validate: bytes scattered
+            # under the wrong storage dtype would be silent garbage.
             raise HandoffRefusedError(
-                f"handoff page size {k_content[0].shape[1]} != receiving "
+                f"handoff kv_dtype {handoff.kv_dtype!r} != receiving pool "
+                f"kv_dtype {dst_pool.cfg.kv_dtype!r}"
+            )
+        first = k_content[0][0] if quantized else k_content[0]
+        n = first.shape[0]
+        if first.shape[1] != dst_pool.cfg.page_size:
+            raise HandoffRefusedError(
+                f"handoff page size {first.shape[1]} != receiving "
                 f"pool page size {dst_pool.cfg.page_size}"
             )
         if n > dst_pool.cfg.pages_per_slot:
@@ -238,12 +274,20 @@ class SerializingTransport(KVTransport):
             P = dst_pool.cfg.pages_per_slot
             vec = np.zeros(P, np.int32)
             vec[:n] = pages
-            pad = ((0, P - n),) + ((0, 0),) * (k_content[0].ndim - 1)
+
+            def _padded(content):
+                if quantized:
+                    pad_d = ((0, P - n),) + ((0, 0),) * (content[0][0].ndim - 1)
+                    pad_s = ((0, P - n), (0, 0))
+                    return tuple((np.pad(d, pad_d), np.pad(s, pad_s))
+                                 for d, s in content)
+                pad = ((0, P - n),) + ((0, 0),) * (content[0].ndim - 1)
+                return tuple(np.pad(c, pad) for c in content)
+
             scatter = self._scatter[id(dst_pool)]
             k_pools, v_pools = scatter(
                 dst_pool.k_pools, dst_pool.v_pools, jnp.asarray(vec),
-                tuple(np.pad(k, pad) for k in k_content),
-                tuple(np.pad(v, pad) for v in v_content),
+                _padded(k_content), _padded(v_content),
             )
             dst_pool.k_pools, dst_pool.v_pools = k_pools, v_pools
             return dst_pool.bind_pages(pages, handoff.n_tokens)

@@ -396,6 +396,7 @@ class PrefillWorker:
             params_step=self.params_step,
             catalog_version=self.head.catalog_version,
             prefill_worker_id=self.worker_id, warm=warm, trace=trace,
+            kv_dtype=self.pool.cfg.kv_dtype,
         )
 
     def _prefill_cold(self, cold, lock,
@@ -778,6 +779,13 @@ class DecodeWorker:
             raise HandoffRefusedError(
                 f"handoff KV layout {tuple(handoff.layout)} != this "
                 f"worker's {layout_of(self.head)}"
+            )
+        if handoff.kv_dtype != self.pool.cfg.kv_dtype:
+            raise HandoffRefusedError(
+                f"handoff KV pages are {handoff.kv_dtype} but this "
+                f"worker's pool stores {self.pool.cfg.kv_dtype} — "
+                "refusing to mix page storage dtypes across the split "
+                "(prefill and decode pools must share one kv_dtype)"
             )
         if handoff.params_step != self.params_step:
             raise HandoffRefusedError(

@@ -75,6 +75,110 @@ def _kernel(bt_ref, sl_ref, q_ref, k_ref, v_ref, acc_ref, m_ref, l_ref,
     )
 
 
+def _kernel_quant(bt_ref, sl_ref, q_ref, k_ref, ks_ref, v_ref, vs_ref,
+                  acc_ref, m_ref, l_ref, *, page: int, scale: float):
+    """Dequant-in-kernel twin of ``_kernel``: K/V blocks arrive int8 and
+    are dequantized per page row (``ks``/``vs`` fp32, lane-replicated to
+    128; lane 0 is the value) right before the fp32 dots — the pool is
+    never upcast outside the kernel."""
+    s = pl.program_id(0)
+    p = pl.program_id(2)
+
+    @pl.when(p == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros(acc_ref.shape, acc_ref.dtype)
+        m_ref[...] = jnp.full(m_ref.shape, NEG, m_ref.dtype)
+        l_ref[...] = jnp.zeros(l_ref.shape, l_ref.dtype)
+
+    q = q_ref[0, 0].astype(jnp.float32)  # (Kp, hdp)
+    k = k_ref[0, :, 0, :].astype(jnp.float32) * ks_ref[0, :, :1]  # (page, hdp)
+    v = v_ref[0, :, 0, :].astype(jnp.float32) * vs_ref[0, :, :1]
+    scores = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+
+    tok = p * page + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
+    scores = jnp.where(tok >= sl_ref[s], NEG, scores)
+
+    m_prev = m_ref[0, 0]  # (Kp, 128)
+    m_new = jnp.maximum(m_prev, scores.max(axis=1, keepdims=True))
+    e = jnp.exp(scores - m_new[:, :1])  # (Kp, page)
+    corr = jnp.exp(m_prev - m_new)
+    l_ref[0, 0] = l_ref[0, 0] * corr + e.sum(axis=1, keepdims=True)
+    m_ref[0, 0] = m_new
+    acc_ref[0, 0] = acc_ref[0, 0] * corr[:, :1] + jnp.dot(
+        e, v, preferred_element_type=jnp.float32
+    )
+
+
+def paged_attention_stats_pallas_quantized(q, k_pool, v_pool, block_tables,
+                                           seq_lens, interpret: bool | None = None):
+    """Quantized-pool kernel path: pools are ``ops.quant.QuantizedKVPool``
+    (int8 data (P, page, H, hd) + fp32 scale (P, page)); the per-page-row
+    scales ride as their own blocks resolved through the same block-table
+    index_map, and dequantization happens inside the kernel body. Same
+    (acc, m, l) contract and interpret-mode convention as the fp32 twin,
+    pinned against the dequant-after-gather fallback in
+    tests/test_quantized.py.
+    """
+    S, K, H, hd = q.shape
+    P, page, _, _ = k_pool.data.shape
+    Pm = block_tables.shape[1]
+    if page % 8 != 0:
+        raise ValueError(f"page_size {page} must be a multiple of 8 (sublanes)")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    Kp = _round_up(K, 8)
+    hdp = _round_up(hd, 128)
+    qp = jnp.pad(q, ((0, 0), (0, Kp - K), (0, 0), (0, hdp - hd)))
+    qp = qp.transpose(0, 2, 1, 3)  # (S, H, Kp, hdp)
+    kp = jnp.pad(k_pool.data, ((0, 0), (0, 0), (0, 0), (0, hdp - hd)))
+    vp = jnp.pad(v_pool.data, ((0, 0), (0, 0), (0, 0), (0, hdp - hd)))
+    # Scales lane-pad to (P, page, 128): only lane 0 is read in the
+    # kernel, the rest is tiling headroom (a (1, page) block is not
+    # lane-tileable). Transient operand, tiny next to the pool.
+    ks = jnp.pad(k_pool.scale[:, :, None], ((0, 0), (0, 0), (0, 127)))
+    vs = jnp.pad(v_pool.scale[:, :, None], ((0, 0), (0, 0), (0, 127)))
+
+    grid = (S, H, Pm)
+    kernel = functools.partial(_kernel_quant, page=page, scale=hd**-0.5)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, Kp, hdp), lambda s, h, p, bt, sl: (s, h, 0, 0)),
+            pl.BlockSpec((1, page, 1, hdp),
+                         lambda s, h, p, bt, sl: (bt[s, p], 0, h, 0)),
+            pl.BlockSpec((1, page, 128),
+                         lambda s, h, p, bt, sl: (bt[s, p], 0, 0)),
+            pl.BlockSpec((1, page, 1, hdp),
+                         lambda s, h, p, bt, sl: (bt[s, p], 0, h, 0)),
+            pl.BlockSpec((1, page, 128),
+                         lambda s, h, p, bt, sl: (bt[s, p], 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, Kp, hdp), lambda s, h, p, bt, sl: (s, h, 0, 0)),
+            pl.BlockSpec((1, 1, Kp, 128), lambda s, h, p, bt, sl: (s, h, 0, 0)),
+            pl.BlockSpec((1, 1, Kp, 128), lambda s, h, p, bt, sl: (s, h, 0, 0)),
+        ],
+    )
+    acc, m, l = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((S, H, Kp, hdp), jnp.float32),
+            jax.ShapeDtypeStruct((S, H, Kp, 128), jnp.float32),
+            jax.ShapeDtypeStruct((S, H, Kp, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), seq_lens.astype(jnp.int32),
+      qp, kp, ks, vp, vs)
+
+    acc = acc[:, :, :K, :hd].transpose(0, 2, 1, 3)  # (S, K, H, hd)
+    m = m[:, :, :K, 0].transpose(0, 2, 1)  # (S, K, H)
+    l = l[:, :, :K, 0].transpose(0, 2, 1)
+    return acc, m, l
+
+
 def paged_attention_stats_pallas(q, k_pool, v_pool, block_tables, seq_lens,
                                  interpret: bool | None = None):
     """Kernel twin of ops/paged.py `_stats_fallback`: (acc, m, l) fp32.

@@ -1336,10 +1336,12 @@ def cobra_generate_paged(
     item_vecs=None,
     trie=None,
     page_size: int = 8,
+    kv_dtype: str = "float32",
 ) -> CobraGenerationOutput:
     """`cobra_generate(use_cache=True)` through the paged decode path —
     prefill into a freshly built pool, then the slot-level suffix step
     with every row in lockstep (the parity reference for serving).
+    ``kv_dtype="int8"`` stores the pool quantized (ops/quant).
     """
     C = model.n_codebooks
     B = input_ids.shape[0]
@@ -1361,9 +1363,18 @@ def cobra_generate_paged(
     block_tables = jnp.asarray(
         1 + jnp.arange(B * pages_per_slot).reshape(B, pages_per_slot), jnp.int32
     )
-    zeros = lambda: tuple(
-        jnp.zeros((num_pages, page_size, H, hd), model.dtype) for _ in range(nl)
-    )
+    if kv_dtype == "int8":
+        from genrec_tpu.ops.quant import QuantizedKVPool
+
+        zeros = lambda: tuple(
+            QuantizedKVPool.zeros((num_pages, page_size, H, hd))
+            for _ in range(nl)
+        )
+    else:
+        zeros = lambda: tuple(
+            jnp.zeros((num_pages, page_size, H, hd), model.dtype)
+            for _ in range(nl)
+        )
     k_pools, v_pools, init = cobra_prefill_paged(
         model, params, input_ids, vecs, block_tables, zeros(), zeros(),
         trie, n_candidates, temperature,

@@ -858,13 +858,16 @@ def tiger_generate_paged(
     sample_factor: int = 6,
     deterministic: bool = False,
     page_size: int = 8,
+    kv_dtype: str = "float32",
 ) -> TigerGenerationOutput:
     """`tiger_generate` through the paged decode path: prefill into a
     freshly built page pool (contiguous block tables) and run the
     slot-level decode step with every row in lockstep. The parity
     reference for serving, which composes the same pieces with a real
     allocator and per-slot steps. Requires seq_mask rows to be contiguous
-    valid prefixes (the serving layout).
+    valid prefixes (the serving layout). ``kv_dtype="int8"`` stores the
+    pool quantized (ops/quant) — the int8-vs-fp32 parity reference
+    tests/test_quantized.py pins.
     """
     B = item_input_ids.shape[0]
     K = n_top_k_candidates
@@ -878,9 +881,18 @@ def tiger_generate_paged(
     block_tables = jnp.asarray(
         1 + jnp.arange(B * pages_per_slot).reshape(B, pages_per_slot), jnp.int32
     )
-    zeros = lambda: tuple(
-        jnp.zeros((num_pages, page_size, H, hd), model.dtype) for _ in range(nl)
-    )
+    if kv_dtype == "int8":
+        from genrec_tpu.ops.quant import QuantizedKVPool
+
+        zeros = lambda: tuple(
+            QuantizedKVPool.zeros((num_pages, page_size, H, hd))
+            for _ in range(nl)
+        )
+    else:
+        zeros = lambda: tuple(
+            jnp.zeros((num_pages, page_size, H, hd), model.dtype)
+            for _ in range(nl)
+        )
     k_pools, v_pools, seq_lens, _ = tiger_prefill_paged(
         model, params, user_input_ids, item_input_ids, token_type_ids,
         seq_mask, block_tables, zeros(), zeros(),

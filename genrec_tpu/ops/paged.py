@@ -35,19 +35,30 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from genrec_tpu.ops.quant import QuantizedKVPool, quantize_symmetric
+
 NEG = -1e9
 
 
-def gather_pages(pool: jax.Array, block_tables: jax.Array) -> jax.Array:
+def gather_pages(pool, block_tables: jax.Array) -> jax.Array:
     """(P, page, H, hd) pool + (S, Pm) block tables -> (S, Pm*page, H, hd)
-    contiguous per-slot K or V (the fallback's materialized view)."""
+    contiguous per-slot K or V (the fallback's materialized view).
+
+    A ``QuantizedKVPool`` dequantizes AFTER the gather — only the
+    gathered slot view is ever upcast to fp32, never the whole pool
+    (the HLO property scripts/check_quant_hlo.py pins).
+    """
     S, Pm = block_tables.shape
     page = pool.shape[1]
-    out = pool[block_tables]  # (S, Pm, page, H, hd)
+    if isinstance(pool, QuantizedKVPool):
+        rows = pool.data[block_tables].astype(jnp.float32)  # (S, Pm, page, H, hd)
+        out = rows * pool.scale[block_tables][..., None, None]
+    else:
+        out = pool[block_tables]  # (S, Pm, page, H, hd)
     return out.reshape(S, Pm * page, *pool.shape[2:])
 
 
-def write_pages(pool: jax.Array, block_tables: jax.Array, kv: jax.Array) -> jax.Array:
+def write_pages(pool, block_tables: jax.Array, kv: jax.Array):
     """Scatter one layer's prefill K or V into its slots' pages.
 
     kv: (B, H, L, hd) — the (batch-major, head-split) layout the decode
@@ -56,6 +67,11 @@ def write_pages(pool: jax.Array, block_tables: jax.Array, kv: jax.Array) -> jax.
     absorbs the padded-tail writes harmlessly (never read unmasked).
     Requires L <= Pm * page_size (the engine sizes pages_per_slot off the
     largest history bucket, so this is a config invariant, asserted).
+
+    A ``QuantizedKVPool`` quantizes HERE — per (page, position) row over
+    heads x head_dim — so pages land already-int8 and their scales land
+    at the same page index (COW shares and disagg gathers move both
+    together for free).
     """
     B, H, L, hd = kv.shape
     page = pool.shape[1]
@@ -68,6 +84,13 @@ def write_pages(pool: jax.Array, block_tables: jax.Array, kv: jax.Array) -> jax.
         )
     rows = jnp.moveaxis(kv, 1, 2)  # (B, L, H, hd)
     rows = jnp.pad(rows, ((0, 0), (0, cap - L), (0, 0), (0, 0)))
+    if isinstance(pool, QuantizedKVPool):
+        rows = rows.reshape(B, Pm, page, H, hd)
+        data, scale = quantize_symmetric(rows, (-2, -1))  # scale (B, Pm, page)
+        return QuantizedKVPool(
+            pool.data.at[block_tables].set(data),
+            pool.scale.at[block_tables].set(scale),
+        )
     rows = rows.reshape(B, Pm, page, H, hd).astype(pool.dtype)
     return pool.at[block_tables].set(rows)
 
@@ -94,13 +117,23 @@ def paged_attention_stats(
 
     use_kernel: None resolves through kernels.policy.auto_paged_attention
     (TPU-only); True forces the Pallas kernel (interpret mode off-TPU);
-    False forces this pure-JAX gather.
+    False forces this pure-JAX gather. ``QuantizedKVPool`` pools route
+    to the dequant-in-kernel twin (or the dequant-after-gather fallback)
+    with identical (acc, m, l) semantics.
     """
     if use_kernel is None:
         from genrec_tpu.kernels.policy import auto_paged_attention
 
         use_kernel = auto_paged_attention()
     if use_kernel:
+        if isinstance(k_pool, QuantizedKVPool):
+            from genrec_tpu.kernels.paged_attention import (
+                paged_attention_stats_pallas_quantized,
+            )
+
+            return paged_attention_stats_pallas_quantized(
+                q, k_pool, v_pool, block_tables, seq_lens
+            )
         from genrec_tpu.kernels.paged_attention import paged_attention_stats_pallas
 
         return paged_attention_stats_pallas(q, k_pool, v_pool, block_tables, seq_lens)

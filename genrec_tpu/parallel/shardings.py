@@ -62,8 +62,25 @@ def retrieval_rules(model_axis: str = "model") -> Sequence[Rule]:
     big tensor in SASRec/HSTU) sharded by ROWS (items) over the model
     axis, so the last-hidden scoring matmul h @ emb.T shards the item
     axis and `item_topk` merges per-shard top-k — the full (B, V) score
-    matrix never lives on one device."""
-    return ((lambda p: p.endswith("item_embedding"), 0, model_axis),)
+    matrix never lives on one device. The substring match (not endswith)
+    also places the quantized runtime operand's leaves — its int8 data
+    (V, d) and fp32 scale (V,) both shard dim 0, which the ndim guard in
+    ``param_specs`` handles per leaf."""
+    return ((lambda p: "item_embedding" in p, 0, model_axis),)
+
+
+def _score_items(h, emb):
+    """fp32 (B, V) scores of last-hiddens against a table (or shard).
+
+    A ``QuantizedTable`` dequantizes AT SCORE: ``(h @ data.T) * scale``
+    equals ``h @ (data * scale[:, None]).T`` exactly in fp32, so the
+    resident operand stays int8 and accumulation stays fp32. Detected
+    structurally (``.data``/``.scale``) — parallel is L0 and must not
+    import ``ops.quant``; any 2-leaf (rows, row-scales) container works.
+    """
+    if hasattr(emb, "scale"):
+        return (h @ emb.data.astype(jnp.float32).T) * emb.scale[None, :]
+    return (h @ emb.T).astype(jnp.float32)
 
 
 def item_topk(h, item_emb, k: int, *, mesh: Mesh | None = None,
@@ -71,17 +88,23 @@ def item_topk(h, item_emb, k: int, *, mesh: Mesh | None = None,
     """Top-k items from last-hidden states: (B, d) x (V, d) -> scores/ids
     (B, k), fp32, with the pad row (item id 0) excluded.
 
+    ``item_emb`` is a plain (V, d) table or an int8
+    ``ops.quant.QuantizedTable`` (dequant-at-score, identical outputs up
+    to quantization error — the recall floor tests/test_quantized.py
+    pins).
+
     With a mesh whose ``model_axis`` divides V, runs as a shard_map over
     the item axis: each device scores and top-k's only ITS slice of the
     table, then the (B, k*n_shards) locals merge with one small top-k —
     per-device score memory drops n_shards-fold. Otherwise (mesh=None,
     degree 1, or non-divisible V) the plain single-device computation.
     """
+    quantized = hasattr(item_emb, "scale")
     V = item_emb.shape[0]
     k = min(k, V)
 
     def plain(h, emb):
-        scores = (h @ emb.T).astype(jnp.float32)
+        scores = _score_items(h, emb)
         scores = scores.at[:, 0].set(-jnp.inf)
         return jax.lax.top_k(scores, k)
 
@@ -95,14 +118,22 @@ def item_topk(h, item_emb, k: int, *, mesh: Mesh | None = None,
     except ImportError:
         from jax.experimental.shard_map import shard_map
 
+    # in_specs must mirror the arg pytrees: a QuantizedTable operand is
+    # a 2-leaf pytree — data rows and their scales shard dim 0 together
+    # (built via type(item_emb) so the class arrives with the operand).
+    emb_spec = (
+        type(item_emb)(P(model_axis, None), P(model_axis))
+        if quantized else P(model_axis, None)
+    )
+
     @functools.partial(
         shard_map, mesh=mesh,
-        in_specs=(P(), P(model_axis, None)),
+        in_specs=(P(), emb_spec),
         out_specs=(P(None, model_axis), P(None, model_axis)),
     )
     def local_topk(h, emb_shard):
         offset = jax.lax.axis_index(model_axis) * emb_shard.shape[0]
-        scores = (h @ emb_shard.T).astype(jnp.float32)
+        scores = _score_items(h, emb_shard)
         ids = offset + jnp.arange(emb_shard.shape[0])
         scores = jnp.where(ids[None, :] == 0, -jnp.inf, scores)
         s, i = jax.lax.top_k(scores, k)

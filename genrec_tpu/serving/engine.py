@@ -928,6 +928,7 @@ class ServingEngine:
         logger: Optional[logging.Logger] = None,
         paged: bool = True,
         paged_config: Optional[PagedConfig] = None,
+        kv_dtype: str = "float32",
         prefix_cache: bool = True,
         prefix_cache_entries: int = 4096,
         spec_decode=False,
@@ -971,6 +972,19 @@ class ServingEngine:
         # baseline bench.py measures against).
         self._paged = paged
         self._paged_config = paged_config
+        # KV page dtype for the DEFAULT paged config ("float32" | "int8"
+        # — docs/SERVING.md "Quantized serving"). An explicit
+        # paged_config carries its own kv_dtype; passing both must agree
+        # (a silent override would ledger different bytes than the pool
+        # actually holds).
+        self._kv_dtype = str(kv_dtype)
+        if paged_config is not None and self._kv_dtype != "float32" \
+                and paged_config.kv_dtype != self._kv_dtype:
+            raise ValueError(
+                f"kv_dtype={self._kv_dtype!r} conflicts with "
+                f"paged_config.kv_dtype={paged_config.kv_dtype!r}; set it "
+                "on the PagedConfig (or drop the engine kwarg)"
+            )
         # Cross-request KV prefix cache over the COW page pool (paged
         # heads only): finished requests retain their prefilled pages in
         # a radix index; a repeat request with the same token-aligned
@@ -1133,6 +1147,7 @@ class ServingEngine:
             max_slots=4 * self._max_batch,
             page_size=page_size,
             pages_per_slot=-(-max_kv // page_size),
+            kv_dtype=self._kv_dtype,
         )
 
     def warmup(self) -> None:

@@ -749,22 +749,51 @@ class RetrievalHead(Head):
     left) so the model's last position is the prediction point — the same
     layout the SASRec eval path uses. ``use_timestamps=True`` (HSTU with
     temporal bias) batches each request's timestamps alongside.
+
+    ``quantized=True`` scores against an int8 per-row-quantized copy of
+    the tied item-embedding table (the largest operand at catalog scale)
+    instead of the fp32 rows in ``params``: ``on_params`` builds the
+    ``ops.quant.QuantizedTable`` ONCE per params version and threads it
+    as a runtime operand (never a closure constant), and ``item_topk``
+    dequantizes at score time with fp32 accumulation. The fp32 table
+    stays untouched in ``params`` (it is tied into the input-embedding
+    path and the hot-reload aval check).
     """
 
     def __init__(self, name: str, model, top_k: int = 10,
                  use_timestamps: bool = False, mesh=None,
-                 model_axis: str = "model"):
+                 model_axis: str = "model", quantized: bool = False):
         self.name = name
         self.model = model
         self.top_k = top_k
         self.use_timestamps = use_timestamps
         self.mesh = mesh
         self.model_axis = model_axis
+        self.quantized = bool(quantized)
+        self._qtable = None
         # SASRec/HSTU position tables are sized max_seq_len: a history
         # bucket past it would crash the warmup trace with an opaque
         # broadcast error, so buckets clamp here (the over-long tail is
         # truncated to the newest items, same as the ladder contract).
         self._max_len = int(getattr(model, "max_seq_len", 0)) or None
+
+    def on_params(self, params) -> None:
+        """Refresh the quantized scoring table — once per params version
+        (start and every hot reload), not per batch."""
+        if self.quantized:
+            from genrec_tpu.models.embeddings import quantize_item_table
+
+            self._qtable = quantize_item_table(params["item_embedding"])
+
+    def runtime_operands(self) -> tuple:
+        if not self.quantized:
+            return ()
+        if self._qtable is None:
+            raise RuntimeError(
+                f"head {self.name!r} is quantized but has no table yet; "
+                "on_params(params) must run before compilation"
+            )
+        return (self._qtable,)
 
     def max_item_id(self):
         return int(self.model.num_items)
@@ -794,17 +823,22 @@ class RetrievalHead(Head):
         del L  # shapes come from make_batch (same clamp)
         model = self.model
 
-        def fn(params, ids, *rest):
+        def fn(params, *rest):
+            if self.quantized:  # runtime operand rides ahead of the batch
+                table, rest = rest[0], rest[1:]
+            else:
+                table = params["item_embedding"]
+            ids = rest[0]
             if self.use_timestamps:
                 h = model.apply(
-                    {"params": params}, ids, rest[0], method=type(model).last_hidden
+                    {"params": params}, ids, rest[1], method=type(model).last_hidden
                 )
             else:
                 h = model.apply(
                     {"params": params}, ids, method=type(model).last_hidden
                 )
             return item_topk(
-                h.astype(jnp.float32), params["item_embedding"], self.top_k,
+                h.astype(jnp.float32), table, self.top_k,
                 mesh=self.mesh, model_axis=self.model_axis,
             )
 

@@ -67,10 +67,21 @@ class PagedConfig:
     page_size: int = 16
     pages_per_slot: int = 8
     num_pages: int = 0  # 0 = full budget: every slot can hold max pages
+    # "float32" | "int8": int8 stores pages quantized (per-page-row
+    # symmetric scales, ops/quant.QuantizedKVPool) — ~4x smaller page
+    # bytes, dequantized at the attention read with fp32 accumulation.
+    kv_dtype: str = "float32"
 
     def __post_init__(self):
         if self.max_slots <= 0 or self.page_size <= 0 or self.pages_per_slot <= 0:
             raise ValueError(f"invalid paged config {self}")
+        from genrec_tpu.ops.quant import KV_DTYPES
+
+        if self.kv_dtype not in KV_DTYPES:
+            raise ValueError(
+                f"kv_dtype {self.kv_dtype!r} not supported; "
+                f"one of {KV_DTYPES}"
+            )
         if self.page_size % 8:
             raise ValueError(
                 f"page_size {self.page_size} must be a multiple of 8 "
@@ -107,11 +118,17 @@ class PagedConfig:
 
     def hbm_bytes(self, n_layers: int, n_heads: int, head_dim: int,
                   itemsize: int = 4) -> int:
-        """Pool HBM footprint (K + V, all layers) — the fixed budget."""
-        return (
-            2 * n_layers * self.num_pages * self.page_size * n_heads
-            * head_dim * itemsize
-        )
+        """Pool HBM footprint (K + V, all layers) — the fixed budget.
+
+        ``kv_dtype="int8"`` prices real quantized bytes: one byte per
+        element plus the fp32 per-page-row scale planes (matching
+        ``obs.memory.tree_nbytes`` over the QuantizedKVPool leaves
+        exactly, so the ledger and this planner never disagree).
+        """
+        rows = 2 * n_layers * self.num_pages * self.page_size
+        if self.kv_dtype == "int8":
+            return rows * (n_heads * head_dim * 1 + 4)
+        return rows * n_heads * head_dim * itemsize
 
 
 class PageAllocator:
@@ -210,17 +227,27 @@ class KVPagePool:
         self._bank = bank
         if bank is None:
             shape = (cfg.num_pages, cfg.page_size, n_heads, head_dim)
-            self._k_pools = tuple(jnp.zeros(shape, dtype) for _ in range(n_layers))
-            self._v_pools = tuple(jnp.zeros(shape, dtype) for _ in range(n_layers))
+            if cfg.kv_dtype == "int8":
+                from genrec_tpu.ops.quant import QuantizedKVPool
+
+                self._k_pools = tuple(
+                    QuantizedKVPool.zeros(shape) for _ in range(n_layers)
+                )
+                self._v_pools = tuple(
+                    QuantizedKVPool.zeros(shape) for _ in range(n_layers)
+                )
+            else:
+                self._k_pools = tuple(jnp.zeros(shape, dtype) for _ in range(n_layers))
+                self._v_pools = tuple(jnp.zeros(shape, dtype) for _ in range(n_layers))
             self.allocator = PageAllocator(cfg.num_pages)
         else:
-            if (cfg.num_pages, cfg.page_size) != (
-                bank.cfg.num_pages, bank.cfg.page_size
+            if (cfg.num_pages, cfg.page_size, cfg.kv_dtype) != (
+                bank.cfg.num_pages, bank.cfg.page_size, bank.cfg.kv_dtype
             ) or n_layers != bank.n_layers:
                 raise ValueError(
-                    "slot view must match its bank's page geometry: "
-                    f"view {cfg} x {n_layers} layers vs bank {bank.cfg} x "
-                    f"{bank.n_layers}"
+                    "slot view must match its bank's page geometry and "
+                    f"kv_dtype: view {cfg} x {n_layers} layers vs bank "
+                    f"{bank.cfg} x {bank.n_layers}"
                 )
             self.allocator = bank.allocator
         self.block_tables = np.zeros((cfg.max_slots, cfg.pages_per_slot), np.int32)
@@ -417,6 +444,7 @@ class KVPagePool:
             "slots_active": self.active_slot_count,
             "slots_total": self.cfg.max_slots,
             "kv_tokens_resident": int(self.seq_lens.sum()),
+            "kv_dtype": self.cfg.kv_dtype,
         }
 
 

@@ -26,6 +26,12 @@
 #                            steady-state recompiles, answers
 #                            bit-identical to a co-located engine, all
 #                            pages on BOTH pools released after drain
+#   check_quant_hlo.py     — quantized serving: int8 KV pool + int8
+#                            retrieval table on ONE engine under
+#                            mixed-dtype churn — zero steady-state
+#                            recompiles, ledger totals equal the
+#                            quantized byte math, and no whole-pool
+#                            fp32 upcast baked into optimized HLO
 #   check_lineage.py       — request lineage: a routed 2-replica
 #                            disagg+spec fleet with tracing on yields
 #                            ONE rooted span tree per request crossing
@@ -160,6 +166,15 @@ if [ "$MODE" = "--smoke" ]; then
     if [ -z "${GENREC_CI_SKIP_SPEC:-}" ]; then
         run python scripts/check_spec_hlo.py --small --platform cpu
     fi
+    # Quantized-serving smoke: int8 KV + int8 retrieval table on one
+    # engine under mixed-dtype churn — zero recompiles, ledger ==
+    # quantized byte math, no whole-pool fp32 upcast in optimized HLO.
+    # GENREC_CI_SKIP_QUANT=1 skips it for callers whose pytest pass
+    # already runs tests/test_quantized.py directly (same contract as
+    # the knobs above).
+    if [ -z "${GENREC_CI_SKIP_QUANT:-}" ]; then
+        run python scripts/check_quant_hlo.py --small --platform cpu
+    fi
     # Request-lineage smoke: a routed 2-replica disagg+spec fleet with
     # tracing on — every completed request's spans form ONE rooted tree
     # spanning >=3 components (router -> prefill worker -> handoff wire
@@ -230,6 +245,7 @@ else
     run python scripts/check_fleet.py --write-note
     run python scripts/check_disagg.py --write-note
     run python scripts/check_spec_hlo.py --write-note
+    run python scripts/check_quant_hlo.py --write-note
     run python scripts/check_lineage.py --write-note
     run python scripts/check_obs.py
     run python scripts/graftlint.py

@@ -14,6 +14,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.join(os.path.dirname(__file__), "..")
 
 # Bit-compat pins for the ISSUE-8 refactor: the migrated scripts must
@@ -50,6 +52,7 @@ LINEAGE_KEYS = {"backend", "submitted", "completed", "traces_checked",
                 "spec_spans_ok", "wire_spans_ok", "segment_sum_ok",
                 "max_segment_sum_error_ms", "segments", "wire_trace_ok",
                 "recompilations", "trace_path", "ok"}
+QUANT_KEYS = {"backend", "churn", "pool_hlo", "recompilations", "ok"}
 # bench_gate is the new perf regression gate (one verdict line,
 # graftlint mold); check_obs's grown verdict (memory + slo sections) is
 # exercised by its own full run in ci_checks, not re-run here.
@@ -99,7 +102,8 @@ def test_check_scripts_keep_their_cli():
     for script in ("check_decode_hlo", "check_packed_hlo",
                    "check_fused_ce_hlo", "check_serving_hlo",
                    "check_catalog_hlo", "check_fleet", "check_disagg",
-                   "check_spec_hlo", "check_lineage", "check_obs"):
+                   "check_spec_hlo", "check_lineage", "check_obs",
+                   "check_quant_hlo"):
         proc = subprocess.run(
             [sys.executable, os.path.join(REPO, "scripts", f"{script}.py"),
              "--help"],
@@ -113,22 +117,28 @@ def test_check_scripts_keep_their_cli():
 def test_ci_checks_smoke_entrypoint():
     """The consolidated entrypoint runs every smoke check and exits 0
     (rc=2 inconclusives tolerated, real failures propagated)."""
-    # The chaos-unit, obs, graftlint and catalog subsets are skipped
-    # here: this test runs INSIDE the suite that already executes
-    # tests/test_fault_tolerance.py, tests/test_obs.py,
-    # tests/test_analysis.py and tests/test_catalog.py directly, and
-    # nesting them would double-pay their cold-start (~30s each) for no
-    # coverage. The (jax-free, sub-second) bench_gate self-test stays.
+    # The chaos-unit, obs, graftlint, catalog and quant subsets are
+    # skipped here: this test runs INSIDE the suite that already
+    # executes tests/test_fault_tolerance.py, tests/test_obs.py,
+    # tests/test_analysis.py, tests/test_catalog.py and
+    # tests/test_quantized.py directly, and nesting them would
+    # double-pay their cold-start (~30-60s each) for no coverage
+    # (check_quant_hlo's verdict schema is pinned by the slow-marked
+    # test below). The (jax-free, sub-second) bench_gate self-test
+    # stays.
     proc = subprocess.run(
         ["bash", os.path.join(REPO, "scripts", "ci_checks.sh"), "--smoke"],
         capture_output=True, text=True, timeout=900,
         env={**os.environ, "JAX_PLATFORMS": "cpu",
              "GENREC_CI_SKIP_CHAOS": "1", "GENREC_CI_SKIP_OBS": "1",
-             "GENREC_CI_SKIP_LINT": "1", "GENREC_CI_SKIP_CATALOG": "1"},
+             "GENREC_CI_SKIP_LINT": "1", "GENREC_CI_SKIP_CATALOG": "1",
+             "GENREC_CI_SKIP_QUANT": "1"},
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     # One verdict JSON per check on stdout (decode, fused-ce, packed,
-    # serving, fleet, disagg, spec, lineage, bench-gate self-test).
+    # serving, fleet, disagg, spec, lineage, bench-gate self-test; the
+    # quant check is env-skipped above, so the unfiltered smoke emits
+    # one more).
     verdicts = [json.loads(l) for l in proc.stdout.splitlines() if l.strip()]
     assert len(verdicts) == 9
     lineage = [v for v in verdicts if "segment_sum_ok" in v]
@@ -159,6 +169,27 @@ def test_ci_checks_smoke_entrypoint():
     gate = [v for v in verdicts if v.get("check") == "bench_gate"]
     assert len(gate) == 1 and set(gate[0]) == BENCH_GATE_KEYS
     assert gate[0]["self_test"]["ok"] and gate[0]["ok"]
+
+
+@pytest.mark.slow
+def test_quant_hlo_check_small(capsys):
+    """check_quant_hlo's verdict schema + the int8-serving pins (slow:
+    it warms a mixed-dtype two-head engine, ~60s — the tier-1 suite
+    already covers the same surfaces via tests/test_quantized.py; this
+    pins the SMOKE CHECK's contract for the shell entrypoint)."""
+    mod = _load("check_quant_hlo")
+    rc = mod.main(["--small"])
+    verdict = json.loads(capsys.readouterr().out)
+    assert set(verdict) == QUANT_KEYS
+    assert rc == 0
+    assert verdict["recompilations"] == 0
+    assert verdict["churn"]["kv_dtype"] == "int8"
+    assert verdict["churn"]["ledger_kv_page_pool_bytes"] == \
+        verdict["churn"]["expected_kv_page_pool_bytes"]
+    assert verdict["churn"]["ledger_quant_table_bytes"] == \
+        verdict["churn"]["expected_quant_table_bytes"]
+    assert verdict["pool_hlo"]["pool_param_s8"]
+    assert not verdict["pool_hlo"]["full_pool_f32_upcast"]
 
 
 # ---------------------------------------------------------------------------
