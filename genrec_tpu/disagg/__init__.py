@@ -20,6 +20,13 @@ page granularity Ragged Paged Attention made the pool's native unit:
   + per-worker prefix cache) and `DecodeWorker` (slot-level continuous
   batching over decode-only executables, per-worker `MemoryLedger`
   budget enforced at warmup).
+- `net` — the cross-host tier: `SocketTransport` (the serializing wire
+  bytes over length-prefixed TCP frames), `RemoteDecodeWorker` (the
+  front-side proxy duck-typing the decode-worker surface, with a
+  per-peer send thread so prefill never blocks on a slow host) and
+  `serve_decode_host`/`spawn_decode_host` (the decode-host process
+  runtime). Peer death — kill -9 mid-frame included — reaps through
+  the same typed at-most-once re-submit as an in-process worker kill.
 - `front` — `DisaggFront`: the engine's exact `submit() -> Future`
   surface, request -> prefill pool -> decode pool routing, at-most-once
   typed re-submit on worker death, drain that completes in-flight
@@ -42,6 +49,12 @@ from genrec_tpu.disagg.handoff import (
     pack_handoff,
     unpack_handoff,
 )
+from genrec_tpu.disagg.net import (
+    RemoteDecodeWorker,
+    SocketTransport,
+    serve_decode_host,
+    spawn_decode_host,
+)
 from genrec_tpu.disagg.transport import (
     InProcessTransport,
     KVTransport,
@@ -59,9 +72,13 @@ __all__ = [
     "KVHandoff",
     "KVTransport",
     "PrefillWorker",
+    "RemoteDecodeWorker",
     "SerializingTransport",
+    "SocketTransport",
     "WIRE_VERSION",
     "WorkerLostError",
     "pack_handoff",
+    "serve_decode_host",
+    "spawn_decode_host",
     "unpack_handoff",
 ]

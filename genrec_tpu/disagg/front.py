@@ -131,6 +131,8 @@ class DisaggFront:
         n_prefill: int = 1,
         n_decode: int = 1,
         transport: str = "inprocess",
+        workers: Optional[Sequence[str]] = None,
+        standby_workers: Optional[Sequence[str]] = None,
         paged_config: Optional[PagedConfig] = None,
         bank_num_pages: Optional[int] = None,
         prefix_cache: bool = True,
@@ -144,6 +146,8 @@ class DisaggFront:
         replica_id: Optional[str] = None,
         spec_decode=False,
         spec_fanout=8,
+        mesh=None,
+        model_axis: str = "model",
         tracer: Optional[SpanTracer] = None,
         handle_signals: bool = False,
         guard=None,
@@ -176,13 +180,31 @@ class DisaggFront:
             raise ValueError("need at least one worker per role")
         self._n_prefill = n_prefill
         self._n_decode = n_decode
-        if transport not in ("inprocess", "serializing"):
+        if transport not in ("inprocess", "serializing", "socket"):
             raise ValueError(
                 f"unknown transport {transport!r}: "
-                "'inprocess' (zero-copy shared page bank) or "
-                "'serializing' (host-roundtrip wire)"
+                "'inprocess' (zero-copy shared page bank), "
+                "'serializing' (host-roundtrip wire) or "
+                "'socket' (cross-process decode hosts)"
+            )
+        if transport == "socket":
+            if not workers:
+                raise ValueError(
+                    "transport='socket' needs workers=[\"host:port\", ...] "
+                    "— the decode-host processes this front serves "
+                    "through (spawn_decode_host returns the address)"
+                )
+        elif workers:
+            raise ValueError(
+                f"workers= is the socket tier's knob; the {transport!r} "
+                "transport builds its decode workers in-process "
+                "(n_decode=)"
             )
         self._transport_kind = transport
+        self._remote_addrs = list(workers or ())
+        # Unconnected decode-host addresses scale-out may consume
+        # (_add_worker on the socket tier attaches one per call).
+        self._standby_addrs = list(standby_workers or ())
         self._paged_config = paged_config
         self._bank_num_pages = bank_num_pages
         self._prefix_cache = bool(prefix_cache)
@@ -198,6 +220,13 @@ class DisaggFront:
         self._spec_decode, self._spec_fanout = normalize_spec_config(
             spec_decode, spec_fanout, self._heads
         )
+        # Tensor-parallel serving operands (the engine's mesh= knob, per
+        # front): params shard by serve_rules, owned pools/banks shard
+        # their page banks over the head axis. Socket-tier decode HOSTS
+        # place their own mesh (factory mesh_shape) — this knob covers
+        # the front's prefill side and the in-process tiers.
+        self._mesh = mesh
+        self._model_axis = str(model_axis)
         self._handle_signals = handle_signals
         self._guard = guard
         self._log = logger or logging.getLogger("genrec_tpu")
@@ -323,7 +352,19 @@ class DisaggFront:
                 kv_dtype=cfg.kv_dtype,
             )
             bank = KVPagePool(bank_cfg, n_layers, n_heads, head_dim, dtype)
+            if self._mesh is not None:
+                from genrec_tpu.parallel.shardings import kv_pool_sharding
+
+                place = kv_pool_sharding(self._mesh, n_heads,
+                                         self._model_axis)
+                if place is not None:
+                    bank.place(place)
             return _HeadGroup(head, bank, InProcessTransport(bank),
+                              spec_topology=topo)
+        if self._transport_kind == "socket":
+            from genrec_tpu.disagg.net import SocketTransport
+
+            return _HeadGroup(head, None, SocketTransport(),
                               spec_topology=topo)
         return _HeadGroup(head, None, SerializingTransport(),
                           spec_topology=topo)
@@ -356,7 +397,9 @@ class DisaggFront:
             prefix_cache=self._prefix_cache,
             prefix_cache_entries=self._prefix_cache_entries,
             hbm_budget_bytes=self._prefill_budget,
-            tracer=self._tracer, logger=self._log,
+            tracer=self._tracer,
+            mesh=self._mesh, model_axis=self._model_axis,
+            logger=self._log,
         )
 
     def _make_decode(self, group: _HeadGroup) -> DecodeWorker:
@@ -398,8 +441,53 @@ class DisaggFront:
             hbm_budget_bytes=self._decode_budget,
             spec_topology=group.spec_topology,
             spec_fanout=self._spec_fanout,
-            tracer=self._tracer, logger=self._log,
+            tracer=self._tracer,
+            mesh=self._mesh, model_axis=self._model_axis,
+            logger=self._log,
         )
+
+    def _make_remote_decode(self, addr: str):
+        """One connected `RemoteDecodeWorker` proxy for a decode-host
+        process (socket tier). The host accepts exactly ONE connection,
+        so the proxy connects once and is then routed to its head's
+        group by the identity it announced in its HELLO — a dead
+        address or an unknown head refuses at attach time, typed, never
+        at delivery time."""
+        from genrec_tpu.disagg.net import RemoteDecodeWorker
+
+        # The group's transport carries the tier's wire counters; until
+        # the HELLO names the head, connect through a throwaway one and
+        # swap after routing (warmup only touches connect counters).
+        w = RemoteDecodeWorker(
+            addr, transport=next(
+                g.transport for g in self._groups.values()
+            ), metrics=self.metrics, counters=self._counters,
+            flight_recorder=self._flight.scoped("decode_worker",
+                                                worker_id=addr),
+            replica_id=self.replica_id, tracer=self._tracer,
+            logger=self._log,
+        )
+        w.warmup()
+        head_name = w.identity["head"]
+        group = self._groups.get(head_name)
+        if group is None:
+            w.kill()
+            raise UnknownHeadError(
+                f"decode host {addr} serves head {head_name!r} but "
+                f"this front only has {sorted(self._groups)}"
+            )
+        w.worker_id = f"{head_name}:d{group.seq['decode']}"
+        group.seq["decode"] += 1
+        w.transport = group.transport
+        w._flight = self._flight.scoped("decode_worker",
+                                        worker_id=w.worker_id)
+        group.decode.append(w)
+        return w
+
+    def _connect_remote_decodes(self) -> None:
+        """Socket tier: attach every configured decode-host address."""
+        for addr in self._remote_addrs:
+            self._make_remote_decode(addr)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -413,9 +501,21 @@ class DisaggFront:
             group = self._build_group(head)
             for _ in range(self._n_prefill):
                 group.prefill.append(self._make_prefill(group))
-            for _ in range(self._n_decode):
-                group.decode.append(self._make_decode(group))
+            if self._transport_kind != "socket":
+                for _ in range(self._n_decode):
+                    group.decode.append(self._make_decode(group))
             self._groups[head.name] = group
+        if self._transport_kind == "socket":
+            # Decode pools live in their own processes: attach one
+            # proxy per configured host (connect + HELLO; the host
+            # warmed its grid before accepting).
+            self._connect_remote_decodes()
+            for name, g in self._groups.items():
+                if not g.decode:
+                    raise WorkerLostError(
+                        f"no decode host connected for head {name!r} — "
+                        "every head needs at least one workers= address"
+                    )
         workers = [w for g in self._groups.values()
                    for w in g.prefill + g.decode]
         for w in workers:
@@ -440,8 +540,10 @@ class DisaggFront:
         self._flight.record(
             "disagg_started", heads=sorted(self._heads),
             transport=self._transport_kind,
-            prefill_workers=self._n_prefill * len(self._heads),
-            decode_workers=self._n_decode * len(self._heads),
+            prefill_workers=sum(len(g.prefill)
+                                for g in self._groups.values()),
+            decode_workers=sum(len(g.decode)
+                               for g in self._groups.values()),
             warmup_compiles=self.metrics.warmup_compiles,
             replica_id=self.replica_id,
         )
@@ -665,10 +767,41 @@ class DisaggFront:
                 progressed |= self._deliver(group)
                 for dw in list(group.decode):
                     if dw.dead:
+                        # A remote proxy marks ITSELF dead when its peer
+                        # process drops (kill -9 included) — the pump
+                        # reaps it here exactly like kill_decode_worker:
+                        # re-submit every resident flight, typed and
+                        # at-most-once. In-process workers only die via
+                        # the kill verb, which already removed them.
+                        if dw in group.decode:
+                            self._reap_dead_decode(group, dw)
+                            progressed = True
                         continue
                     progressed |= dw.step()
             self._poll_slo()
         return progressed
+
+    def _reap_dead_decode(self, group: _HeadGroup, worker) -> None:
+        """kill_decode_worker's body for a worker that died on its own
+        (a lost decode-host peer): remove, strand, re-submit typed."""
+        group.decode.remove(worker)
+        stranded = worker.kill()
+        group.transport.forget(worker.pool)
+        self._counters["decode_worker_deaths"] += 1
+        self._flight.record(
+            "disagg_worker_dead", worker=worker.worker_id, role="decode",
+            head=group.head.name, stranded=len(stranded),
+            survivors=len(group.decode),
+            peer=getattr(worker, "peer_addr", None),
+        )
+        self._log.warning(
+            f"disagg: decode worker {worker.worker_id} "
+            f"({getattr(worker, 'peer_addr', 'in-process')}) lost with "
+            f"{len(stranded)} requests resident — re-submitting through "
+            f"{len(group.decode)} survivors"
+        )
+        for fl in stranded:
+            self._resubmit(group, fl, from_worker=worker.worker_id)
 
     def _deliver(self, group: _HeadGroup) -> bool:
         """Route pending handoffs onto decode workers with free slots
@@ -742,6 +875,7 @@ class DisaggFront:
                     parent_id=tr.parent_span_id, side="admit",
                     transport=group.transport.name, transfer_bytes=tb,
                     component="decode_worker", worker=target.worker_id,
+                    peer=getattr(target, "peer_addr", None),
                 )
             self._counters["handoffs_admitted"] += 1
             self._counters["transfer_bytes"] += tb
@@ -785,6 +919,10 @@ class DisaggFront:
                             "spec_scratch_released", head=group.head.name,
                             worker_id=dw.worker_id, reason="drain", pages=n,
                         )
+                    if hasattr(dw, "close"):
+                        # Remote proxy: SHUTDOWN handshake drains the
+                        # host process and closes both sockets clean.
+                        dw.close()
         self._flight.record("disagg_stopped",
                             completed=self.metrics.completed)
         self._drained.set()
@@ -961,6 +1099,15 @@ class DisaggFront:
                 w = self._make_prefill(group)
                 w.warmup()
                 group.prefill.append(w)
+            elif self._transport_kind == "socket":
+                # Scale-out attaches the next standby decode host; the
+                # socket tier never builds decode workers in-process.
+                if not self._standby_addrs:
+                    raise WorkerLostError(
+                        "socket-tier decode scale-out needs a standby "
+                        "decode host (standby_workers=) — none left"
+                    )
+                w = self._make_remote_decode(self._standby_addrs.pop(0))
             else:
                 w = self._make_decode(group)
                 w.warmup()
@@ -1015,6 +1162,8 @@ class DisaggFront:
                 # A removed worker's scratch reservation leaves with it
                 # (its refs would pin shared-bank pages forever).
                 worker.pool.release_scratch()
+                if hasattr(worker, "close"):
+                    worker.close()
         group.transport.forget(worker.pool)
         final = worker.stats()
         self._flight.record(
@@ -1094,6 +1243,13 @@ class DisaggFront:
             "transfer_ms": self.transfer.summary(),
             "roles": roles_by_head,
         }
+        transports = {}
+        for g in self._groups.values():
+            tstats = g.transport.stats()
+            if tstats:
+                transports[g.transport.name] = tstats
+        if transports:
+            snap["disagg"]["transports"] = transports
         if self._slo is not None:
             snap["slo"] = self._slo.snapshot()
         return snap

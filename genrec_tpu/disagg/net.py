@@ -1,0 +1,971 @@
+"""Cross-host serving: the socket `KVTransport` tier + the decode-host
+process runtime.
+
+The serializing transport already pinned the cross-host CONTRACT — every
+handoff round-trips through `pack_handoff` bytes — but both roles still
+shared one process, one GIL and one device. This module makes the hop
+real: prefill stays on the front (with the front's control plane —
+submit/route/SLO/autoscaler — untouched), decode workers run in their
+OWN OS processes (`serve_decode_host`), and the handoff bytes cross a
+TCP socket instead of a function call. Roles genuinely overlap: the
+front prefills the next batch while the decode hosts step their slots.
+
+Wire framing (everything on the socket is one of these):
+
+    [8B big-endian frame length][1B type][4B meta length][meta JSON][payload]
+
+- ``HELLO``    (host -> front): the peer's full serving identity —
+  worker_id, head, KV layout, kv_dtype, params_step, catalog_version,
+  pool geometry, warmup_compiles. The front's proxy validates every
+  handoff against THIS, so skew is refused typed before a byte of page
+  content crosses the wire.
+- ``HANDOFF``  (front -> host): meta carries the request (history /
+  user_id — the decode side finalizes against the request) + a
+  monotonic ``seq``; payload is the `pack_handoff` bytes, verbatim.
+- ``RESULT``   (host -> front): meta is the response provenance
+  (bucket, timings, worker ids), payload an ``.npz`` of
+  items/scores/sem_ids — bit-exact arrays, not reprinted floats.
+- ``REFUSED``  (host -> front): typed failure for one seq
+  (HandoffRefusedError backstop, finalize errors) — never silence.
+- ``STATS_REQ``/``STATS``: the peer's stats()/pool/recompilation
+  counters, so "0 steady-state recompiles" and "pools clean after
+  drain" stay checkable ACROSS the process boundary.
+- ``SHUTDOWN``/``BYE``: graceful drain handshake; the host exits after
+  BYE and the front knows the socket closed clean.
+
+Failure semantics (the disagg contract, held across processes): a peer
+that dies mid-frame (kill -9 included) surfaces as EOF/reset on the
+proxy's reader thread -> the proxy marks itself dead -> the front's
+pump reaps it exactly like `kill_decode_worker` — every accepted flight
+is re-submitted typed and AT MOST ONCE through the survivors, a second
+loss fails `WorkerLostError`. Sends run on a per-peer thread with a
+bounded timeout, so one slow/hung decode host never blocks the front's
+runtime thread (or the other peers' deliveries).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import logging
+import queue
+import select
+import socket as socket_mod
+import struct
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from genrec_tpu.disagg.handoff import (
+    HandoffRefusedError,
+    KVHandoff,
+    WorkerLostError,
+    unpack_handoff,
+)
+from genrec_tpu.disagg.transport import SerializingTransport
+from genrec_tpu.disagg.workers import DecodeWorker, Flight
+from genrec_tpu.obs.spans import NULL_TRACER
+from genrec_tpu.serving.kv_pool import KVPagePool, PagedConfig
+from genrec_tpu.serving.metrics import LatencyHistogram, ServingMetrics
+from genrec_tpu.serving.types import Request, Response, ServingError
+
+# Frame types (1 byte on the wire).
+HELLO, HANDOFF, RESULT, REFUSED, STATS_REQ, STATS, SHUTDOWN, BYE = range(1, 9)
+
+_LEN = struct.Struct(">Q")
+_HDR = struct.Struct(">BI")
+
+#: Hard ceiling on one frame — a corrupt length prefix must fail typed,
+#: not allocate unbounded memory. Generous: the largest real frame is
+#: one handoff's npz (pages_per_slot * page geometry).
+MAX_FRAME_BYTES = 1 << 31
+
+
+def send_frame(sock, ftype: int, meta: dict, payload: bytes = b"") -> int:
+    """Write one length-prefixed frame; returns bytes on the wire."""
+    meta_b = json.dumps(meta).encode("utf-8")
+    frame = _HDR.pack(ftype, len(meta_b)) + meta_b + payload
+    sock.sendall(_LEN.pack(len(frame)) + frame)
+    return _LEN.size + len(frame)
+
+
+def _recv_exact(sock, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed mid-frame")
+        buf += chunk
+    return bytes(buf)
+
+
+def recv_frame(sock) -> tuple[int, dict, bytes]:
+    """Read one frame. Raises ConnectionError on EOF/reset (peer death —
+    mid-frame included: a kill -9 between the length prefix and the
+    payload lands here, never as a truncated parse)."""
+    (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    if n < _HDR.size or n > MAX_FRAME_BYTES:
+        raise ConnectionError(f"insane frame length {n}")
+    frame = _recv_exact(sock, n)
+    ftype, meta_len = _HDR.unpack_from(frame)
+    meta = json.loads(frame[_HDR.size:_HDR.size + meta_len].decode("utf-8"))
+    return ftype, meta, frame[_HDR.size + meta_len:]
+
+
+def _jsonable(obj):
+    """Recursively JSON-safe (numpy scalars/arrays -> python)."""
+    if isinstance(obj, dict):
+        return {str(k): _jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonable(v) for v in obj]
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    if isinstance(obj, np.generic):
+        return obj.item()
+    return obj
+
+
+class SocketTransport(SerializingTransport):
+    """The network tier: `send` is the serializing gather+pack (the wire
+    bytes ARE the contract), and the bytes then leave the process on a
+    `RemoteDecodeWorker`'s per-peer send thread instead of scattering
+    into a local pool. Admission happens on the PEER — this transport's
+    `admit` never runs in the front process (proxies own delivery), but
+    the scatter path stays available so a host-side pool can reuse it.
+
+    Carries the wire observability for the whole socket tier: the
+    serializing counters (frames packed, wire bytes, serialize_ms) plus
+    the network section proxies feed — receipts, connects/retries, peer
+    losses, in-flight frames (gauge) and network_ms (send-side wall
+    time per frame), so `transfer_ms` splits into serialize-vs-network
+    in `stats()`/Prometheus."""
+
+    name = "socket"
+
+    def __init__(self):
+        super().__init__()
+        self.net_counters = {
+            "receipts": 0,
+            "connects": 0,
+            "connect_retries": 0,
+            "peer_losses": 0,
+        }
+        self.in_flight_frames = 0  # gauge: admitted, no receipt yet
+        self.network_ms = LatencyHistogram()
+
+    def stats(self) -> dict:
+        out = super().stats()
+        out["network"] = {
+            **self.net_counters,
+            "in_flight_frames": self.in_flight_frames,
+            "network_ms": self.network_ms.summary(),
+        }
+        return out
+
+
+class _RemotePoolStats:
+    """Duck-typed `KVPagePool` stats surface for a remote peer: the
+    front's aggregation (`stats()["kv_pool"]`, drain accounting) reads
+    slots/seq_lens off worker pools — for a proxy those live across the
+    wire, so this shim answers from the proxy's outstanding-flight
+    ledger (exact: one outstanding seq == one bound remote slot)."""
+
+    def __init__(self, proxy: "RemoteDecodeWorker", cfg: PagedConfig):
+        self._proxy = proxy
+        self.cfg = cfg
+        self.scratch_page_count = 0
+
+    @property
+    def active_slot_count(self) -> int:
+        return len(self._proxy._outstanding)
+
+    @property
+    def seq_lens(self) -> np.ndarray:
+        out = np.zeros(self.cfg.max_slots, np.int32)
+        for i, (_fl, n_tok, _t) in enumerate(
+            list(self._proxy._outstanding.values())[: self.cfg.max_slots]
+        ):
+            out[i] = n_tok
+        return out
+
+    def release_scratch(self) -> int:
+        return 0
+
+
+class RemoteDecodeWorker:
+    """The front-side proxy for one decode-host process.
+
+    Duck-types the `DecodeWorker` surface the front schedules against
+    (validate/admit/step/kill/stats/headroom/free_slots/idle), with:
+
+    - ``validate`` checking the handoff against the peer's HANDSHAKE
+      identity — params/catalog/layout/kv_dtype skew is refused typed on
+      the front, before any bytes cross the wire (the host re-validates
+      on receipt as the backstop);
+    - ``admit`` enqueueing the frame to this peer's send thread and
+      returning immediately — the front's runtime thread never blocks
+      on a slow host, and slot accounting is the outstanding-seq ledger;
+    - ``step`` draining receipts on the front's runtime thread (the
+      single-writer discipline: futures resolve where every other
+      worker's do);
+    - reader/sender thread errors marking the proxy ``dead``, which the
+      front's pump reaps exactly like an in-process worker kill.
+    """
+
+    role = "decode"
+    owns_pool = False
+
+    def __init__(self, addr: str, *, transport: SocketTransport, metrics,
+                 counters: dict, flight_recorder, worker_id: str = "",
+                 expected_head: Optional[str] = None,
+                 replica_id: Optional[str] = None,
+                 connect_timeout: float = 5.0,
+                 connect_retries: int = 40,
+                 hello_timeout: float = 600.0,
+                 send_timeout: float = 60.0,
+                 tracer=None, logger: Optional[logging.Logger] = None):
+        self.peer_addr = addr
+        self.transport = transport
+        self.metrics = metrics
+        self._counters = counters  # the FRONT's counter dict (shared)
+        self._flight = flight_recorder
+        self.worker_id = worker_id or f"remote:{addr}"
+        self._expected_head = expected_head
+        self.replica_id = replica_id
+        self._connect_timeout = connect_timeout
+        self._connect_retries = int(connect_retries)
+        self._hello_timeout = hello_timeout
+        self._send_timeout = send_timeout
+        self.tracer = tracer if tracer is not None else NULL_TRACER
+        self._log = logger or logging.getLogger("genrec_tpu")
+        self.dead = False
+        self.draining = False
+        self.identity: Optional[dict] = None
+        self.head = None  # set by the front after the handshake
+        self.params_step: Optional[int] = None
+        self.warmup_compiles = 0
+        self.admitted = 0
+        self._seq = 0
+        # seq -> (flight, n_tokens, t_enqueued): accepted, unresolved.
+        # Runtime-thread writes only (admit/step/kill under the front's
+        # runtime lock); the reader/sender threads never touch it.
+        self._outstanding: dict[int, tuple] = {}
+        self._inbox: queue.Queue = queue.Queue()
+        self._send_q: queue.Queue = queue.Queue()
+        self._sock: Optional[socket_mod.socket] = None
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._peer_stats: dict = {}
+        self._stats_gen = 0
+        self._stats_next = 0.0
+        self.pool: Optional[_RemotePoolStats] = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def warmup(self) -> None:
+        """Connect + handshake (idempotent). The peer compiles its grid
+        before accepting, so connect retries ride out host warmup; the
+        HELLO read then waits on a generous timeout."""
+        if self._sock is not None:
+            return
+        host, _, port = self.peer_addr.rpartition(":")
+        last_err: Optional[Exception] = None
+        for attempt in range(self._connect_retries + 1):
+            try:
+                sock = socket_mod.create_connection(
+                    (host, int(port)), timeout=self._connect_timeout
+                )
+                break
+            except OSError as e:
+                last_err = e
+                self.transport.net_counters["connect_retries"] += 1
+                time.sleep(min(0.25 * (attempt + 1), 2.0))
+        else:
+            raise WorkerLostError(
+                f"decode host {self.peer_addr} unreachable after "
+                f"{self._connect_retries} retries: {last_err}"
+            )
+        self.transport.net_counters["connects"] += 1
+        sock.setsockopt(socket_mod.IPPROTO_TCP, socket_mod.TCP_NODELAY, 1)
+        sock.settimeout(self._hello_timeout)
+        try:
+            ftype, meta, _ = recv_frame(sock)
+        except (OSError, ConnectionError) as e:
+            sock.close()
+            raise WorkerLostError(
+                f"decode host {self.peer_addr} died during handshake: {e}"
+            ) from e
+        if ftype != HELLO:
+            sock.close()
+            raise HandoffRefusedError(
+                f"decode host {self.peer_addr} opened with frame type "
+                f"{ftype}, expected HELLO"
+            )
+        if (self._expected_head is not None
+                and meta.get("head") != self._expected_head):
+            sock.close()
+            raise HandoffRefusedError(
+                f"decode host {self.peer_addr} serves head "
+                f"{meta.get('head')!r}, this pool needs "
+                f"{self._expected_head!r}"
+            )
+        self.identity = meta
+        self.params_step = meta.get("params_step")
+        self.warmup_compiles = int(meta.get("warmup_compiles", 0))
+        self.pool = _RemotePoolStats(self, PagedConfig(
+            max_slots=int(meta["max_slots"]),
+            page_size=int(meta["page_size"]),
+            pages_per_slot=int(meta["pages_per_slot"]),
+            kv_dtype=str(meta.get("kv_dtype", "float32")),
+        ))
+        sock.settimeout(self._send_timeout)
+        self._sock = sock
+        for fn, name in ((self._send_loop, "send"), (self._recv_loop, "recv")):
+            t = threading.Thread(
+                target=fn, name=f"disagg-net-{name}-{self.peer_addr}",
+                daemon=True,
+            )
+            t.start()
+            self._threads.append(t)
+
+    def _ledger(self, operands_only: bool = False) -> None:
+        """The peer budgets its own HBM (DecodeWorker._ledger in its
+        process, refusing at ITS warmup); nothing is resident here."""
+
+    def close(self, timeout: float = 5.0) -> None:
+        """Graceful: ask the peer to drain and exit (and let the send
+        thread actually flush the SHUTDOWN frame), then tear down the
+        threads/socket. Safe to call twice."""
+        if self._sock is not None and not self.dead:
+            self._send_q.put((SHUTDOWN, {}, b"", None))
+            deadline = time.monotonic() + min(timeout, 2.0)
+            while (not self._send_q.empty() and not self.dead
+                   and time.monotonic() < deadline):
+                time.sleep(0.005)
+            # Give the peer's BYE time to land (the recv thread exits on
+            # it) so tearing the socket down never races its last write.
+            for t in self._threads:
+                if "recv" in t.name:
+                    t.join(min(timeout, 5.0))
+        self._shutdown(timeout)
+
+    def _shutdown(self, timeout: float = 2.0) -> None:
+        self._stop.set()
+        self._send_q.put(None)  # unblock the sender
+        for t in self._threads:
+            t.join(timeout)
+        self._threads = []
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    @property
+    def sockets_closed(self) -> bool:
+        return self._sock is None
+
+    # -- scheduling surface (front runtime thread) ---------------------------
+
+    @property
+    def max_slots(self) -> int:
+        return int(self.identity["max_slots"]) if self.identity else 0
+
+    @property
+    def free_slots(self) -> int:
+        if self.dead:
+            return 0
+        return max(self.max_slots - len(self._outstanding), 0)
+
+    @property
+    def idle(self) -> bool:
+        return not self._outstanding
+
+    def occupancy(self) -> float:
+        total = self.max_slots or 1
+        return round(len(self._outstanding) / total, 4)
+
+    def headroom(self) -> float:
+        if self.dead or self.draining:
+            return -1.0
+        return round(self.free_slots / (self.max_slots or 1), 4)
+
+    @property
+    def recompilations(self) -> int:
+        return int(self._peer_stats.get("recompilations", 0))
+
+    @property
+    def decode_steps(self) -> int:
+        return int(self._peer_stats.get("decode_steps", 0))
+
+    def validate(self, handoff: KVHandoff) -> None:
+        """The admission contract, enforced on the SEND side against the
+        peer's handshake identity: skew refuses typed before the frame
+        is built, let alone sent. The host's own `DecodeWorker.validate`
+        re-checks on receipt (REFUSED frame) as the backstop."""
+        ident = self.identity
+        if ident is None or self.dead:
+            raise WorkerLostError(
+                f"decode host {self.peer_addr} is not connected"
+            )
+        if handoff.head != ident["head"]:
+            raise HandoffRefusedError(
+                f"handoff for head {handoff.head!r} routed to remote "
+                f"{ident['head']!r} decode host {self.peer_addr}"
+            )
+        if list(handoff.layout) != list(ident["layout"]):
+            raise HandoffRefusedError(
+                f"handoff KV layout {tuple(handoff.layout)} != decode "
+                f"host {self.peer_addr}'s {tuple(ident['layout'])}"
+            )
+        if handoff.kv_dtype != ident.get("kv_dtype", "float32"):
+            raise HandoffRefusedError(
+                f"handoff KV pages are {handoff.kv_dtype} but decode "
+                f"host {self.peer_addr} stores "
+                f"{ident.get('kv_dtype')!r} — refusing to mix page "
+                "storage dtypes across the wire"
+            )
+        if handoff.params_step != ident.get("params_step"):
+            raise HandoffRefusedError(
+                f"handoff prefilled at params step {handoff.params_step} "
+                f"but decode host {self.peer_addr} serves step "
+                f"{ident.get('params_step')} — refusing to mix params "
+                "versions across the wire"
+            )
+        if handoff.catalog_version != ident.get("catalog_version"):
+            raise HandoffRefusedError(
+                f"handoff catalog {handoff.catalog_version} != decode "
+                f"host {self.peer_addr}'s {ident.get('catalog_version')} "
+                "— refusing to decode against a different corpus"
+            )
+
+    def admit(self, flight: Flight, handoff: KVHandoff) -> bool:
+        """Accept one validated handoff for this peer: ledger the seq,
+        hand the frame to the send thread, return. False when the peer's
+        slots are all spoken for (the handoff stays pending at the
+        front, same as a full local pool)."""
+        if self.dead or self.free_slots == 0:
+            return False
+        wire = handoff.wire
+        if wire is None:
+            raise HandoffRefusedError(
+                "socket transport needs serialized handoffs (no wire "
+                "bytes on this one — was it sent through the in-process "
+                "transport?)"
+            )
+        seq = self._seq
+        self._seq += 1
+        req = flight.req
+        meta = {
+            "seq": seq,
+            "req": {
+                "head": req.head,
+                "history": np.asarray(req.history).tolist(),
+                "user_id": int(req.user_id),
+                "timestamps": (np.asarray(req.timestamps).tolist()
+                               if req.timestamps is not None else None),
+            },
+        }
+        self._outstanding[seq] = (flight, int(handoff.n_tokens),
+                                  time.monotonic())
+        self.transport.in_flight_frames += 1
+        self._send_q.put((HANDOFF, meta, wire, flight.trace))
+        self.transport.release(handoff)  # frame owns the bytes now
+        self.admitted += 1
+        self.metrics.record_admit(1)
+        return True
+
+    def step(self) -> bool:
+        """Drain receipts on the front's runtime thread — RESULTs
+        resolve futures, REFUSEDs fail them typed, STATS refresh the
+        peer snapshot. Also keeps a low-rate STATS_REQ heartbeat going
+        so peer counters stay fresh without a per-request round trip."""
+        progressed = False
+        while True:
+            try:
+                ftype, meta, payload = self._inbox.get_nowait()
+            except queue.Empty:
+                break
+            progressed |= self._dispatch(ftype, meta, payload)
+        now = time.monotonic()
+        if (not self.dead and self._sock is not None
+                and now >= self._stats_next):
+            self._stats_next = now + 0.25
+            self._send_q.put((STATS_REQ, {}, b"", None))
+        return progressed
+
+    def _dispatch(self, ftype: int, meta: dict, payload: bytes) -> bool:
+        if ftype == STATS:
+            self._peer_stats = meta
+            self._stats_gen += 1
+            return False
+        if ftype == BYE:
+            return False
+        if ftype not in (RESULT, REFUSED):
+            self._log.warning(
+                f"disagg: unexpected frame type {ftype} from "
+                f"{self.peer_addr}"
+            )
+            return False
+        ent = self._outstanding.pop(meta.get("seq"), None)
+        if ent is None:
+            return False
+        self.transport.in_flight_frames = max(
+            0, self.transport.in_flight_frames - 1)
+        self.transport.net_counters["receipts"] += 1
+        flight, _n_tok, _t = ent
+        if ftype == REFUSED:
+            err_cls = (HandoffRefusedError
+                       if meta.get("etype") == "HandoffRefusedError"
+                       else ServingError)
+            self._counters["handoffs_refused"] += 1
+            self._flight.record(
+                "handoff_refused", peer=self.peer_addr,
+                worker=self.worker_id, reason=meta.get("error", ""),
+            )
+            if not flight.fut.done():
+                flight.fut.set_exception(err_cls(
+                    f"decode host {self.peer_addr} refused: "
+                    f"{meta.get('error', '')}"
+                ))
+                self.metrics.record_failure(1)
+            return True
+        with np.load(io.BytesIO(payload)) as z:
+            items = np.array(z["items"])
+            scores = np.array(z["scores"])
+            sem_ids = np.array(z["sem_ids"]) if "sem_ids" in z.files else None
+        resp = Response(
+            head=meta["head"], items=items, scores=scores, sem_ids=sem_ids,
+            params_step=meta.get("params_step"),
+            bucket=tuple(meta["bucket"]),
+            queue_wait_s=float(meta.get("queue_wait_s", 0.0)),
+            compute_s=float(meta.get("compute_s", 0.0)),
+            total_s=time.monotonic() - flight.t_enq,
+            catalog_version=meta.get("catalog_version"),
+            request_id=(flight.trace.trace_id
+                        if flight.trace is not None else None),
+            replica_id=self.replica_id,
+            prefill_worker_id=meta.get("prefill_worker_id"),
+            decode_worker_id=meta.get("decode_worker_id", self.worker_id),
+        )
+        if not flight.fut.done():
+            flight.fut.set_result(resp)
+        self.metrics.record_response(
+            resp.queue_wait_s, resp.compute_s, resp.total_s, head=resp.head
+        )
+        self.metrics.record_evict(1)
+        return True
+
+    def refresh_stats(self, timeout: float = 5.0) -> dict:
+        """Round-trip a STATS_REQ (drain/CI path: the final "0 recompiles
+        / pools clean / sockets closed" reads must be FRESH, not the
+        heartbeat's last sample). Caller must be the scheduling thread."""
+        if self.dead or self._sock is None:
+            return dict(self._peer_stats)
+        gen = self._stats_gen
+        self._send_q.put((STATS_REQ, {}, b"", None))
+        deadline = time.monotonic() + timeout
+        while (self._stats_gen == gen and not self.dead
+               and time.monotonic() < deadline):
+            self.step()
+            time.sleep(0.005)
+        return dict(self._peer_stats)
+
+    # -- failure -------------------------------------------------------------
+
+    def _on_peer_lost(self, where: str, err: Exception) -> None:
+        if self.dead:
+            return
+        self.dead = True
+        self.transport.net_counters["peer_losses"] += 1
+        self._flight.record(
+            "disagg_peer_lost", peer=self.peer_addr, worker=self.worker_id,
+            where=where, error=str(err),
+            outstanding=len(self._outstanding),
+        )
+        self._log.warning(
+            f"disagg: decode host {self.peer_addr} lost ({where}: {err}) "
+            f"with {len(self._outstanding)} frames outstanding"
+        )
+
+    def kill(self) -> list[Flight]:
+        """Reap: every accepted-unresolved flight is stranded (its KV
+        lives in the dead process). The front re-submits each typed,
+        at most once — `DecodeWorker.kill`'s contract, across the wire."""
+        self.dead = True
+        stranded = []
+        for seq, (flight, _n, _t) in list(self._outstanding.items()):
+            if not flight.fut.done():
+                stranded.append(flight)
+        self.transport.in_flight_frames = max(
+            0, self.transport.in_flight_frames - len(self._outstanding))
+        self._outstanding.clear()
+        self._shutdown()
+        return stranded
+
+    # -- I/O threads ---------------------------------------------------------
+
+    def _send_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                item = self._send_q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            if item is None:
+                break
+            ftype, meta, payload, trace = item
+            t0 = time.monotonic()
+            try:
+                nbytes = send_frame(self._sock, ftype, meta, payload)
+            except (OSError, ConnectionError) as e:
+                self._on_peer_lost("send", e)
+                break
+            t1 = time.monotonic()
+            if ftype == HANDOFF:
+                self.transport.network_ms.record(t1 - t0)
+                if trace is not None and self.tracer.enabled:
+                    # The network hop as its own critical-path segment
+                    # (scripts/trace_report.py SEGMENT_OF), attributed
+                    # to the peer that received it.
+                    self.tracer.record_span(
+                        "handoff_network", trace.trace_id, t0, t1,
+                        parent_id=trace.parent_span_id, side="send",
+                        peer=self.peer_addr, transfer_bytes=nbytes,
+                        component="disagg_front", worker=self.worker_id,
+                    )
+
+    def _recv_loop(self) -> None:
+        # select-gated: the blocking read only STARTS once bytes exist,
+        # so the socket's timeout bounds per-chunk stalls mid-frame (a
+        # genuine peer hang) without a between-frames idle timeout ever
+        # firing mid-read and desyncing the stream.
+        sock = self._sock
+        while not self._stop.is_set():
+            try:
+                readable, _, _ = select.select([sock], [], [], 0.05)
+            except (OSError, ValueError):
+                break
+            if not readable:
+                continue
+            try:
+                frame = recv_frame(sock)
+            except (OSError, ConnectionError, ValueError) as e:
+                if not self._stop.is_set():
+                    self._on_peer_lost("recv", e)
+                break
+            self._inbox.put(frame)
+            if frame[0] == BYE:
+                break  # graceful close: the EOF behind it is not a loss
+
+    # -- observability -------------------------------------------------------
+
+    def stats(self) -> dict:
+        peer = dict(self._peer_stats)
+        return {
+            "peer_addr": self.peer_addr,
+            "slots_active": len(self._outstanding),
+            "slots_total": self.max_slots,
+            "occupancy": self.occupancy(),
+            "headroom": self.headroom(),
+            "admitted": self.admitted,
+            "decode_steps": self.decode_steps,
+            "in_flight_frames": len(self._outstanding),
+            "warmup_compiles": self.warmup_compiles,
+            "recompilations": self.recompilations,
+            "peer": peer,
+        }
+
+
+# ---------------------------------------------------------------------------
+# The decode-host process
+# ---------------------------------------------------------------------------
+
+
+def _resolve_factory(spec: str):
+    """``module:function`` or ``/path/to/file.py:function`` -> callable.
+    The factory runs in the CHILD process and must rebuild the exact
+    head/params the front serves (same seed/config), so both sides of
+    the wire agree on identity — the handshake and per-handoff
+    validation then PROVE it rather than assume it."""
+    mod_spec, _, fn_name = spec.rpartition(":")
+    if not mod_spec or not fn_name:
+        raise ValueError(f"factory spec {spec!r} is not 'module:function'")
+    if mod_spec.endswith(".py"):
+        import importlib.util
+
+        m_spec = importlib.util.spec_from_file_location(
+            "_genrec_decode_factory", mod_spec)
+        module = importlib.util.module_from_spec(m_spec)
+        m_spec.loader.exec_module(module)
+    else:
+        import importlib
+
+        module = importlib.import_module(mod_spec)
+    return getattr(module, fn_name)
+
+
+class _HostFlights:
+    """The host's in-flight ledger: seq -> Flight, plus the pending
+    deque for handoffs that validated but found no free slot (retried
+    every loop pass — the front's pending semantics, host-side)."""
+
+    def __init__(self):
+        self.flights: dict[int, Flight] = {}
+        self.pending: list[tuple[int, Flight, KVHandoff]] = []
+
+
+def serve_decode_host(factory: str, *, host: str = "127.0.0.1",
+                      port: int = 0, worker_id: str = "remote-d0",
+                      announce=None, idle_timeout: Optional[float] = None,
+                      logger: Optional[logging.Logger] = None) -> dict:
+    """Run one decode worker as a network peer (the child-process
+    entrypoint behind ``python -m genrec_tpu.disagg.net``).
+
+    Binds + announces the port FIRST (``GENREC_DECODE_PORT <n>`` on
+    stdout — `spawn_decode_host` reads it), then builds and warms the
+    real `DecodeWorker` from the factory, then accepts the front's
+    connection; the front's connect/HELLO timeouts ride out warmup.
+    Serves until SHUTDOWN (drain + BYE) or peer disconnect. Returns the
+    final stats dict (useful when called in-process by tests)."""
+    log = logger or logging.getLogger("genrec_tpu")
+    srv = socket_mod.socket(socket_mod.AF_INET, socket_mod.SOCK_STREAM)
+    srv.setsockopt(socket_mod.SOL_SOCKET, socket_mod.SO_REUSEADDR, 1)
+    srv.bind((host, port))
+    srv.listen(1)
+    bound_port = srv.getsockname()[1]
+    import sys
+
+    out = announce if announce is not None else sys.stdout
+    print(f"GENREC_DECODE_PORT {bound_port}", file=out, flush=True)
+
+    cfg = _resolve_factory(factory)()
+    head = cfg["head"]
+    params = cfg["params"]
+    head.on_params(params)
+    mesh = None
+    if cfg.get("mesh_shape"):
+        from genrec_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(dict(cfg["mesh_shape"]))
+    paged: PagedConfig = cfg["paged_config"]
+    n_layers, n_heads, head_dim, dtype = head.paged_layout()
+    pool = KVPagePool(paged, n_layers, n_heads, head_dim, dtype)
+    transport = SerializingTransport()
+    from genrec_tpu.obs.flight_recorder import get_flight_recorder
+
+    worker = DecodeWorker(
+        worker_id, head, params, transport=transport, pool=pool,
+        owns_pool=True, ladder=cfg["ladder"], metrics=ServingMetrics(),
+        flight_recorder=get_flight_recorder().scoped(
+            "decode_host", worker_id=worker_id),
+        params_step=cfg.get("params_step"),
+        hbm_budget_bytes=cfg.get("hbm_budget_bytes"),
+        mesh=mesh, model_axis=cfg.get("model_axis", "model"),
+        logger=log,
+    )
+    worker._ledger(operands_only=True)
+    worker.warmup()
+    from genrec_tpu.disagg.handoff import layout_of
+
+    hello = {
+        "worker_id": worker_id,
+        "head": head.name,
+        "layout": list(layout_of(head)),
+        "kv_dtype": paged.kv_dtype,
+        "params_step": cfg.get("params_step"),
+        "catalog_version": head.catalog_version,
+        "max_slots": paged.max_slots,
+        "page_size": paged.page_size,
+        "pages_per_slot": paged.pages_per_slot,
+        "warmup_compiles": worker.warmup_compiles,
+        "tp_devices": int(mesh.size) if mesh is not None else 1,
+    }
+    srv.settimeout(idle_timeout)
+    try:
+        conn, peer = srv.accept()
+    except socket_mod.timeout:
+        srv.close()
+        raise TimeoutError("no front connected before idle_timeout")
+    conn.setsockopt(socket_mod.IPPROTO_TCP, socket_mod.TCP_NODELAY, 1)
+    conn.settimeout(60.0)  # per-chunk bound once a frame has started
+    send_frame(conn, HELLO, hello)
+    log.info(f"disagg host {worker_id}: serving {head.name} to {peer}")
+
+    ledger = _HostFlights()
+    draining = False
+
+    def _host_stats() -> dict:
+        return _jsonable({
+            **worker.stats(),
+            "pool": {
+                "pages_in_use": pool.allocator.pages_in_use,
+                "pages_free": pool.allocator.pages_free,
+                "slots_active": pool.active_slot_count,
+                "kv_tokens_resident": int(pool.seq_lens.sum()),
+            },
+            "transport": transport.stats(),
+            "pending": len(ledger.pending),
+            "in_flight": len(ledger.flights),
+            "draining": draining,
+        })
+
+    def _try_admit(seq: int, fl: Flight, h: KVHandoff) -> bool:
+        try:
+            worker.validate(h)
+            ok = worker.admit(fl, h)
+        except Exception as e:  # noqa: BLE001 — refuse THIS seq typed
+            transport.release(h)
+            send_frame(conn, REFUSED, {
+                "seq": seq, "error": str(e),
+                "etype": type(e).__name__,
+            })
+            return True
+        if not ok:
+            return False
+        ledger.flights[seq] = fl
+        return True
+
+    final_stats: dict = {}
+    try:
+        while True:
+            busy = bool(ledger.flights or ledger.pending)
+            # select-gated read: never start a blocking frame read on an
+            # idle wire (a poll timeout mid-frame would desync it).
+            readable, _, _ = select.select(
+                [conn], [], [], 0.0005 if busy else 0.05)
+            frame = None
+            if readable:
+                try:
+                    frame = recv_frame(conn)
+                except (OSError, ConnectionError):
+                    log.warning(
+                        f"disagg host {worker_id}: front disconnected")
+                    break
+            if frame is not None:
+                ftype, meta, payload = frame
+                if ftype == HANDOFF:
+                    h, _k, _v = unpack_handoff(payload)
+                    r = meta["req"]
+                    req = Request(
+                        head=r["head"],
+                        history=np.asarray(r["history"], np.int64),
+                        user_id=int(r["user_id"]),
+                        timestamps=(np.asarray(r["timestamps"])
+                                    if r.get("timestamps") is not None
+                                    else None),
+                        trace=h.trace,
+                    )
+                    fl = Flight(req)
+                    if not _try_admit(meta["seq"], fl, h):
+                        ledger.pending.append((meta["seq"], fl, h))
+                elif ftype == STATS_REQ:
+                    send_frame(conn, STATS, _host_stats())
+                elif ftype == SHUTDOWN:
+                    draining = True
+            # Pending handoffs retry as slots free up (front semantics).
+            still = []
+            for seq, fl, h in ledger.pending:
+                if not _try_admit(seq, fl, h):
+                    still.append((seq, fl, h))
+            ledger.pending = still
+            worker.step()
+            # Ship every finished flight's receipt.
+            for seq, fl in list(ledger.flights.items()):
+                if not fl.fut.done():
+                    continue
+                del ledger.flights[seq]
+                exc = fl.fut.exception()
+                if exc is not None:
+                    send_frame(conn, REFUSED, {
+                        "seq": seq, "error": str(exc),
+                        "etype": type(exc).__name__,
+                    })
+                    continue
+                resp = fl.fut.result()
+                buf = io.BytesIO()
+                arrays = {"items": np.asarray(resp.items),
+                          "scores": np.asarray(resp.scores)}
+                if resp.sem_ids is not None:
+                    arrays["sem_ids"] = np.asarray(resp.sem_ids)
+                np.savez(buf, **arrays)
+                send_frame(conn, RESULT, {
+                    "seq": seq,
+                    "head": resp.head,
+                    "params_step": resp.params_step,
+                    "catalog_version": resp.catalog_version,
+                    "bucket": list(resp.bucket),
+                    "queue_wait_s": resp.queue_wait_s,
+                    "compute_s": resp.compute_s,
+                    "prefill_worker_id": resp.prefill_worker_id,
+                    "decode_worker_id": worker_id,
+                }, buf.getvalue())
+            if draining and not ledger.flights and not ledger.pending:
+                pool.release_scratch()
+                final_stats = _host_stats()
+                send_frame(conn, STATS, final_stats)
+                send_frame(conn, BYE, {})
+                break
+    finally:
+        try:
+            conn.close()
+        finally:
+            srv.close()
+    log.info(f"disagg host {worker_id}: drained, exiting")
+    return final_stats
+
+
+def spawn_decode_host(factory: str, *, host: str = "127.0.0.1",
+                      worker_id: str = "remote-d0",
+                      env: Optional[dict] = None,
+                      startup_timeout: float = 120.0):
+    """Launch `serve_decode_host` in a fresh OS process and return
+    ``(Popen, "host:port")`` once the child announces its port. ``env``
+    overlays os.environ — the caller pins JAX_PLATFORMS/XLA_FLAGS there
+    (they must be set before the child imports jax, which is exactly
+    what a fresh process guarantees)."""
+    import os
+    import subprocess
+    import sys
+
+    cfg = {"factory": factory, "host": host, "port": 0,
+           "worker_id": worker_id}
+    full_env = dict(os.environ)
+    full_env.update(env or {})
+    # The child must resolve genrec_tpu the same way the parent did.
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    full_env["PYTHONPATH"] = (
+        repo + os.pathsep + full_env.get("PYTHONPATH", "")
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-c",
+         "import json, sys\n"
+         "from genrec_tpu.disagg.net import serve_decode_host\n"
+         "serve_decode_host(**json.loads(sys.argv[1]))",
+         json.dumps(cfg)],
+        stdout=subprocess.PIPE, env=full_env, text=True, bufsize=1,
+    )
+    deadline = time.monotonic() + startup_timeout
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"decode host {worker_id} exited rc={proc.returncode} "
+                "before announcing its port"
+            )
+        line = proc.stdout.readline()
+        if line.startswith("GENREC_DECODE_PORT "):
+            return proc, f"{host}:{int(line.split()[1])}"
+    proc.kill()
+    raise TimeoutError(
+        f"decode host {worker_id} did not announce a port within "
+        f"{startup_timeout}s"
+    )
+
+
+if __name__ == "__main__":
+    import sys
+
+    serve_decode_host(**json.loads(sys.argv[1]))

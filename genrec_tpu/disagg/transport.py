@@ -73,6 +73,13 @@ class KVTransport:
         this when a worker is killed or scaled in, so a group-lifetime
         transport does not accumulate executables for dead pools."""
 
+    def stats(self) -> dict:
+        """Per-transport wire section for the front's `stats()` snapshot
+        (frames/bytes counters + serialize-vs-network latency splits).
+        The in-process tier moves references, so it has nothing to
+        report."""
+        return {}
+
 
 class InProcessTransport(KVTransport):
     """Zero-copy: every worker pool must be a slot view over ``bank``."""
@@ -128,6 +135,35 @@ class SerializingTransport(KVTransport):
         self._gather: dict[int, object] = {}
         self._scatter: dict[int, object] = {}
         self._pools: dict[int, KVPagePool] = {}
+        # Wire observability (obs/export.py types these by leaf name:
+        # frames_*/wire_bytes are counters, the _ms summaries gauges).
+        from genrec_tpu.serving.metrics import LatencyHistogram
+
+        self.counters = {
+            "frames_sent": 0, "frames_admitted": 0,
+            "frames_refused": 0, "wire_bytes": 0,
+        }
+        self.serialize_ms = LatencyHistogram()
+
+    def stats(self) -> dict:
+        return {**self.counters, "serialize_ms": self.serialize_ms.summary()}
+
+    @staticmethod
+    def _stage_vec(pool, vec):
+        """The page-index vector for a gather/scatter call. A mesh-placed
+        pool (worker ``mesh=`` knob) lowered its executables against
+        NamedSharding operands — hand those a HOST array and let the
+        executable place it; a device-0-committed jnp array would be a
+        sharding mismatch. Single-device pools keep the jnp fast path."""
+        from jax.sharding import NamedSharding
+
+        leaf = pool.k_pools[0]
+        leaf = getattr(leaf, "data", leaf)  # int8 QuantizedKVPool
+        if isinstance(getattr(leaf, "sharding", None), NamedSharding):
+            return vec
+        import jax.numpy as jnp
+
+        return jnp.asarray(vec)
 
     def forget(self, pool) -> None:
         key = id(pool)
@@ -209,14 +245,15 @@ class SerializingTransport(KVTransport):
         on_compile(self._scatter[id(pool)])
 
     def send(self, src_pool, pages, handoff) -> None:
-        import jax.numpy as jnp
+        import time
 
+        t0 = time.monotonic()
         gather = self._gather[id(src_pool)]
         P = src_pool.cfg.pages_per_slot
         vec = np.zeros(P, np.int32)
         vec[: len(pages)] = pages
         k_content, v_content = gather(
-            src_pool.k_pools, src_pool.v_pools, jnp.asarray(vec)
+            src_pool.k_pools, src_pool.v_pools, self._stage_vec(src_pool, vec)
         )
         n = len(pages)
         if src_pool.cfg.kv_dtype == "int8":
@@ -229,10 +266,21 @@ class SerializingTransport(KVTransport):
             v_host = tuple(np.asarray(v)[:n] for v in v_content)
         handoff.wire = pack_handoff(handoff, k_host, v_host)
         handoff.pages = None  # nothing pinned on the sender side
+        self.counters["frames_sent"] += 1
+        self.counters["wire_bytes"] += len(handoff.wire)
+        self.serialize_ms.record(time.monotonic() - t0)
 
     def admit(self, handoff, dst_pool) -> int:
-        import jax.numpy as jnp
+        try:
+            return self._admit(handoff, dst_pool)
+        except HandoffRefusedError:
+            self.counters["frames_refused"] += 1
+            raise
 
+    def _admit(self, handoff, dst_pool) -> int:
+        import time
+
+        t0 = time.monotonic()
         parsed = getattr(handoff, "_parsed", None)
         if parsed is None:
             decoded, k_content, v_content = unpack_handoff(handoff.wire)
@@ -276,6 +324,15 @@ class SerializingTransport(KVTransport):
             vec[:n] = pages
 
             def _padded(content):
+                if n == P:
+                    # The run already fills the compiled (P,) rung — the
+                    # scatter's only shape. Re-padding here was a full
+                    # host copy of every page row per handoff on the max
+                    # rung (the common case under long-history load);
+                    # skipping it changes no executable (pinned by the
+                    # full-rung recompilation check in
+                    # tests/test_crosshost.py).
+                    return content
                 if quantized:
                     pad_d = ((0, P - n),) + ((0, 0),) * (content[0][0].ndim - 1)
                     pad_s = ((0, P - n), (0, 0))
@@ -286,11 +343,15 @@ class SerializingTransport(KVTransport):
 
             scatter = self._scatter[id(dst_pool)]
             k_pools, v_pools = scatter(
-                dst_pool.k_pools, dst_pool.v_pools, jnp.asarray(vec),
+                dst_pool.k_pools, dst_pool.v_pools,
+                self._stage_vec(dst_pool, vec),
                 _padded(k_content), _padded(v_content),
             )
             dst_pool.k_pools, dst_pool.v_pools = k_pools, v_pools
-            return dst_pool.bind_pages(pages, handoff.n_tokens)
+            slot = dst_pool.bind_pages(pages, handoff.n_tokens)
+            self.counters["frames_admitted"] += 1
+            self.serialize_ms.record(time.monotonic() - t0)
+            return slot
         except Exception:
             dst_pool.allocator.free(pages)
             raise

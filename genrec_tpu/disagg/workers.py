@@ -62,6 +62,47 @@ from genrec_tpu.serving.kv_pool import (
 from genrec_tpu.serving.types import HBMBudgetError, Response
 
 
+def _stage(tree, mesh):
+    """Per-call operands (batch arrays, slot state, block tables) on
+    their way into a compiled executable. Single device: device arrays,
+    as always. Under a mesh: HOST arrays — the mesh-lowered executable
+    places them to its expected (replicated) sharding at dispatch,
+    whereas a device-0-committed jnp array would be rejected as a
+    sharding mismatch (the engine's ``ServingEngine._stage``, shared by
+    both role workers)."""
+    import jax
+    import jax.numpy as jnp
+
+    f = np.asarray if mesh is not None else jnp.asarray
+    return jax.tree_util.tree_map(f, tree)
+
+
+def _place_worker(worker, mesh, model_axis: str) -> None:
+    """The DecodeWorker/PrefillWorker ``mesh=`` knob: shard params by
+    ``serve_rules`` (row-sharded retrieval item table incl. the int8
+    QuantizedTable operand, vocab-sharded TIGER output head), commit the
+    head's runtime operands, and — when this worker OWNS its pool
+    (serializing/socket tiers) — shard the KV page bank over the head
+    axis. A shared in-process bank is the front's to place, not one
+    view's. Runs before warmup so aot.sds_tree carries every
+    NamedSharding into the lowerings."""
+    from genrec_tpu.parallel.shardings import (
+        kv_pool_sharding,
+        serve_rules,
+        shard_params,
+    )
+
+    worker.params = shard_params(
+        mesh, worker.params, serve_rules(model_axis), log_fn=worker._log.info
+    )
+    worker.head.place_operands(mesh, model_axis)
+    if worker.owns_pool:
+        n_heads = layout_of(worker.head)[1]
+        place = kv_pool_sharding(mesh, n_heads, model_axis)
+        if place is not None:
+            worker.pool.place(place)
+
+
 class Flight:
     """One accepted request moving through the role pipeline."""
 
@@ -100,6 +141,7 @@ class PrefillWorker:
                  prefix_cache_entries: int = 4096,
                  hbm_budget_bytes: Optional[int] = None,
                  tracer=None,
+                 mesh=None, model_axis: str = "model",
                  logger: Optional[logging.Logger] = None):
         self.worker_id = worker_id
         self.head = head
@@ -115,6 +157,10 @@ class PrefillWorker:
         self.params_step = params_step
         self.tracer = tracer if tracer is not None else NULL_TRACER
         self._log = logger or logging.getLogger("genrec_tpu")
+        self._mesh = mesh
+        self._model_axis = str(model_axis)
+        if mesh is not None:
+            _place_worker(self, mesh, self._model_axis)
         # Guarded by the FRONT's lock: submit threads append, the front
         # runtime thread pops.
         self.queue: collections.deque = collections.deque()
@@ -170,7 +216,7 @@ class PrefillWorker:
         args = (
             self.params,
             *(_sds(op) for op in ops),
-            *batch,
+            *(_sds(b) for b in batch),  # aval-only: never pins a device
             jax.ShapeDtypeStruct((B, self.pool.cfg.pages_per_slot), np.int32),
             _sds(self.pool.k_pools),
             _sds(self.pool.v_pools),
@@ -401,8 +447,6 @@ class PrefillWorker:
 
     def _prefill_cold(self, cold, lock,
                       t_pop: float) -> list[tuple[Flight, KVHandoff]]:
-        import jax.numpy as jnp
-
         head = self.head
         t_alloc0 = time.monotonic()
         runs, admitted = [], []
@@ -438,10 +482,10 @@ class PrefillWorker:
             bt[i, : len(run)] = run
         t_run0 = time.monotonic()
         try:
-            args = head.make_batch(reqs, B, L)
+            args = _stage(head.make_batch(reqs, B, L), self._mesh)
             k_pools, v_pools, init = compiled(
                 self.params, *head.runtime_operands(), *args,
-                jnp.asarray(bt), self.pool.k_pools, self.pool.v_pools,
+                _stage(bt, self._mesh), self.pool.k_pools, self.pool.v_pools,
             )
             self.pool.k_pools, self.pool.v_pools = k_pools, v_pools
         except Exception as e:  # noqa: BLE001 — fail THESE futures only
@@ -557,6 +601,7 @@ class DecodeWorker:
                  hbm_budget_bytes: Optional[int] = None,
                  spec_topology=None, spec_fanout=8,
                  tracer=None,
+                 mesh=None, model_axis: str = "model",
                  logger: Optional[logging.Logger] = None):
         self.worker_id = worker_id
         self.head = head
@@ -571,6 +616,10 @@ class DecodeWorker:
         self.replica_id = replica_id
         self.tracer = tracer if tracer is not None else NULL_TRACER
         self._log = logger or logging.getLogger("genrec_tpu")
+        self._mesh = mesh
+        self._model_axis = str(model_axis)
+        if mesh is not None:
+            _place_worker(self, mesh, self._model_axis)
         cfg = pool.cfg
         self.spec_topology = spec_topology
         self.spec_fanout = spec_fanout
@@ -864,20 +913,19 @@ class DecodeWorker:
         fixed-shape step, per worker)."""
         if self.idle:
             return False
-        import jax.numpy as jnp
-
         spec = self.spec_topology is not None
         hi = int(np.nonzero(self.active)[0][-1]) + 1
         S = next(s for s in self.slot_shapes if s >= hi)
         t_stage = time.monotonic()
+        mesh = self._mesh
         args = (
             self.params,
             *self.head.runtime_operands(),
-            {k: jnp.asarray(v[:S]) for k, v in self.state.items()},
-            jnp.asarray(np.where(self.active[:S], self.steps[:S], 0)
-                        .astype(np.int32)),
-            jnp.asarray(self.pool.block_tables[:S]),
-            jnp.asarray(self.pool.seq_lens[:S]),
+            _stage({k: v[:S] for k, v in self.state.items()}, mesh),
+            _stage(np.where(self.active[:S], self.steps[:S], 0)
+                   .astype(np.int32), mesh),
+            _stage(self.pool.block_tables[:S], mesh),
+            _stage(self.pool.seq_lens[:S], mesh),
             self.pool.k_pools,
             self.pool.v_pools,
         )

@@ -42,6 +42,11 @@ _COUNTER_LEAVES = frozenset({
     "handoffs_sent", "handoffs_admitted", "handoffs_refused",
     "handoffs_resubmitted", "transfer_bytes", "decode_worker_deaths",
     "prefill_worker_deaths", "prefills", "deferred", "admitted",
+    # Per-transport wire totals (disagg/net.py socket backend + the
+    # serializing tier's stats() section); in_flight_frames and the
+    # serialize_ms/network_ms percentile leaves stay gauges.
+    "frames_sent", "frames_admitted", "frames_refused", "wire_bytes",
+    "receipts", "connects", "connect_retries", "peer_losses",
     # Speculative tree decode (genrec_spec_<head>_*): invocation/drafted/
     # accepted/slot-step totals; codes_per_invocation stays a gauge.
     "spec_steps", "drafted", "accepted", "slot_steps",

@@ -69,6 +69,54 @@ def retrieval_rules(model_axis: str = "model") -> Sequence[Rule]:
     return ((lambda p: "item_embedding" in p, 0, model_axis),)
 
 
+def serve_rules(model_axis: str = "model") -> Sequence[Rule]:
+    """Tensor-parallel SERVING operands (the ServingEngine/DecodeWorker
+    ``mesh=`` knob): everything fat a serving host holds resident.
+
+    - the retrieval item table, by rows (``retrieval_rules`` — the
+      substring match also places the int8 ``QuantizedTable`` runtime
+      operand's two leaves, so ``item_topk``'s shard_map two-stage top-k
+      reads its slice in place);
+    - TIGER's flat vocab output head and sem-id embedding rows, the two
+      generative-serving params that grow with the catalog.
+
+    Attention/FFN kernels stay replicated: serving shards the KV page
+    BANK over its head axis instead (``kv_pool_sharding``), which is
+    where paged-decode memory actually lives. Unmatched leaves replicate
+    over the whole mesh (``param_specs`` fallback), so one rule set
+    serves mixed retrieval+generative heads."""
+    return (
+        *retrieval_rules(model_axis),
+        (lambda p: "output_head" in p and p.endswith("kernel"), 1, model_axis),
+        (lambda p: "sem_id_embedding" in p, 0, model_axis),
+    )
+
+
+def kv_pool_sharding(mesh: Mesh, n_heads: int, model_axis: str = "model"):
+    """Per-leaf placement for a KV page bank's pools: (num_pages,
+    page_size, n_heads, head_dim) leaves shard the HEAD axis (dim 2)
+    over ``model_axis`` — paged attention is embarrassingly parallel
+    across heads, so the bank splits n-fold with zero cross-device
+    traffic inside the attention read — and every other leaf (int8
+    per-row scale planes, which span heads) replicates.
+
+    Returns None when the mesh cannot shard the head axis (no such axis,
+    degree 1, or non-divisible n_heads): the caller keeps the pool
+    unsharded rather than silently replicating a "sharded" bank."""
+    if model_axis not in mesh.shape:
+        return None
+    n = mesh.shape[model_axis]
+    if n <= 1 or n_heads % n != 0:
+        return None
+
+    def place(leaf):
+        if getattr(leaf, "ndim", 0) == 4 and leaf.shape[2] == n_heads:
+            return NamedSharding(mesh, P(None, None, model_axis, None))
+        return NamedSharding(mesh, P())
+
+    return place
+
+
 def _score_items(h, emb):
     """fp32 (B, V) scores of last-hiddens against a table (or shard).
 

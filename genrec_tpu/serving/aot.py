@@ -21,10 +21,24 @@ def donate_argnums(*argnums):
 
 
 def sds_tree(tree):
-    """Pytree -> ShapeDtypeStructs for AOT lowering without live buffers."""
+    """Pytree -> ShapeDtypeStructs for AOT lowering without live buffers.
+
+    Leaves already committed to a mesh (`NamedSharding` — the
+    ServingEngine/DecodeWorker ``mesh=`` knob places params, quantized
+    tables, and KV page banks this way) keep their sharding on the
+    struct, so the lowered executable expects exactly the placement the
+    live operand has. Host numpy / single-device leaves lower unplaced,
+    as before — nothing changes for a meshless engine."""
     import jax
     import jax.numpy as jnp
+    from jax.sharding import NamedSharding
 
-    return jax.tree_util.tree_map(
-        lambda x: jax.ShapeDtypeStruct(jnp.shape(x), jnp.result_type(x)), tree
-    )
+    def cvt(x):
+        sharding = getattr(x, "sharding", None)
+        if isinstance(sharding, NamedSharding):
+            return jax.ShapeDtypeStruct(
+                jnp.shape(x), jnp.result_type(x), sharding=sharding
+            )
+        return jax.ShapeDtypeStruct(jnp.shape(x), jnp.result_type(x))
+
+    return jax.tree_util.tree_map(cvt, tree)

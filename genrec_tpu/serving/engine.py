@@ -219,6 +219,21 @@ class _PagedRunner:
         self.cfg = cfg
         n_layers, n_heads, head_dim, dtype = head.paged_layout()
         self.pool = KVPagePool(cfg, n_layers, n_heads, head_dim, dtype)
+        if engine._mesh is not None:
+            from genrec_tpu.parallel.shardings import kv_pool_sharding
+
+            # Shard the KV page BANK over the head axis: paged attention
+            # is independent per head, so the pools (the biggest serving
+            # operand after the item table) split n-fold with no
+            # cross-device traffic inside the attention read. Placement
+            # rides into the AOT lowering via aot.sds_tree; a mesh that
+            # cannot shard n_heads keeps the pool replicated (and
+            # kv_pool_sharding returns None rather than pretending).
+            place = kv_pool_sharding(
+                engine._mesh, n_heads, engine._model_axis
+            )
+            if place is not None:
+                self.pool.place(place)
         self._scratch_tables = self.pool.reserve_scratch(self._scratch_demand)
         self.state = head.paged_state_zeros(cfg.max_slots)
         self.steps = np.zeros(cfg.max_slots, np.int32)
@@ -351,7 +366,7 @@ class _PagedRunner:
         args = (
             eng._select(self.head, eng._params),
             *(_sds(op) for op in ops),
-            *batch,
+            *(_sds(b) for b in batch),  # aval-only: never pins a device
             jax.ShapeDtypeStruct((B, self.cfg.pages_per_slot), np.int32),
             _sds(self.pool.k_pools),
             _sds(self.pool.v_pools),
@@ -658,12 +673,12 @@ class _PagedRunner:
         compiled = self._prefill.get((B, L))
         if compiled is None:  # off-grid (should not happen): counted
             compiled = self._prefill[(B, L)] = self._compile_prefill(B, L)
-        args = head.make_batch(reqs, B, L)
+        args = eng._stage(head.make_batch(reqs, B, L))
         bt = np.zeros((B, self.cfg.pages_per_slot), np.int32)
         bt[: len(slots)] = self.pool.block_tables[slots]
         k_pools, v_pools, init = compiled(
             eng._select(head, eng._params), *head.runtime_operands(), *args,
-            jnp.asarray(bt), self.pool.k_pools, self.pool.v_pools,
+            eng._stage(bt), self.pool.k_pools, self.pool.v_pools,
         )
         self.pool.k_pools, self.pool.v_pools = k_pools, v_pools
         n = len(slots)
@@ -741,10 +756,10 @@ class _PagedRunner:
         args = (
             eng._select(self.head, eng._params),
             *self.head.runtime_operands(),
-            {k: jnp.asarray(v[:S]) for k, v in self.state.items()},
-            jnp.asarray(np.where(self.active[:S], self.steps[:S], 0).astype(np.int32)),
-            jnp.asarray(self.pool.block_tables[:S]),
-            jnp.asarray(self.pool.seq_lens[:S]),
+            eng._stage({k: v[:S] for k, v in self.state.items()}),
+            eng._stage(np.where(self.active[:S], self.steps[:S], 0).astype(np.int32)),
+            eng._stage(self.pool.block_tables[:S]),
+            eng._stage(self.pool.seq_lens[:S]),
             self.pool.k_pools,
             self.pool.v_pools,
         )
@@ -938,11 +953,25 @@ class ServingEngine:
         slo_targets=None,
         slo_poll_secs: float = 0.05,
         replica_id: Optional[str] = None,
+        mesh=None,
+        model_axis: str = "model",
     ):
         # Replica identity (fleet deployments, genrec_tpu/fleet/): stamped
         # into every Response (`Response.replica_id` provenance) and the
         # lifecycle flight events. None for a standalone engine.
         self.replica_id = replica_id
+        # Tensor-parallel serving operands (docs/SERVING.md "Cross-host
+        # serving"): with a mesh, start() commits params through
+        # parallel.shardings.serve_rules (retrieval item tables + the
+        # TIGER vocab head row-sharded over ``model_axis``, everything
+        # else replicated), each head places its runtime operands
+        # (quantized table sharded, catalog trie replicated), and every
+        # paged runner's KV page bank shards its HEAD axis. The AOT
+        # lowering carries those placements (aot.sds_tree), so the
+        # compile discipline is unchanged — same executable count, now
+        # partitioned by GSPMD.
+        self._mesh = mesh
+        self._model_axis = str(model_axis)
         self._heads = {h.name: h for h in heads}
         if len(self._heads) != len(heads):
             raise ValueError("duplicate head names")
@@ -1090,6 +1119,15 @@ class ServingEngine:
         install the signal guard. Returns self."""
         if self._started:
             raise RuntimeError("engine already started")
+        if self._mesh is not None:
+            from genrec_tpu.parallel.shardings import serve_rules, shard_params
+
+            self._params = shard_params(
+                self._mesh, self._params, serve_rules(self._model_axis),
+                log_fn=self._log.info,
+            )
+            for head in self._heads.values():
+                head.place_operands(self._mesh, self._model_axis)
         for head in self._heads.values():
             head.on_params(self._select(head, self._params))
         if self._paged:
@@ -1567,7 +1605,7 @@ class ServingEngine:
         B = self._ladder.batch_bucket(len(reqs))
         cat_version = head.catalog_version  # stable: swaps apply on this thread
         try:
-            args = head.make_batch(reqs, B, L)
+            args = self._stage(head.make_batch(reqs, B, L))
             compiled = self._get_executable(head, B, L)
             out = compiled(
                 self._select(head, self._params), *head.runtime_operands(), *args
@@ -1635,6 +1673,17 @@ class ServingEngine:
     def _select(self, head, params):
         return params[head.name] if self._params_by_head else params
 
+    def _stage(self, tree):
+        """Per-call operands (batch arrays, slot state, step vectors) on
+        their way into a compiled executable. Single device: device
+        arrays, as always. Under a mesh: HOST arrays — the executable
+        places them to its expected (replicated) sharding at dispatch,
+        whereas a device-0-committed jnp array would be rejected as a
+        sharding mismatch by the mesh-lowered executable."""
+        if self._mesh is None:
+            return jax.tree_util.tree_map(jnp.asarray, tree)
+        return jax.tree_util.tree_map(np.asarray, tree)
+
     def _get_executable(self, head, B: int, L: int):
         key = (head.name, B, L)
         compiled = self._exec.get(key)
@@ -1655,7 +1704,8 @@ class ServingEngine:
         ops = operands if operands is not None else head.runtime_operands()
         args = head.make_batch([head.dummy_request()], B, L)
         compiled = jax.jit(fn).lower(
-            self._select(head, self._params), *(_sds(op) for op in ops), *args
+            self._select(head, self._params), *(_sds(op) for op in ops),
+            *(_sds(a) for a in args),  # aval-only: never pins a device
         ).compile()
         if install:
             self._exec[(head.name, B, L)] = compiled

@@ -106,6 +106,33 @@ class Head:
     def on_params(self, params) -> None:  # derived-table refresh hook
         del params
 
+    #: Mesh the serving runtime committed this head's operands to (the
+    #: ServingEngine/DecodeWorker ``mesh=`` knob) — remembered so catalog
+    #: swaps and hot reloads re-place the refreshed operand.
+    _serve_mesh = None
+    _serve_model_axis = "model"
+
+    def place_operands(self, mesh, model_axis: str = "model") -> None:
+        """Commit runtime operands to ``mesh``: catalog tries REPLICATE
+        (every device needs the full constraint set — the trie is tiny
+        next to the tables that actually shard), RetrievalHead row-shards
+        its quantized scoring table. Mesh-lowered executables require
+        committed operands (aot.sds_tree carries NamedSharding into the
+        lowering), so this runs before warmup compiles anything."""
+        self._serve_mesh = mesh
+        self._serve_model_axis = model_axis
+        self._place_trie()
+
+    def _place_trie(self) -> None:
+        trie = getattr(self, "trie", None)
+        if trie is None or self._serve_mesh is None:
+            return
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        self.trie = jax.device_put(
+            trie, NamedSharding(self._serve_mesh, PartitionSpec())
+        )
+
     def runtime_operands(self) -> tuple:
         """Device-side catalog operands threaded between ``params`` and
         the batch in EVERY compiled call — runtime arguments, never
@@ -272,6 +299,7 @@ class TigerGenerativeHead(Head):
         self.catalog = snapshot
         self.item_sem_ids = snapshot.item_sem_ids
         self.trie = snapshot.device_trie()
+        self._place_trie()  # keep the operand on the serving mesh
         self._lookup = _CorpusLookup(snapshot)
 
     @property
@@ -525,6 +553,7 @@ class CobraGenerativeHead(Head):
         self.catalog = snapshot
         self.item_sem_ids = snapshot.item_sem_ids
         self.trie = snapshot.device_trie()
+        self._place_trie()  # keep the operand on the serving mesh
         self._lookup = _CorpusLookup(snapshot)
         if snapshot.item_vecs is not None:
             # Snapshot-held tower: reused as-is until the NEXT catalog
@@ -784,6 +813,36 @@ class RetrievalHead(Head):
             from genrec_tpu.models.embeddings import quantize_item_table
 
             self._qtable = quantize_item_table(params["item_embedding"])
+            self._place_qtable()
+
+    def place_operands(self, mesh, model_axis: str = "model") -> None:
+        """Engine/worker mesh knob: adopt the mesh for ``item_topk``'s
+        shard_map (when the head wasn't constructed with one) and
+        row-shard the quantized table — both int8 data rows and their
+        fp32 scales split dim 0 over the model axis, the PR 16 2-leaf
+        operand landing sharded in place."""
+        super().place_operands(mesh, model_axis)
+        if self.mesh is None:
+            self.mesh = mesh
+            self.model_axis = model_axis
+        self._place_qtable()
+
+    def _place_qtable(self) -> None:
+        mesh = self._serve_mesh
+        if mesh is None or self._qtable is None:
+            return
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        axis = self._serve_model_axis
+        qt = self._qtable
+        n = mesh.shape.get(axis, 1)
+        if n > 1 and qt.data.shape[0] % n == 0:
+            spec = type(qt)(P(axis, None), P(axis))
+        else:  # non-divisible vocab: replicate, same as param_specs
+            spec = type(qt)(P(), P())
+        self._qtable = jax.device_put(
+            qt, jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s), spec)
+        )
 
     def runtime_operands(self) -> tuple:
         if not self.quantized:

@@ -287,6 +287,22 @@ class KVPagePool:
         else:
             self._v_pools = value
 
+    def place(self, sharding_of) -> None:
+        """Commit the page pools through ``sharding_of(leaf) -> Sharding``
+        (parallel.shardings.kv_pool_sharding: the head axis shards over
+        the serving mesh, int8 scale planes replicate). Owner pools only
+        — a slot view reads its bank's arrays, so the bank is what gets
+        placed. Runs BEFORE warmup: aot.sds_tree carries the resulting
+        NamedSharding into every prefill/decode/scatter lowering."""
+        if self._bank is not None:
+            self._bank.place(sharding_of)
+            return
+        import jax
+
+        put = lambda x: jax.device_put(x, sharding_of(x))  # noqa: E731
+        self._k_pools = jax.tree_util.tree_map(put, self._k_pools)
+        self._v_pools = jax.tree_util.tree_map(put, self._v_pools)
+
     @property
     def free_slot_count(self) -> int:
         return len(self._free_slots)

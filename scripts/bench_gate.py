@@ -103,6 +103,14 @@ GATED_METRICS: dict[str, tuple[str, float]] = {
     "serve/disagg/handoff_p50_ms": ("lower", 60.0),
     "serve/disagg/wire_bytes_per_handoff": ("lower", 15.0),
     "serve/disagg/qps_vs_colocated": ("higher", 40.0),
+    # Cross-host serving (PR 17): the socket tier's send->admit p50 with
+    # the decode pool in another OS process (loopback kernel socket + a
+    # second Python runtime on a shared CPU host: wide band), and the
+    # socket front's qps against the co-located engine at parity traffic
+    # (same-backend ratio — what the process/socket hop costs on one
+    # machine, the number that must hold when the peer is a real host).
+    "serve/crosshost/handoff_p50_ms": ("lower", 60.0),
+    "serve/crosshost/qps_vs_colocated": ("higher", 40.0),
     # Speculative tree decode (PR 14): codes committed per target-model
     # invocation is structural (drafter acceptance on the seeded trace —
     # tight band; the >2x acceptance bar lives in the committed

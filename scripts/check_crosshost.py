@@ -1,0 +1,268 @@
+"""Cross-host serving check (built on the shared graftlint harness,
+genrec_tpu/analysis/ir.py — CLI, verdict JSON and rc conventions
+unchanged): does the socket tier really hold the serving discipline
+when the decode pool is another OS process?
+
+One scenario, end to end: a 1-prefill front serves TIGER through ONE
+decode-host process spawned over the loopback socket transport
+(`spawn_decode_host`), against the same mixed warm/cold churn the
+disagg check pins — Zipfian-ish repeat users whose replays land warm
+off the prefill prefix cache, interleaved with fresh cold histories.
+Asserts:
+
+- **zero steady-state recompiles on BOTH sides of the wire** — the
+  front's grid AND the decode host's (its counter read across the
+  socket via a fresh STATS round-trip);
+- **bit-identical answers vs a co-located engine** — sem_ids/items
+  equal, scores <= 1e-5, for every request, with the response carrying
+  the remote worker's id;
+- **warm handoffs really crossed the wire** (replays >= hits > 0) and
+  every handoff sent was admitted (none refused, none lost, receipts
+  match);
+- **both pools clean after drain** — the prefill staging pool here and
+  the decode host's pool in ITS final stats — and the **socket closed**
+  with the child exiting rc 0.
+
+Run:  python scripts/check_crosshost.py             (default shapes)
+      python scripts/check_crosshost.py --small     (CI-speed shapes)
+Appends a verdict line to docs/PERF.md when --write-note is passed.
+Prints ONE JSON verdict line on stdout; rc 0 ok / 1 failed.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from genrec_tpu.analysis import ir  # noqa: E402
+
+
+def _shapes(small: bool):
+    if small:
+        return dict(
+            n_corpus=50,
+            arch=dict(embedding_dim=16, attn_dim=32, dropout=0.0,
+                      num_heads=4, n_layers=2, num_item_embeddings=8,
+                      num_user_embeddings=20, sem_id_dim=3),
+            ladder_args=((1, 2), (8,)), max_batch=2,
+            n_requests=14, n_users=5,
+        )
+    return dict(
+        n_corpus=1000,
+        arch=dict(embedding_dim=64, attn_dim=128, dropout=0.0, num_heads=4,
+                  n_layers=4, num_item_embeddings=64,
+                  num_user_embeddings=10_000, sem_id_dim=3),
+        ladder_args=((1, 4), (8, 16)), max_batch=4,
+        n_requests=64, n_users=12,
+    )
+
+
+def _build(small: bool):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from genrec_tpu.models.tiger import Tiger
+    from genrec_tpu.serving import BucketLadder, PagedConfig
+
+    s = _shapes(small)
+    D = s["arch"]["sem_id_dim"]
+    Kcb = s["arch"]["num_item_embeddings"]
+    ladder = BucketLadder(*s["ladder_args"])
+    max_hist = ladder.history_buckets[-1]
+    model = Tiger(**s["arch"])
+    rng = np.random.default_rng(0)
+    valid_ids = np.unique(rng.integers(0, Kcb, (s["n_corpus"], D)), axis=0)
+    B0, L0 = 2, 2 * D
+    params = model.init(
+        jax.random.key(0),
+        jnp.zeros((B0,), jnp.int32), jnp.zeros((B0, L0), jnp.int32),
+        jnp.zeros((B0, L0), jnp.int32), jnp.zeros((B0, D), jnp.int32),
+        jnp.zeros((B0, D), jnp.int32), jnp.ones((B0, L0), jnp.int32),
+    )["params"]
+    n_tok = 1 + max_hist * D
+    cfg = PagedConfig(max_slots=s["max_batch"], page_size=8,
+                      pages_per_slot=-(-n_tok // 8))
+    return model, valid_ids, params, ladder, cfg, s
+
+
+def make_decode_cfg():
+    """Decode-host factory (runs in the CHILD process; shape choice and
+    platform arrive via GENREC_CROSSHOST_* env vars the parent sets)."""
+    from genrec_tpu.serving.heads import TigerGenerativeHead
+
+    small = os.environ.get("GENREC_CROSSHOST_SMALL") == "1"
+    model, valid_ids, params, ladder, cfg, _ = _build(small)
+    return {
+        "head": TigerGenerativeHead(model, valid_ids, top_k=5),
+        "params": params,
+        "ladder": ladder,
+        "paged_config": cfg,
+        "params_step": 1,
+    }
+
+
+def main(argv=None):
+    args = ir.check_args(argv)
+
+    import jax
+
+    if args.platform:
+        from genrec_tpu.parallel.mesh import pin_platform
+
+        pin_platform(args.platform)
+
+    import numpy as np
+
+    from genrec_tpu.disagg import DisaggFront, spawn_decode_host
+    from genrec_tpu.serving import Request, ServingEngine
+    from genrec_tpu.serving.heads import TigerGenerativeHead
+
+    backend = jax.default_backend()
+    model, valid_ids, params, ladder, cfg, s = _build(args.small)
+    max_hist = ladder.history_buckets[-1]
+
+    child_env = {"GENREC_CROSSHOST_SMALL": "1" if args.small else "0"}
+    if backend == "cpu":
+        child_env["JAX_PLATFORMS"] = "cpu"
+    proc, addr = spawn_decode_host(
+        f"{os.path.abspath(__file__)}:make_decode_cfg",
+        worker_id="remote-d0", env=child_env, startup_timeout=600.0,
+    )
+
+    front = DisaggFront(
+        [TigerGenerativeHead(model, valid_ids, top_k=5)], params,
+        ladder=ladder, max_batch=s["max_batch"], max_wait_ms=2.0,
+        n_prefill=1, transport="socket", workers=[addr],
+        paged_config=cfg, params_step=1,
+    ).start()
+    engine = ServingEngine(
+        [TigerGenerativeHead(model, valid_ids, top_k=5)], params,
+        ladder=ladder, max_batch=s["max_batch"], max_wait_ms=2.0,
+        handle_signals=False, paged_config=cfg, params_step=1,
+    ).start()
+
+    # Mixed-traffic churn, deterministic (the disagg check's trace).
+    rng = np.random.default_rng(0)
+    histories: dict[int, np.ndarray] = {}
+    reqs = []
+    replays = 0
+    for _ in range(s["n_requests"]):
+        user = int(rng.integers(0, s["n_users"]))
+        if user in histories and rng.random() < 0.6:
+            replays += 1
+        else:
+            histories[user] = rng.integers(
+                0, len(valid_ids), int(rng.integers(1, max_hist + 1)))
+        reqs.append(Request(head="tiger", history=histories[user],
+                            user_id=user))
+
+    futs = [front.submit(r) for r in reqs]
+    resps, failed = [], 0
+    for f in futs:
+        try:
+            resps.append(f.result(600))
+        except Exception:  # noqa: BLE001 — counted in the verdict
+            resps.append(None)
+            failed += 1
+
+    parity_ok = True
+    for r, resp in zip(reqs, resps):
+        if resp is None:
+            parity_ok = False
+            continue
+        ref = engine.serve(r, timeout=600)
+        parity_ok = parity_ok and bool(
+            np.array_equal(resp.sem_ids, ref.sem_ids)
+            and np.array_equal(resp.items, ref.items)
+            and np.allclose(resp.scores, ref.scores, atol=1e-5)
+            and resp.prefill_worker_id == "tiger:p0"
+            and resp.decode_worker_id == "remote-d0"
+        )
+
+    group = front._groups["tiger"]
+    prefill_pool = group.prefill[0].pool
+    (dw,) = group.decode
+    # Fresh peer stats ACROSS the wire before drain tears it down.
+    peer = dw.refresh_stats(timeout=30.0)
+    final = front.stop()
+    engine.stop()
+    child_rc = proc.wait(60)
+
+    d = final["disagg"]
+    pc = final["prefix_cache"]["tiger"]
+    net = d.get("transports", {}).get("socket", {}).get("network", {})
+    prefill_pages = prefill_pool.allocator.pages_in_use
+    peer_pool = peer.get("pool", {})
+
+    verdict = {
+        "backend": backend,
+        "submitted": len(reqs),
+        "completed": final["completed"],
+        "failed": failed,
+        "replays": replays,
+        "warm_hits": pc["hits"],
+        "handoffs_sent": d["handoffs_sent"],
+        "handoffs_admitted": d["handoffs_admitted"],
+        "handoffs_refused": d["handoffs_refused"],
+        "receipts": net.get("receipts", 0),
+        "peer_losses": net.get("peer_losses", 0),
+        "wire_bytes": d["transfer_bytes"],
+        "recompilations_front": final["recompilations"],
+        "recompilations_peer": peer.get("recompilations", -1),
+        "prefill_pages_final": prefill_pages,
+        "peer_pages_final": peer_pool.get("pages_in_use", -1),
+        "peer_slots_final": peer_pool.get("slots_active", -1),
+        "sockets_closed": dw.sockets_closed,
+        "child_rc": child_rc,
+        "parity_ok": parity_ok,
+        "ok": False,
+    }
+    ok = (
+        failed == 0
+        and final["completed"] == len(reqs)
+        and parity_ok
+        and final["recompilations"] == 0
+        and peer.get("recompilations", -1) == 0
+        and d["handoffs_sent"] == d["handoffs_admitted"] == len(reqs)
+        and d["handoffs_refused"] == 0
+        and net.get("receipts", 0) == len(reqs)
+        and net.get("peer_losses", 0) == 0
+        and d["transfer_bytes"] > 0
+        and replays > 0
+        and pc["hits"] >= 1
+        and prefill_pages == 0
+        and peer_pool.get("pages_in_use", -1) == 0
+        and peer_pool.get("slots_active", -1) == 0
+        and dw.sockets_closed
+        and child_rc == 0
+    )
+    verdict["ok"] = ok
+    ir.emit_verdict(verdict)
+
+    if args.write_note:
+        if ok:
+            msg = (
+                f"OK: {len(reqs)} mixed warm/cold requests through a "
+                f"decode-host PROCESS over the socket transport — "
+                f"{pc['hits']} warm handoffs, {d['transfer_bytes']} wire "
+                "bytes, answers bit-identical to the co-located engine, "
+                "0 recompiles on both sides, both pools clean, child "
+                "exited 0 with sockets closed"
+            )
+        else:
+            msg = ("ATTENTION: cross-host split lost work, diverged from "
+                   "the co-located engine, recompiled, or leaked "
+                   "pages/sockets")
+        ir.append_perf_note(
+            f"\n- Cross-host check (scripts/check_crosshost.py, "
+            f"backend={backend}): {msg}\n"
+        )
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,6 +26,13 @@
 #                            steady-state recompiles, answers
 #                            bit-identical to a co-located engine, all
 #                            pages on BOTH pools released after drain
+#   check_crosshost.py     — cross-host serving: mixed warm/cold churn
+#                            through a decode-host PROCESS over the
+#                            socket KV transport — zero steady-state
+#                            recompiles on BOTH sides of the wire,
+#                            answers bit-identical to a co-located
+#                            engine, both pools clean, child exits 0
+#                            with sockets closed
 #   check_quant_hlo.py     — quantized serving: int8 KV pool + int8
 #                            retrieval table on ONE engine under
 #                            mixed-dtype churn — zero steady-state
@@ -156,6 +163,17 @@ if [ "$MODE" = "--smoke" ]; then
     if [ -z "${GENREC_CI_SKIP_DISAGG:-}" ]; then
         run python scripts/check_disagg.py --small --platform cpu
     fi
+    # Cross-host smoke: the same churn trace through ONE decode-host
+    # process over the loopback socket transport — zero recompiles on
+    # both sides of the wire (the peer's counter read via a STATS
+    # round-trip), bit-identical to a co-located engine, both pools
+    # clean, child rc 0, sockets closed.
+    # GENREC_CI_SKIP_CROSSHOST=1 skips it for callers whose pytest
+    # pass already runs tests/test_crosshost.py directly (same
+    # contract as the knobs above).
+    if [ -z "${GENREC_CI_SKIP_CROSSHOST:-}" ]; then
+        run python scripts/check_crosshost.py --small --platform cpu
+    fi
     # Speculative-decode smoke: a warmed spec TIGER engine under
     # staggered churn — zero steady-state recompiles, exactly one tree
     # topology per slot rung, output bit-identical to a plain engine at
@@ -244,6 +262,7 @@ else
     run python scripts/check_catalog_hlo.py --write-note
     run python scripts/check_fleet.py --write-note
     run python scripts/check_disagg.py --write-note
+    run python scripts/check_crosshost.py --write-note
     run python scripts/check_spec_hlo.py --write-note
     run python scripts/check_quant_hlo.py --write-note
     run python scripts/check_lineage.py --write-note

@@ -159,6 +159,7 @@ SEGMENT_OF = {
     "prefix_lookup": "admission",
     "warm_admit": "prefill",
     "decode_step": "decode",
+    "handoff_network": "network",  # socket-tier send, peer-attributed
     "request": "untraced",       # root/container exclusive time
     "slot_residency": "slot_gap",  # resident but not stepping (scheduler)
 }

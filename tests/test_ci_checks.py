@@ -40,6 +40,13 @@ DISAGG_KEYS = {"backend", "submitted", "completed", "failed", "replays",
                "handoffs_refused", "transfer_bytes", "recompilations",
                "prefill_pages_final", "decode_pages_final",
                "slots_active_final", "parity_ok", "ok"}
+CROSSHOST_KEYS = {"backend", "submitted", "completed", "failed", "replays",
+                  "warm_hits", "handoffs_sent", "handoffs_admitted",
+                  "handoffs_refused", "receipts", "peer_losses",
+                  "wire_bytes", "recompilations_front",
+                  "recompilations_peer", "prefill_pages_final",
+                  "peer_pages_final", "peer_slots_final", "sockets_closed",
+                  "child_rc", "parity_ok", "ok"}
 SPEC_KEYS = {"backend", "submitted", "completed", "recompilations", "rungs",
              "topology", "topologies_per_rung", "spec_steps",
              "plain_decode_steps", "spec_decode_steps",
@@ -102,8 +109,8 @@ def test_check_scripts_keep_their_cli():
     for script in ("check_decode_hlo", "check_packed_hlo",
                    "check_fused_ce_hlo", "check_serving_hlo",
                    "check_catalog_hlo", "check_fleet", "check_disagg",
-                   "check_spec_hlo", "check_lineage", "check_obs",
-                   "check_quant_hlo"):
+                   "check_crosshost", "check_spec_hlo", "check_lineage",
+                   "check_obs", "check_quant_hlo"):
         proc = subprocess.run(
             [sys.executable, os.path.join(REPO, "scripts", f"{script}.py"),
              "--help"],
@@ -136,11 +143,11 @@ def test_ci_checks_smoke_entrypoint():
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     # One verdict JSON per check on stdout (decode, fused-ce, packed,
-    # serving, fleet, disagg, spec, lineage, bench-gate self-test; the
-    # quant check is env-skipped above, so the unfiltered smoke emits
-    # one more).
+    # serving, fleet, disagg, crosshost, spec, lineage, bench-gate
+    # self-test; the quant check is env-skipped above, so the
+    # unfiltered smoke emits one more).
     verdicts = [json.loads(l) for l in proc.stdout.splitlines() if l.strip()]
-    assert len(verdicts) == 9
+    assert len(verdicts) == 10
     lineage = [v for v in verdicts if "segment_sum_ok" in v]
     assert len(lineage) == 1 and set(lineage[0]) == LINEAGE_KEYS
     assert lineage[0]["rooted_ok"] and lineage[0]["components_ok"]
@@ -159,11 +166,18 @@ def test_ci_checks_smoke_entrypoint():
     fleet = [v for v in verdicts if "rerouted" in v]
     assert len(fleet) == 1 and set(fleet[0]) == FLEET_KEYS
     assert fleet[0]["recompilations"] == 0 and fleet[0]["lost"] == 0
-    disagg = [v for v in verdicts if "handoffs_sent" in v]
+    disagg = [v for v in verdicts if "decode_pages_final" in v]
     assert len(disagg) == 1 and set(disagg[0]) == DISAGG_KEYS
     assert disagg[0]["recompilations"] == 0 and disagg[0]["parity_ok"]
     assert disagg[0]["prefill_pages_final"] == 0
     assert disagg[0]["decode_pages_final"] == 0
+    xhost = [v for v in verdicts if "recompilations_peer" in v]
+    assert len(xhost) == 1 and set(xhost[0]) == CROSSHOST_KEYS
+    assert xhost[0]["recompilations_front"] == 0
+    assert xhost[0]["recompilations_peer"] == 0
+    assert xhost[0]["parity_ok"] and xhost[0]["peer_losses"] == 0
+    assert xhost[0]["receipts"] == xhost[0]["handoffs_sent"]
+    assert xhost[0]["peer_pages_final"] == 0 and xhost[0]["child_rc"] == 0
     decode = [v for v in verdicts if "cached_broadcast_hits" in v]
     assert len(decode) == 1 and set(decode[0]) == DECODE_KEYS
     gate = [v for v in verdicts if v.get("check") == "bench_gate"]
