@@ -690,6 +690,13 @@ def _serve_bench(model, params, valid_ids, rng, batch: int = SERVE_BATCH,
         out["crosshost"] = _crosshost_bench(model, params, valid_ids, rng)
     except Exception as e:
         print(f"bench: crosshost benchmark failed: {e!r}", file=sys.stderr)
+    # Chaos-hardened cross-host serving (genrec_tpu/disagg/chaosnet.py):
+    # qps through a seeded network-fault schedule vs the clean wire, and
+    # end-to-end recovery time after a yanked decode connection.
+    try:
+        out["chaos"] = _chaos_bench(model, params, valid_ids, rng)
+    except Exception as e:
+        print(f"bench: chaos benchmark failed: {e!r}", file=sys.stderr)
     # Speculative tree decode: accepted codes per target invocation and
     # qps, spec vs plain, on the seeded Zipfian repeat-user trace.
     try:
@@ -1592,6 +1599,193 @@ def _crosshost_bench(model, params, valid_ids, rng, batch: int = 8) -> dict:
     if tp is not None:
         result["tp_item_topk"] = tp
     return result
+
+
+def _chaos_bench(model, params, valid_ids, rng, batch: int = 8) -> dict:
+    """Chaos-hardened cross-host serving (disagg/chaosnet.py + the
+    self-healing socket tier in disagg/net.py):
+
+    - **qps_under_faults_vs_clean**: the seeded Zipfian trace through a
+      1-prefill front + 1 remote decode-host process, clean wire vs a
+      live seeded fault schedule — 2ms latency jitter on 20% of front
+      sends for the whole run, plus one child-injected corrupt frame on
+      the first connection (CRC trip -> typed error -> backoff
+      reconnect -> stranded-flight re-submit, all mid-trace). The ratio
+      is the throughput tax of surviving a flaky network, and it gates
+      that self-healing stays CHEAP, not just correct.
+    - **recovery_time_ms**: yank the established decode connection out
+      from under the front (socket shutdown — what a dead NAT entry or
+      yanked cable looks like), immediately submit a probe request, and
+      time until it resolves. End-to-end caller-visible recovery:
+      detection + backoff + reconnect handshake + re-admit + decode.
+
+    CPU-only for the same reason as the crosshost section: a decode
+    child cannot share the single TPU chip with the parent.
+    """
+    import collections
+    import socket as socket_mod
+
+    import jax
+
+    from genrec_tpu.core import chaos
+    from genrec_tpu.core.chaos import ChaosPlan, NetFault
+    from genrec_tpu.disagg import DisaggFront, chaosnet, spawn_decode_host
+    from genrec_tpu.serving import (
+        BucketLadder, OverloadError, PagedConfig, Request,
+    )
+    from genrec_tpu.serving.heads import TigerGenerativeHead
+
+    backend = jax.default_backend()
+    if backend != "cpu":
+        return dict(backend=backend, skipped=(
+            "chaos section is CPU-only: a decode-host child process "
+            "cannot share the single TPU chip with the parent"
+        ))
+
+    items = BENCH_ITEMS
+    ladder = BucketLadder((1, batch), (items,))
+    n_tok = 1 + items * model.sem_id_dim
+    cfg = PagedConfig(max_slots=2 * batch, page_size=16,
+                      pages_per_slot=-(-n_tok // 16))
+    trace = zipfian_repeat_user_trace(
+        n_requests=64, n_users=32, max_items=items,
+        corpus_size=len(valid_ids), rng=rng,
+    )
+
+    def drive(submit) -> float:
+        inflight = collections.deque()
+        window = 2 * batch + 1
+        i = 0
+        t0 = time.perf_counter()
+        while i < len(trace) or inflight:
+            while i < len(trace) and len(inflight) < window:
+                user, hist = trace[i]
+                inflight.append(submit(
+                    Request(head="tiger", history=hist, user_id=user)
+                ))
+                i += 1
+            inflight.popleft().result(600)
+        return time.perf_counter() - t0
+
+    factory = f"{os.path.join(REPO, 'bench.py')}:_crosshost_decode_cfg"
+
+    def run(child_env, front_plan, remote_net=None, probe=False):
+        chaosnet.reset_conn_counts()
+        chaos.install(front_plan)
+        try:
+            return _run_inner(child_env, remote_net, probe)
+        finally:
+            chaos.install(None)  # never leak the plan into later sections
+
+    def _run_inner(child_env, remote_net, probe):
+        proc, addr = spawn_decode_host(
+            factory, worker_id="chaos-d0", env=child_env,
+            startup_timeout=600.0,
+        )
+        front = DisaggFront(
+            [TigerGenerativeHead(model, valid_ids, top_k=DECODE_BEAM_K,
+                                 name="tiger")],
+            params, ladder=ladder, max_batch=batch, max_wait_ms=2.0,
+            n_prefill=1, transport="socket", workers=[addr],
+            paged_config=cfg, params_step=1,
+            remote_net=remote_net or {},
+        ).start()
+        recovery_ms = None
+        try:
+            wall = drive(front.submit)
+            if probe:
+                # Yank the live connection (RST-equivalent from the
+                # front's point of view) and time a probe request
+                # end-to-end through detection + reconnect + decode.
+                (dw,) = front._groups["tiger"].decode
+                t0 = time.perf_counter()
+                dw._sock.shutdown(socket_mod.SHUT_RDWR)
+                user, hist = trace[0]
+                deadline = t0 + 300
+                while True:
+                    # The front may shed (degraded: sole peer is mid-
+                    # reconnect) — a real caller retries, so the probe
+                    # does too, and the shed window counts against
+                    # recovery time.
+                    try:
+                        front.submit(
+                            Request(head="tiger", history=hist,
+                                    user_id=user)
+                        ).result(300)
+                        break
+                    except OverloadError:
+                        if time.perf_counter() > deadline:
+                            raise
+                        time.sleep(0.005)
+                recovery_ms = (time.perf_counter() - t0) * 1e3
+        finally:
+            st = front.stop()
+        rc = proc.wait(60)
+        return wall, st, rc, recovery_ms
+
+    # Clean wire: the throughput baseline the faulted run gates against,
+    # and (connection still healthy at the end) the recovery probe host.
+    wall_clean, st_clean, rc_clean, recovery_ms = run(
+        {"JAX_PLATFORMS": "cpu"}, None,
+        remote_net=dict(reconnect_base=0.05, reconnect_cap=0.25,
+                        reconnect_seed=23),
+        probe=True,
+    )
+
+    # Faulted wire: the same trace through a live schedule — front-side
+    # latency jitter every connection, one child-side corrupt frame on
+    # conn 0 (the reconnect it forces comes up clean: n_conns=1).
+    child_env = {"JAX_PLATFORMS": "cpu"}
+    child_env[chaos.NET_PLAN_ENV] = chaos.net_plan_to_env(ChaosPlan(
+        net_seed=23,
+        net_faults=(NetFault(kind="corrupt", role="host", side="send",
+                             at_frame=6, n_frames=1, n_conns=1),),
+    ))
+    wall_faulted, st_faulted, rc_faulted, _ = run(
+        child_env,
+        ChaosPlan(net_seed=23, net_faults=(
+            NetFault(kind="latency", role="front", side="send",
+                     at_frame=0, n_frames=1_000_000, delay_s=0.002,
+                     p=0.2),
+        )),
+        remote_net=dict(reconnect_base=0.05, reconnect_cap=0.25,
+                        reconnect_seed=23),
+    )
+
+    qps_clean = round(len(trace) / wall_clean, 2)
+    qps_faulted = round(len(trace) / wall_faulted, 2)
+    net_c = (st_clean["disagg"].get("transports", {})
+             .get("socket", {}).get("network", {}))
+    net_f = (st_faulted["disagg"].get("transports", {})
+             .get("socket", {}).get("network", {}))
+    return dict(
+        backend=backend,
+        trace=dict(n_requests=len(trace), n_users=32, max_items=items),
+        schedule=("2ms latency jitter on 20% of front sends (all conns)"
+                  " + 1 corrupt host frame on conn 0"),
+        qps_clean=qps_clean,
+        qps_under_faults=qps_faulted,
+        qps_under_faults_vs_clean=(
+            round(qps_faulted / qps_clean, 3) if qps_clean else None
+        ),
+        recovery_time_ms=round(recovery_ms, 1),
+        reconnects_clean=net_c.get("reconnects", 0),
+        reconnects_faulted=net_f.get("reconnects", 0),
+        incarnation_discards=net_f.get("incarnation_discards", 0),
+        completed_clean=st_clean["completed"],
+        completed_faulted=st_faulted["completed"],
+        recompilations_steady=st_clean["recompilations"]
+        + st_faulted["recompilations"],
+        child_rcs=[rc_clean, rc_faulted],
+        note=(
+            "same seeded Zipfian trace on clean wire vs a live seeded "
+            "fault schedule; the ratio is the throughput tax of "
+            "self-healing (CRC + liveness + reconnect machinery active "
+            "either way, faults firing only in the second run); "
+            "recovery_time_ms is submit-to-answer across a yanked "
+            "connection — detection + backoff + handshake + re-admit"
+        ),
+    )
 
 
 #: Speculative-decode serve section shapes: parity beams (both engines),

@@ -15,6 +15,12 @@ step/epoch it names —
 - `truncate_checkpoint` / `garble_checkpoint`: damage an on-disk orbax
   step dir the way a crashed writer or a bad disk would, driving the
   integrity ladder in core.checkpoint.
+- ``net_faults``: a seeded schedule of NETWORK faults (latency, drop,
+  corruption, truncation, slow-loris, reset, hang) applied at the frame
+  send/recv boundary of the cross-host serving tier — the injector
+  itself lives in `genrec_tpu.disagg.chaosnet`, but the schedule rides
+  the SAME plan schema as training chaos, so one `inject(...)` covers a
+  whole chaos scenario (kill the host AND partition its wire).
 
 The hooks are no-ops (one module attribute read) unless a plan is
 installed, so they stay in the production loops permanently — the same
@@ -24,11 +30,42 @@ code path that serves traffic is the one chaos-tested.
 from __future__ import annotations
 
 import dataclasses
+import json
 import os
 import signal
 from typing import Iterable, Iterator
 
 import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class NetFault:
+    """One scheduled network fault at the socket tier's frame boundary.
+
+    Matched per wrapped endpoint by ``role`` (``"front"`` — the proxy's
+    socket; ``"host"`` — a decode host's accepted connection; ``"*"``)
+    and ``side`` (``"send"`` / ``"recv"``), armed for the half-open
+    frame-index window ``[at_frame, at_frame + n_frames)`` counted
+    per endpoint+side. Every probabilistic choice (``p``, corruption
+    positions) draws from the plan's seeded RNG, so a fault sequence is
+    bit-reproducible per ``net_seed``."""
+
+    kind: str          # latency|drop|corrupt|truncate|slow_loris|reset|hang
+    role: str = "*"    # which endpoint's socket ("front"|"host"|"*")
+    side: str = "send"  # "send" | "recv"
+    at_frame: int = 0  # first frame index the rule arms at
+    n_frames: int = 1  # window length in frames
+    delay_s: float = 0.0  # latency/hang sleep; slow-loris per-chunk delay
+    p: float = 1.0     # per-frame firing probability (seeded)
+    # Connection window: each wrap of a role's socket gets the next
+    # ordinal (0, 1, ... per process+role, reconnects included), and
+    # the fault only arms for ordinals in [at_conn, at_conn + n_conns).
+    # n_conns=0 means every connection. This is how a schedule says
+    # "blackhole the FIRST connection" and still lets the reconnect
+    # that recovers from it come up clean — the property that makes a
+    # zero-lost-requests chaos run deterministic instead of a race.
+    at_conn: int = 0
+    n_conns: int = 0
 
 
 @dataclasses.dataclass
@@ -46,6 +83,11 @@ class ChaosPlan:
     # host (jax.process_index()). None = fire on every process (the
     # single-process default, where process_index() is 0).
     only_process: int | None = None
+    # Serving chaos: the scheduled network faults disagg.chaosnet
+    # injects at the socket tier's frame boundary, plus the seed that
+    # makes the whole sequence reproducible.
+    net_faults: tuple[NetFault, ...] = ()
+    net_seed: int = 0
 
 
 def _this_process_targeted(plan: ChaosPlan) -> bool:
@@ -79,6 +121,44 @@ class inject:
 
 def active() -> ChaosPlan | None:
     return _ACTIVE
+
+
+def install(plan: ChaosPlan | None) -> None:
+    """Process-lifetime install (no context manager to unwind): a child
+    process — a spawned decode host — installs its plan once at startup
+    and keeps it until exit."""
+    global _ACTIVE
+    _ACTIVE = plan
+
+
+#: Env var carrying a net-fault schedule into a CHILD process (a
+#: spawned decode host cannot enter the parent's `inject` block).
+NET_PLAN_ENV = "GENREC_CHAOS_NET_PLAN"
+
+
+def net_plan_to_env(plan: ChaosPlan) -> str:
+    """Serialize the plan's NETWORK schedule for `NET_PLAN_ENV` (the
+    process-kill/NaN fields stay parent-side — a child that should die
+    gets its own plan)."""
+    return json.dumps({
+        "net_seed": plan.net_seed,
+        "net_faults": [dataclasses.asdict(f) for f in plan.net_faults],
+    })
+
+
+def install_net_plan_from_env() -> ChaosPlan | None:
+    """Child-process hook: install the schedule `NET_PLAN_ENV` carries
+    (no-op without it). Returns the installed plan."""
+    raw = os.environ.get(NET_PLAN_ENV)
+    if not raw:
+        return None
+    spec = json.loads(raw)
+    plan = ChaosPlan(
+        net_seed=int(spec.get("net_seed", 0)),
+        net_faults=tuple(NetFault(**f) for f in spec.get("net_faults", ())),
+    )
+    install(plan)
+    return plan
 
 
 def maybe_kill(step: int | None = None, epoch: int | None = None) -> None:

@@ -39,6 +39,7 @@ head. Layering: disagg imports serving/obs (and core for the signal
 guard); nothing imports disagg.
 """
 
+from genrec_tpu.disagg import chaosnet
 from genrec_tpu.disagg.front import DisaggFront
 from genrec_tpu.disagg.handoff import (
     DisaggError,
@@ -77,6 +78,7 @@ __all__ = [
     "SocketTransport",
     "WIRE_VERSION",
     "WorkerLostError",
+    "chaosnet",
     "pack_handoff",
     "serve_decode_host",
     "spawn_decode_host",

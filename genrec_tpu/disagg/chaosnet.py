@@ -1,0 +1,261 @@
+"""Deterministic network-fault injection for the socket serving tier.
+
+The cross-host hop (disagg/net.py) is the availability-critical edge of
+disaggregated serving, and "the network is reliable" is the first
+fallacy. This module makes every classic wire failure injectable at the
+FRAME boundary — the exact unit net.py reasons in — without touching a
+production code path: `maybe_wrap(sock, role)` is a no-op (one module
+attribute read, via `core.chaos.active()`) unless a `ChaosPlan` with
+``net_faults`` is installed, so the wrapped code is the code that
+serves traffic.
+
+Fault kinds (``core.chaos.NetFault``), by side:
+
+- ``latency``    (send/recv): sleep ``delay_s`` before the frame moves.
+- ``drop``       (send): the frame's bytes vanish — a one-way
+  partition/blackhole. The sender is none the wiser; the receiver just
+  never sees the frame. (Send-side only: TCP cannot lose bytes without
+  killing the stream, so a receive-side "drop" has no real analogue.)
+- ``corrupt``    (send/recv): flip seeded byte positions — a corrupt
+  length prefix, header, meta or payload must all land as TYPED errors
+  on the reader, never a hang or a silent mis-parse.
+- ``truncate``   (send): ship a prefix of the frame, then hard-reset —
+  the peer sees EOF mid-frame (`kill -9` between length and payload).
+- ``slow_loris`` (send): dribble the frame in 64-byte chunks with
+  ``delay_s`` between them — a stalling-but-alive peer, bounded by the
+  receiver's per-chunk socket timeout.
+- ``reset``      (send/recv): SO_LINGER-0 close — an RST instead of a
+  FIN, the mid-conversation connection reset.
+- ``hang``       (send/recv): sleep ``delay_s`` with the frame parked —
+  the hung-but-connected peer the liveness deadline (not the death
+  detector) must catch.
+
+Scheduling is by per-endpoint, per-side FRAME INDEX (`at_frame` ..
+`at_frame + n_frames`) and by CONNECTION ordinal (`at_conn` ..
+`at_conn + n_conns`, counted per process+role across wraps, so a
+reconnect is the next ordinal), and every probabilistic draw comes from
+`random.Random(net_seed ^ role)` consumed in frame order — the same
+plan + seed replays the same fault sequence byte-for-byte. A fault
+windowed to `n_conns=1` fires on the first connection and leaves the
+reconnect that recovers from it clean — which is what makes a
+zero-lost-requests chaos schedule deterministic.
+
+The wrapper exploits (and asserts) net.py's framing discipline: one
+`sendall` call == one frame on the send side; on the receive side it
+parses the length-prefixed framing itself, byte-accurately, so faults
+arm exactly at frame boundaries no matter how recv chunks.
+"""
+
+from __future__ import annotations
+
+import random
+import socket as socket_mod
+import struct
+import time
+from typing import Optional
+
+from genrec_tpu.core import chaos
+from genrec_tpu.core.chaos import ChaosPlan, NetFault
+
+_LEN = struct.Struct(">Q")
+
+#: Which fault kinds make sense on which side (see module docstring).
+KINDS_BY_SIDE = {
+    "send": frozenset(
+        {"latency", "drop", "corrupt", "truncate", "slow_loris", "reset",
+         "hang"}),
+    "recv": frozenset({"latency", "corrupt", "reset", "hang"}),
+}
+
+_ROLE_SALT = {"front": 0x66, "host": 0x68}
+
+# Per-process connection ordinals, one counter per role: every wrap —
+# initial connect, reconnect, newly accepted front — takes the next
+# index, which `NetFault.at_conn`/`n_conns` windows match against.
+_conn_counts: dict[str, int] = {}
+
+
+def reset_conn_counts() -> None:
+    """Test hook: restart the per-role connection ordinals."""
+    _conn_counts.clear()
+
+
+class ChaosInjectionError(ConnectionResetError):
+    """The typed face of an injected reset/truncation on the INJECTING
+    side (the peer sees a plain RST/EOF). A ConnectionResetError
+    subclass, so every existing `except (OSError, ConnectionError)`
+    handler treats it exactly like the real fault it simulates."""
+
+
+def validate_faults(faults) -> None:
+    for f in faults:
+        if f.side not in KINDS_BY_SIDE:
+            raise ValueError(f"NetFault side {f.side!r} not send/recv")
+        if f.kind not in KINDS_BY_SIDE[f.side]:
+            raise ValueError(
+                f"NetFault kind {f.kind!r} not injectable on the "
+                f"{f.side!r} side (have {sorted(KINDS_BY_SIDE[f.side])})"
+            )
+        if f.role not in ("front", "host", "*"):
+            raise ValueError(f"NetFault role {f.role!r} not front/host/*")
+
+
+class ChaosSocket:
+    """A socket proxy applying the plan's schedule at frame boundaries.
+
+    Everything not intercepted (fileno/settimeout/setsockopt/close/...)
+    delegates to the wrapped socket, so select() and the existing
+    timeout discipline see the real fd."""
+
+    def __init__(self, sock, role: str, plan: ChaosPlan,
+                 conn_idx: int = 0):
+        validate_faults(plan.net_faults)
+        self._sock = sock
+        self.role = role
+        self.conn_idx = conn_idx
+        self._faults = [
+            f for f in plan.net_faults
+            if f.role in ("*", role)
+            and (f.n_conns == 0
+                 or f.at_conn <= conn_idx < f.at_conn + f.n_conns)
+        ]
+        self._rng = random.Random(
+            int(plan.net_seed) ^ _ROLE_SALT.get(role, 0))
+        self._tx_idx = 0
+        self._rx_idx = 0
+        # Receive-side frame parser: bytes of length prefix still
+        # outstanding, then body countdown (None = prefix phase).
+        self._rx_len_buf = bytearray()
+        self._rx_body_left: Optional[int] = None
+        self._rx_active: list[NetFault] = []
+        #: (side, frame_idx, kind) log of every fault fired — the
+        #: determinism pin reads this.
+        self.applied: list[tuple[str, int, str]] = []
+
+    # -- schedule ------------------------------------------------------------
+
+    def _match(self, side: str, idx: int) -> list[NetFault]:
+        out = []
+        for f in self._faults:
+            if f.side != side:
+                continue
+            if not (f.at_frame <= idx < f.at_frame + f.n_frames):
+                continue
+            # One seeded draw per in-window frame, in frame order:
+            # the consumption sequence is what makes replays exact.
+            if f.p < 1.0 and self._rng.random() >= f.p:
+                continue
+            out.append(f)
+            self.applied.append((side, idx, f.kind))
+        return out
+
+    def _flip(self, data: bytes, n_flips: int = 3) -> bytes:
+        buf = bytearray(data)
+        for _ in range(min(n_flips, len(buf))):
+            pos = self._rng.randrange(len(buf))
+            buf[pos] ^= 1 << self._rng.randrange(8)
+        return bytes(buf)
+
+    def _hard_close(self) -> None:
+        # RST, not FIN: linger-0 close aborts the connection, which is
+        # what a yanked cable / dead NAT entry looks like to the peer.
+        try:
+            self._sock.setsockopt(
+                socket_mod.SOL_SOCKET, socket_mod.SO_LINGER,
+                struct.pack("ii", 1, 0))
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    # -- send side (one sendall == one frame) --------------------------------
+
+    def sendall(self, data) -> None:
+        idx = self._tx_idx
+        self._tx_idx += 1
+        for f in self._match("send", idx):
+            if f.kind in ("latency", "hang"):
+                time.sleep(f.delay_s)
+            elif f.kind == "drop":
+                return  # blackhole: the frame never existed on the wire
+            elif f.kind == "corrupt":
+                data = self._flip(bytes(data))
+            elif f.kind == "truncate":
+                self._sock.sendall(bytes(data)[: max(1, len(data) // 2)])
+                self._hard_close()
+                raise ChaosInjectionError(
+                    "chaosnet: injected mid-frame truncation")
+            elif f.kind == "reset":
+                self._hard_close()
+                raise ChaosInjectionError(
+                    "chaosnet: injected connection reset")
+            elif f.kind == "slow_loris":
+                buf = bytes(data)
+                for i in range(0, len(buf), 64):
+                    self._sock.sendall(buf[i:i + 64])
+                    time.sleep(f.delay_s)
+                return
+        self._sock.sendall(data)
+
+    # -- recv side (length-prefix parser finds the boundaries) ---------------
+
+    def recv(self, n: int) -> bytes:
+        if self._rx_body_left is None and not self._rx_len_buf:
+            # About to deliver the first byte of a NEW frame.
+            self._rx_active = self._match("recv", self._rx_idx)
+            for f in self._rx_active:
+                if f.kind in ("latency", "hang"):
+                    time.sleep(f.delay_s)
+                elif f.kind == "reset":
+                    self._hard_close()
+                    raise ChaosInjectionError(
+                        "chaosnet: injected connection reset")
+        data = self._sock.recv(n)
+        if not data:
+            return data
+        self._advance_rx(data)
+        if any(f.kind == "corrupt" for f in self._rx_active):
+            data = self._flip(data)
+        return data
+
+    def _advance_rx(self, data: bytes) -> None:
+        # Walk the UNCORRUPTED bytes so our own parser never desyncs
+        # (the reader above us is welcome to — that is the test).
+        i = 0
+        while i < len(data):
+            if self._rx_body_left is None:
+                take = min(_LEN.size - len(self._rx_len_buf), len(data) - i)
+                self._rx_len_buf += data[i:i + take]
+                i += take
+                if len(self._rx_len_buf) == _LEN.size:
+                    (self._rx_body_left,) = _LEN.unpack(
+                        bytes(self._rx_len_buf))
+                    self._rx_len_buf.clear()
+            else:
+                take = min(self._rx_body_left, len(data) - i)
+                self._rx_body_left -= take
+                i += take
+            if self._rx_body_left == 0:
+                self._rx_body_left = None
+                self._rx_idx += 1
+                self._rx_active = []
+
+    # -- passthrough ---------------------------------------------------------
+
+    def __getattr__(self, name):
+        return getattr(self._sock, name)
+
+
+def maybe_wrap(sock, role: str):
+    """The production hook: wrap ``sock`` when the active `ChaosPlan`
+    schedules network faults, else hand it back untouched. One module
+    attribute read on the no-chaos path — same bar as every other
+    chaos hook."""
+    plan = chaos.active()
+    if plan is None or not plan.net_faults:
+        return sock
+    idx = _conn_counts.get(role, 0)
+    _conn_counts[role] = idx + 1
+    return ChaosSocket(sock, role, plan, conn_idx=idx)

@@ -133,6 +133,7 @@ class DisaggFront:
         transport: str = "inprocess",
         workers: Optional[Sequence[str]] = None,
         standby_workers: Optional[Sequence[str]] = None,
+        remote_net: Optional[dict] = None,
         paged_config: Optional[PagedConfig] = None,
         bank_num_pages: Optional[int] = None,
         prefix_cache: bool = True,
@@ -205,6 +206,12 @@ class DisaggFront:
         # Unconnected decode-host addresses scale-out may consume
         # (_add_worker on the socket tier attaches one per call).
         self._standby_addrs = list(standby_workers or ())
+        # Socket-tier resilience knobs forwarded verbatim to every
+        # RemoteDecodeWorker this front builds (liveness_timeout,
+        # reconnect_max, reconnect_base, reconnect_cap, reconnect_seed).
+        if remote_net and transport != "socket":
+            raise ValueError("remote_net= is the socket tier's knob")
+        self._remote_net = dict(remote_net or ())
         self._paged_config = paged_config
         self._bank_num_pages = bank_num_pages
         self._prefix_cache = bool(prefix_cache)
@@ -276,7 +283,16 @@ class DisaggFront:
             "transfer_bytes": 0,
             "decode_worker_deaths": 0,
             "prefill_worker_deaths": 0,
+            "degraded_entered": 0,
+            "degraded_exited": 0,
         }
+        # Heads whose decode pool currently has ZERO live capacity
+        # (socket tier: every remote peer unreachable). While a head is
+        # degraded, submit sheds with the recoverable OverloadError
+        # instead of queueing work that can only hang; pump_once exits
+        # the head the moment a worker (reconnected or promoted
+        # standby) is live again.
+        self._degraded: set[str] = set()
         self.transfer = LatencyHistogram()
         self._draining = False
         self._drained = threading.Event()
@@ -465,7 +481,7 @@ class DisaggFront:
             flight_recorder=self._flight.scoped("decode_worker",
                                                 worker_id=addr),
             replica_id=self.replica_id, tracer=self._tracer,
-            logger=self._log,
+            logger=self._log, **self._remote_net,
         )
         w.warmup()
         head_name = w.identity["head"]
@@ -632,6 +648,19 @@ class DisaggFront:
                     f"head {req.head!r} disagg pools are load-shedding; "
                     "back off and retry or fail over"
                 )
+            if req.head in self._degraded:
+                # Degraded mode: every remote decode peer is currently
+                # unreachable. Shed at admission with the recoverable
+                # error rather than accept work that can only pile up
+                # behind reconnect — the caller (or FleetRouter) backs
+                # off / fails over, and the head exits degraded the
+                # moment a peer is live again.
+                self.metrics.record_overload(req.head)
+                raise OverloadError(
+                    f"head {req.head!r} is in degraded mode (no "
+                    "reachable decode peers); back off and retry or "
+                    "fail over"
+                )
             self._attach_trace(flight)
             try:
                 self._enqueue_locked(flight)
@@ -778,8 +807,51 @@ class DisaggFront:
                             progressed = True
                         continue
                     progressed |= dw.step()
+                    # A reconnect stranded this worker's pre-reconnect
+                    # flights (the host orphaned them): re-submit each
+                    # through prefill, at-most-once, exactly like the
+                    # death path — but the worker itself stays live.
+                    take = getattr(dw, "take_stranded", None)
+                    if take is not None:
+                        for fl in take():
+                            self._resubmit(group, fl,
+                                           from_worker=dw.worker_id)
+                            progressed = True
+                self._update_degraded(group)
             self._poll_slo()
         return progressed
+
+    def _update_degraded(self, group: _HeadGroup) -> None:
+        """Enter/exit the head's degraded mode on the socket tier: zero
+        reachable decode peers in, first live peer out. Flight-evented
+        both ways and visible in stats()["disagg"]["degraded_heads"]."""
+        if self._transport_kind != "socket":
+            return
+        name = group.head.name
+        live = any(
+            not w.dead and not w.draining
+            and not getattr(w, "reconnecting", False)
+            for w in group.decode
+        )
+        if not live and name not in self._degraded:
+            self._degraded.add(name)
+            self._counters["degraded_entered"] += 1
+            self._flight.record(
+                "degraded_mode_entered", head=name,
+                decode_workers=len(group.decode),
+            )
+            self._log.warning(
+                f"disagg: head {name!r} entered degraded mode — no "
+                "reachable decode peers; shedding at admission"
+            )
+        elif live and name in self._degraded:
+            self._degraded.discard(name)
+            self._counters["degraded_exited"] += 1
+            self._flight.record("degraded_mode_exited", head=name)
+            self._log.info(
+                f"disagg: head {name!r} exited degraded mode — decode "
+                "capacity restored"
+            )
 
     def _reap_dead_decode(self, group: _HeadGroup, worker) -> None:
         """kill_decode_worker's body for a worker that died on its own
@@ -816,10 +888,24 @@ class DisaggFront:
                 fl, handoff, _t = group.pending.popleft()
                 group.transport.release(handoff)
                 if not fl.fut.done():
-                    fl.fut.set_exception(WorkerLostError(
-                        f"no live decode workers for head "
-                        f"{group.head.name!r}; handoff dropped typed"
-                    ))
+                    if self._transport_kind == "socket":
+                        # Socket tier: dead peers are a NETWORK outcome
+                        # (partition, crash) the fleet fails over on —
+                        # shed recoverable, and enter degraded mode so
+                        # subsequent submits shed at admission instead
+                        # of burning a prefill first.
+                        self._update_degraded(group)
+                        self.metrics.record_overload(group.head.name)
+                        fl.fut.set_exception(OverloadError(
+                            f"head {group.head.name!r} has no reachable "
+                            "decode peers (degraded mode); back off and "
+                            "retry or fail over"
+                        ))
+                    else:
+                        fl.fut.set_exception(WorkerLostError(
+                            f"no live decode workers for head "
+                            f"{group.head.name!r}; handoff dropped typed"
+                        ))
                     self.metrics.record_failure(1)
                 progressed = True
                 continue
@@ -1240,6 +1326,7 @@ class DisaggFront:
             **dict(self._counters),
             "pending_handoffs": sum(len(g.pending)
                                     for g in self._groups.values()),
+            "degraded_heads": sorted(self._degraded),
             "transfer_ms": self.transfer.summary(),
             "roles": roles_by_head,
         }

@@ -49,14 +49,18 @@ import io
 import json
 import logging
 import queue
+import random
 import select
 import socket as socket_mod
 import struct
 import threading
 import time
+import zlib
 from typing import Optional
 
 import numpy as np
+
+from genrec_tpu.disagg import chaosnet
 
 from genrec_tpu.disagg.handoff import (
     HandoffRefusedError,
@@ -83,10 +87,18 @@ _HDR = struct.Struct(">BI")
 MAX_FRAME_BYTES = 1 << 31
 
 
+_CRC = struct.Struct(">I")
+
+
 def send_frame(sock, ftype: int, meta: dict, payload: bytes = b"") -> int:
-    """Write one length-prefixed frame; returns bytes on the wire."""
+    """Write one length-prefixed, checksummed frame; returns bytes on
+    the wire. The CRC32 covers header+meta+payload: TCP's 16-bit
+    checksum misses real corruption at fleet scale, and a flipped bit
+    in a RESULT's array payload would otherwise parse clean here and
+    explode (or worse, mis-rank) far from the wire that caused it."""
     meta_b = json.dumps(meta).encode("utf-8")
-    frame = _HDR.pack(ftype, len(meta_b)) + meta_b + payload
+    body = _HDR.pack(ftype, len(meta_b)) + meta_b + payload
+    frame = _CRC.pack(zlib.crc32(body)) + body
     sock.sendall(_LEN.pack(len(frame)) + frame)
     return _LEN.size + len(frame)
 
@@ -104,13 +116,39 @@ def _recv_exact(sock, n: int) -> bytes:
 def recv_frame(sock) -> tuple[int, dict, bytes]:
     """Read one frame. Raises ConnectionError on EOF/reset (peer death —
     mid-frame included: a kill -9 between the length prefix and the
-    payload lands here, never as a truncated parse)."""
+    payload lands here, never as a truncated parse) AND on any corrupt
+    framing — an insane length, a meta length past the frame end, or
+    meta bytes that fail to decode. A flipped bit anywhere lands as the
+    same typed error as a dead peer: the stream is presumed desynced
+    and the connection unusable. The CRC32 check catches corruption
+    ANYWHERE in the frame — payload bytes included — before a single
+    field is trusted."""
     (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
-    if n < _HDR.size or n > MAX_FRAME_BYTES:
+    if n < _CRC.size + _HDR.size or n > MAX_FRAME_BYTES:
         raise ConnectionError(f"insane frame length {n}")
-    frame = _recv_exact(sock, n)
+    raw = _recv_exact(sock, n)
+    (crc,) = _CRC.unpack_from(raw)
+    frame = raw[_CRC.size:]
+    if zlib.crc32(frame) != crc:
+        raise ConnectionError(
+            "corrupt frame: checksum mismatch (stream presumed desynced)"
+        )
     ftype, meta_len = _HDR.unpack_from(frame)
-    meta = json.loads(frame[_HDR.size:_HDR.size + meta_len].decode("utf-8"))
+    if meta_len > len(frame) - _HDR.size:
+        raise ConnectionError(
+            f"corrupt frame: meta length {meta_len} exceeds frame "
+            f"body {len(frame) - _HDR.size}"
+        )
+    try:
+        meta = json.loads(
+            frame[_HDR.size:_HDR.size + meta_len].decode("utf-8"))
+    except (UnicodeDecodeError, ValueError) as e:
+        raise ConnectionError(f"corrupt frame meta: {e}") from e
+    if not isinstance(meta, dict):
+        raise ConnectionError(
+            f"corrupt frame meta: expected object, got "
+            f"{type(meta).__name__}"
+        )
     return ftype, meta, frame[_HDR.size + meta_len:]
 
 
@@ -151,6 +189,9 @@ class SocketTransport(SerializingTransport):
             "connects": 0,
             "connect_retries": 0,
             "peer_losses": 0,
+            "reconnects": 0,
+            "heartbeat_misses": 0,
+            "incarnation_discards": 0,
         }
         self.in_flight_frames = 0  # gauge: admitted, no receipt yet
         self.network_ms = LatencyHistogram()
@@ -225,6 +266,11 @@ class RemoteDecodeWorker:
                  connect_retries: int = 40,
                  hello_timeout: float = 600.0,
                  send_timeout: float = 60.0,
+                 liveness_timeout: float = 60.0,
+                 reconnect_max: int = 3,
+                 reconnect_base: float = 0.05,
+                 reconnect_cap: float = 2.0,
+                 reconnect_seed: Optional[int] = None,
                  tracer=None, logger: Optional[logging.Logger] = None):
         self.peer_addr = addr
         self.transport = transport
@@ -238,6 +284,22 @@ class RemoteDecodeWorker:
         self._connect_retries = int(connect_retries)
         self._hello_timeout = hello_timeout
         self._send_timeout = send_timeout
+        # Liveness deadline: a hung-but-connected peer (no frames at
+        # all, despite the 0.25s STATS_REQ heartbeat soliciting them)
+        # is treated as lost after this many silent seconds — distinct
+        # from the send/recv timeouts, which only bound an ACTIVE
+        # chunk. 0 disables the check.
+        self._liveness_timeout = float(liveness_timeout)
+        # Reconnect-with-backoff budget before the terminal peer-loss
+        # path: reconnect_max attempts, exponential from reconnect_base
+        # capped at reconnect_cap, each with seeded jitter in [0.5, 1)x.
+        # reconnect_max=0 restores fail-fast (first error is terminal).
+        self._reconnect_max = int(reconnect_max)
+        self._reconnect_base = float(reconnect_base)
+        self._reconnect_cap = float(reconnect_cap)
+        self._jitter = random.Random(
+            reconnect_seed if reconnect_seed is not None
+            else (hash(addr) & 0xFFFFFFFF))
         self.tracer = tracer if tracer is not None else NULL_TRACER
         self._log = logger or logging.getLogger("genrec_tpu")
         self.dead = False
@@ -261,6 +323,27 @@ class RemoteDecodeWorker:
         self._stats_gen = 0
         self._stats_next = 0.0
         self.pool: Optional[_RemotePoolStats] = None
+        # Connection epochs: every (re)connect bumps the incarnation,
+        # I/O threads are born with theirs, and frames delivered by a
+        # stale epoch's reader are DISCARDED in _dispatch — a RESULT
+        # from before a reconnect can never resolve (or double-resolve)
+        # a flight that was re-submitted after it.
+        self.incarnation = 0
+        self._reconnecting = False
+        self._reconnect_lock = threading.Lock()
+        # Set when an epoch dies with frames outstanding; the front's
+        # pump drains take_stranded() on the runtime thread and
+        # re-submits (at most once) through the prefill path.
+        self._strand_pending = False
+        # The in-flight connect socket of a reconnect attempt, so a
+        # racing close() can abort it instead of leaking it.
+        self._connecting_sock = None
+        self._last_rx = time.monotonic()
+        self._last_step = time.monotonic()
+        # Most recent traced handoff: reconnect attempts record their
+        # handoff_network spans against it (best-effort attribution —
+        # retry wall-time shows on the critical path it stalled).
+        self._last_handoff_trace = None
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -270,47 +353,10 @@ class RemoteDecodeWorker:
         HELLO read then waits on a generous timeout."""
         if self._sock is not None:
             return
-        host, _, port = self.peer_addr.rpartition(":")
-        last_err: Optional[Exception] = None
-        for attempt in range(self._connect_retries + 1):
-            try:
-                sock = socket_mod.create_connection(
-                    (host, int(port)), timeout=self._connect_timeout
-                )
-                break
-            except OSError as e:
-                last_err = e
-                self.transport.net_counters["connect_retries"] += 1
-                time.sleep(min(0.25 * (attempt + 1), 2.0))
-        else:
-            raise WorkerLostError(
-                f"decode host {self.peer_addr} unreachable after "
-                f"{self._connect_retries} retries: {last_err}"
-            )
-        self.transport.net_counters["connects"] += 1
-        sock.setsockopt(socket_mod.IPPROTO_TCP, socket_mod.TCP_NODELAY, 1)
-        sock.settimeout(self._hello_timeout)
-        try:
-            ftype, meta, _ = recv_frame(sock)
-        except (OSError, ConnectionError) as e:
-            sock.close()
-            raise WorkerLostError(
-                f"decode host {self.peer_addr} died during handshake: {e}"
-            ) from e
-        if ftype != HELLO:
-            sock.close()
-            raise HandoffRefusedError(
-                f"decode host {self.peer_addr} opened with frame type "
-                f"{ftype}, expected HELLO"
-            )
-        if (self._expected_head is not None
-                and meta.get("head") != self._expected_head):
-            sock.close()
-            raise HandoffRefusedError(
-                f"decode host {self.peer_addr} serves head "
-                f"{meta.get('head')!r}, this pool needs "
-                f"{self._expected_head!r}"
-            )
+        sock, meta = self._connect_once(
+            retries=self._connect_retries,
+            hello_timeout=self._hello_timeout,
+        )
         self.identity = meta
         self.params_step = meta.get("params_step")
         self.warmup_compiles = int(meta.get("warmup_compiles", 0))
@@ -320,11 +366,85 @@ class RemoteDecodeWorker:
             pages_per_slot=int(meta["pages_per_slot"]),
             kv_dtype=str(meta.get("kv_dtype", "float32")),
         ))
-        sock.settimeout(self._send_timeout)
         self._sock = sock
+        self._last_rx = time.monotonic()
+        self._start_io(sock)
+
+    def _connect_once(self, *, retries: int,
+                      hello_timeout: float) -> tuple:
+        """One connect + HELLO handshake. Typed on every failure; the
+        in-flight socket is tracked in `_connecting_sock` so a racing
+        close() aborts it rather than leaking it."""
+        host, _, port = self.peer_addr.rpartition(":")
+        last_err: Optional[Exception] = None
+        sock = None
+        for attempt in range(retries + 1):
+            if self._stop.is_set():
+                raise WorkerLostError(
+                    f"decode host {self.peer_addr}: proxy closing")
+            try:
+                sock = socket_mod.create_connection(
+                    (host, int(port)), timeout=self._connect_timeout
+                )
+                break
+            except OSError as e:
+                last_err = e
+                self.transport.net_counters["connect_retries"] += 1
+                self._stop.wait(min(0.25 * (attempt + 1), 2.0))
+        else:
+            raise WorkerLostError(
+                f"decode host {self.peer_addr} unreachable after "
+                f"{retries} retries: {last_err}"
+            )
+        self._connecting_sock = sock
+        if self._stop.is_set():
+            sock.close()
+            self._connecting_sock = None
+            raise WorkerLostError(
+                f"decode host {self.peer_addr}: proxy closing")
+        self.transport.net_counters["connects"] += 1
+        sock.setsockopt(socket_mod.IPPROTO_TCP, socket_mod.TCP_NODELAY, 1)
+        sock = chaosnet.maybe_wrap(sock, "front")
+        self._connecting_sock = sock
+        sock.settimeout(hello_timeout)
+        try:
+            ftype, meta, _ = recv_frame(sock)
+        except (OSError, ConnectionError) as e:
+            sock.close()
+            self._connecting_sock = None
+            raise WorkerLostError(
+                f"decode host {self.peer_addr} died during handshake: {e}"
+            ) from e
+        if ftype != HELLO:
+            sock.close()
+            self._connecting_sock = None
+            raise HandoffRefusedError(
+                f"decode host {self.peer_addr} opened with frame type "
+                f"{ftype}, expected HELLO"
+            )
+        if (self._expected_head is not None
+                and meta.get("head") != self._expected_head):
+            sock.close()
+            self._connecting_sock = None
+            raise HandoffRefusedError(
+                f"decode host {self.peer_addr} serves head "
+                f"{meta.get('head')!r}, this pool needs "
+                f"{self._expected_head!r}"
+            )
+        sock.settimeout(self._send_timeout)
+        self._connecting_sock = None
+        return sock, meta
+
+    def _start_io(self, sock) -> None:
+        """Spawn this epoch's sender/reader pair, pinned to the current
+        incarnation — a stale epoch's threads exit on their own when
+        they notice the bump."""
+        inc = self.incarnation
+        self._threads = [t for t in self._threads if t.is_alive()]
         for fn, name in ((self._send_loop, "send"), (self._recv_loop, "recv")):
             t = threading.Thread(
-                target=fn, name=f"disagg-net-{name}-{self.peer_addr}",
+                target=fn, args=(sock, inc),
+                name=f"disagg-net-{name}-{self.peer_addr}",
                 daemon=True,
             )
             t.start()
@@ -338,8 +458,9 @@ class RemoteDecodeWorker:
         """Graceful: ask the peer to drain and exit (and let the send
         thread actually flush the SHUTDOWN frame), then tear down the
         threads/socket. Safe to call twice."""
-        if self._sock is not None and not self.dead:
-            self._send_q.put((SHUTDOWN, {}, b"", None))
+        if (self._sock is not None and not self.dead
+                and not self._reconnecting):
+            self._send_q.put((SHUTDOWN, {}, b"", None, self.incarnation))
             deadline = time.monotonic() + min(timeout, 2.0)
             while (not self._send_q.empty() and not self.dead
                    and time.monotonic() < deadline):
@@ -354,6 +475,17 @@ class RemoteDecodeWorker:
     def _shutdown(self, timeout: float = 2.0) -> None:
         self._stop.set()
         self._send_q.put(None)  # unblock the sender
+        # A close racing a reconnect: abort the attempt's in-flight
+        # connect socket so the backoff thread can neither leak it nor
+        # install it after this proxy is gone (it re-checks _stop under
+        # _reconnect_lock before installing).
+        cs = self._connecting_sock
+        if cs is not None:
+            try:
+                cs.close()
+            except OSError:
+                pass
+            self._connecting_sock = None
         for t in self._threads:
             t.join(timeout)
         self._threads = []
@@ -376,7 +508,7 @@ class RemoteDecodeWorker:
 
     @property
     def free_slots(self) -> int:
-        if self.dead:
+        if self.dead or self._reconnecting:
             return 0
         return max(self.max_slots - len(self._outstanding), 0)
 
@@ -384,12 +516,16 @@ class RemoteDecodeWorker:
     def idle(self) -> bool:
         return not self._outstanding
 
+    @property
+    def reconnecting(self) -> bool:
+        return self._reconnecting
+
     def occupancy(self) -> float:
         total = self.max_slots or 1
         return round(len(self._outstanding) / total, 4)
 
     def headroom(self) -> float:
-        if self.dead or self.draining:
+        if self.dead or self.draining or self._reconnecting:
             return -1.0
         return round(self.free_slots / (self.max_slots or 1), 4)
 
@@ -472,7 +608,10 @@ class RemoteDecodeWorker:
         self._outstanding[seq] = (flight, int(handoff.n_tokens),
                                   time.monotonic())
         self.transport.in_flight_frames += 1
-        self._send_q.put((HANDOFF, meta, wire, flight.trace))
+        if flight.trace is not None:
+            self._last_handoff_trace = flight.trace
+        self._send_q.put((HANDOFF, meta, wire, flight.trace,
+                          self.incarnation))
         self.transport.release(handoff)  # frame owns the bytes now
         self.admitted += 1
         self.metrics.record_admit(1)
@@ -482,22 +621,84 @@ class RemoteDecodeWorker:
         """Drain receipts on the front's runtime thread — RESULTs
         resolve futures, REFUSEDs fail them typed, STATS refresh the
         peer snapshot. Also keeps a low-rate STATS_REQ heartbeat going
-        so peer counters stay fresh without a per-request round trip."""
+        so peer counters stay fresh without a per-request round trip,
+        and enforces the liveness deadline: a connected peer that has
+        answered NOTHING (heartbeats included) for liveness_timeout
+        seconds is hung, and hung means reconnect."""
         progressed = False
         while True:
             try:
-                ftype, meta, payload = self._inbox.get_nowait()
+                ftype, meta, payload, inc = self._inbox.get_nowait()
             except queue.Empty:
                 break
-            progressed |= self._dispatch(ftype, meta, payload)
+            progressed |= self._dispatch(ftype, meta, payload, inc)
         now = time.monotonic()
-        if (not self.dead and self._sock is not None
-                and now >= self._stats_next):
+        if now - self._last_step > 1.0:
+            # The FRONT went quiet (nobody pumped this proxy), not the
+            # peer — reset the rx clock instead of reading the gap as a
+            # peer hang.
+            self._last_rx = now
+        self._last_step = now
+        if (not self.dead and not self._reconnecting
+                and self._sock is not None and now >= self._stats_next):
             self._stats_next = now + 0.25
-            self._send_q.put((STATS_REQ, {}, b"", None))
+            self._send_q.put((STATS_REQ, {}, b"", None, self.incarnation))
+        if (self._liveness_timeout > 0 and not self.dead
+                and not self._reconnecting and self._sock is not None
+                and now - self._last_rx > self._liveness_timeout):
+            silent = now - self._last_rx
+            self.transport.net_counters["heartbeat_misses"] += 1
+            self._flight.record(
+                "peer_hung", peer=self.peer_addr, worker=self.worker_id,
+                silent_s=round(silent, 3),
+                outstanding=len(self._outstanding),
+            )
+            self._log.warning(
+                f"disagg: decode host {self.peer_addr} hung — no frames "
+                f"for {silent:.1f}s (liveness deadline "
+                f"{self._liveness_timeout}s) with "
+                f"{len(self._outstanding)} outstanding"
+            )
+            self._begin_reconnect(
+                "liveness",
+                TimeoutError(
+                    f"no frames from {self.peer_addr} in {silent:.1f}s"),
+                self.incarnation,
+            )
         return progressed
 
-    def _dispatch(self, ftype: int, meta: dict, payload: bytes) -> bool:
+    def take_stranded(self) -> list[Flight]:
+        """Runtime thread: collect the flights whose connection epoch
+        died under them (their KV pages are unreachable behind the old
+        connection — the host orphans them on disconnect). The front's
+        pump re-submits each through the prefill path, riding the same
+        at-most-once ledger as a worker death."""
+        if not self._strand_pending:
+            return []
+        self._strand_pending = False
+        stranded = [fl for (fl, _n, _t) in self._outstanding.values()
+                    if not fl.fut.done()]
+        self.transport.in_flight_frames = max(
+            0, self.transport.in_flight_frames - len(self._outstanding))
+        self._outstanding.clear()
+        return stranded
+
+    def _dispatch(self, ftype: int, meta: dict, payload: bytes,
+                  inc: Optional[int] = None) -> bool:
+        if inc is not None and inc != self.incarnation:
+            # A stale epoch's reader delivered this after the reconnect
+            # bumped the incarnation: the flight it answers was already
+            # stranded and re-submitted, so resolving from it would be
+            # a double-finalize. Discard, counted.
+            if ftype in (RESULT, REFUSED):
+                self.transport.net_counters["incarnation_discards"] += 1
+                self._log.info(
+                    f"disagg: discarding stale incarnation-{inc} frame "
+                    f"(type {ftype}, seq {meta.get('seq')}) from "
+                    f"{self.peer_addr} (now incarnation "
+                    f"{self.incarnation})"
+                )
+            return False
         if ftype == STATS:
             self._peer_stats = meta
             self._stats_gen += 1
@@ -563,10 +764,10 @@ class RemoteDecodeWorker:
         """Round-trip a STATS_REQ (drain/CI path: the final "0 recompiles
         / pools clean / sockets closed" reads must be FRESH, not the
         heartbeat's last sample). Caller must be the scheduling thread."""
-        if self.dead or self._sock is None:
+        if self.dead or self._reconnecting or self._sock is None:
             return dict(self._peer_stats)
         gen = self._stats_gen
-        self._send_q.put((STATS_REQ, {}, b"", None))
+        self._send_q.put((STATS_REQ, {}, b"", None, self.incarnation))
         deadline = time.monotonic() + timeout
         while (self._stats_gen == gen and not self.dead
                and time.monotonic() < deadline):
@@ -591,11 +792,132 @@ class RemoteDecodeWorker:
             f"with {len(self._outstanding)} frames outstanding"
         )
 
+    # -- self-healing --------------------------------------------------------
+
+    def _begin_reconnect(self, where: str, err: Exception,
+                         inc: int) -> None:
+        """First stop on any connection error: open a new epoch and try
+        to get the peer back before declaring it dead. Idempotent per
+        epoch — send thread, recv thread and the liveness check can all
+        report the same loss; exactly one wins."""
+        with self._reconnect_lock:
+            if (self.dead or self._stop.is_set() or self._reconnecting
+                    or inc != self.incarnation):
+                return
+            if self._reconnect_max <= 0:
+                self._on_peer_lost(where, err)
+                return
+            self._reconnecting = True
+            self.incarnation += 1
+            self._strand_pending = True
+            # Fresh epoch, fresh send queue: the dying epoch's sender
+            # must never pick up a frame admitted for the new one and
+            # push it down its own (dead) socket — that frame would be
+            # silently lost with its flight still ledgered, and the
+            # caller would hang to its timeout.
+            self._send_q = queue.Queue()
+        self._log.warning(
+            f"disagg: decode host {self.peer_addr} connection lost "
+            f"({where}: {err}) — reconnecting (incarnation "
+            f"{self.incarnation}, budget {self._reconnect_max})"
+        )
+        t = threading.Thread(
+            target=self._reconnect_loop, args=(where, err),
+            name=f"disagg-net-reconnect-{self.peer_addr}", daemon=True,
+        )
+        t.start()
+        self._threads.append(t)
+
+    def _same_identity(self, meta: dict) -> bool:
+        ident = self.identity or {}
+        return all(
+            meta.get(k) == ident.get(k)
+            for k in ("head", "layout", "kv_dtype", "params_step",
+                      "catalog_version")
+        )
+
+    def _record_reconnect_span(self, ctx, t0: float, attempt: int,
+                               ok: bool) -> None:
+        if ctx is None or not self.tracer.enabled:
+            return
+        self.tracer.record_span(
+            "handoff_network", ctx.trace_id, t0, time.monotonic(),
+            parent_id=ctx.parent_span_id, side="reconnect",
+            attempt=attempt, ok=ok, peer=self.peer_addr,
+            component="disagg_front", worker=self.worker_id,
+        )
+
+    def _reconnect_loop(self, where: str, err: Exception) -> None:
+        old, self._sock = self._sock, None
+        if old is not None:
+            try:
+                old.close()
+            except OSError:
+                pass
+        last_err: Exception = err
+        ctx = self._last_handoff_trace
+        for attempt in range(self._reconnect_max):
+            delay = min(self._reconnect_cap,
+                        self._reconnect_base * (2 ** attempt))
+            delay *= 0.5 + 0.5 * self._jitter.random()
+            if self._stop.wait(delay):
+                self._reconnecting = False
+                return  # closing: close() owns the teardown, no loss event
+            t0 = time.monotonic()
+            try:
+                sock, meta = self._connect_once(
+                    retries=0,
+                    hello_timeout=min(self._hello_timeout, 30.0),
+                )
+            except (WorkerLostError, HandoffRefusedError, OSError,
+                    ConnectionError) as e:
+                last_err = e
+                self._record_reconnect_span(ctx, t0, attempt, ok=False)
+                if self._stop.is_set():
+                    self._reconnecting = False
+                    return
+                continue
+            if not self._same_identity(meta):
+                sock.close()
+                self._reconnecting = False
+                self._on_peer_lost("reconnect", HandoffRefusedError(
+                    f"decode host {self.peer_addr} came back with a "
+                    f"different identity (params/catalog/layout) — "
+                    "refusing to resume against it"
+                ))
+                return
+            self._record_reconnect_span(ctx, t0, attempt, ok=True)
+            with self._reconnect_lock:
+                if self._stop.is_set():
+                    sock.close()
+                    self._reconnecting = False
+                    return
+                self._sock = sock
+                self._last_rx = time.monotonic()
+                self._reconnecting = False
+            self.transport.net_counters["reconnects"] += 1
+            self._flight.record(
+                "peer_reconnected", peer=self.peer_addr,
+                worker=self.worker_id, attempts=attempt + 1,
+                incarnation=self.incarnation, where=where,
+            )
+            self._log.warning(
+                f"disagg: decode host {self.peer_addr} reconnected "
+                f"(attempt {attempt + 1}, incarnation {self.incarnation})"
+            )
+            self._start_io(sock)
+            return
+        # Budget exhausted: the existing terminal path (front reaps the
+        # dead proxy; anything still outstanding re-submits typed).
+        self._reconnecting = False
+        self._on_peer_lost(where, last_err)
+
     def kill(self) -> list[Flight]:
         """Reap: every accepted-unresolved flight is stranded (its KV
         lives in the dead process). The front re-submits each typed,
         at most once — `DecodeWorker.kill`'s contract, across the wire."""
         self.dead = True
+        self._strand_pending = False
         stranded = []
         for seq, (flight, _n, _t) in list(self._outstanding.items()):
             if not flight.fut.done():
@@ -608,20 +930,36 @@ class RemoteDecodeWorker:
 
     # -- I/O threads ---------------------------------------------------------
 
-    def _send_loop(self) -> None:
-        while not self._stop.is_set():
+    def _send_loop(self, sock, inc: int) -> None:
+        # This epoch's queue, captured at entry: a reconnect swaps in a
+        # fresh queue for the new epoch, so frames admitted after the
+        # swap can never be consumed here and pushed down THIS (dead)
+        # socket — the silent-loss race the chaos bench caught.
+        q = self._send_q
+        while not self._stop.is_set() and inc == self.incarnation:
             try:
-                item = self._send_q.get(timeout=0.1)
+                item = q.get(timeout=0.1)
             except queue.Empty:
                 continue
             if item is None:
                 break
-            ftype, meta, payload, trace = item
+            ftype, meta, payload, trace, item_inc = item
+            if item_inc != inc:
+                if item_inc > inc:
+                    # Admit raced the queue swap and landed a new-epoch
+                    # frame in this epoch's queue: hand it to the live
+                    # sender instead of the dead socket.
+                    self._send_q.put(item)
+                    break
+                # Queued before a reconnect: its flight was stranded and
+                # re-submitted through prefill — sending the stale frame
+                # would make the host decode work nobody can claim.
+                continue
             t0 = time.monotonic()
             try:
-                nbytes = send_frame(self._sock, ftype, meta, payload)
+                nbytes = send_frame(sock, ftype, meta, payload)
             except (OSError, ConnectionError) as e:
-                self._on_peer_lost("send", e)
+                self._begin_reconnect("send", e, inc)
                 break
             t1 = time.monotonic()
             if ftype == HANDOFF:
@@ -636,14 +974,38 @@ class RemoteDecodeWorker:
                         peer=self.peer_addr, transfer_bytes=nbytes,
                         component="disagg_front", worker=self.worker_id,
                     )
+        # Exiting (incarnation bump, stop, or error): frames meant for a
+        # NEWER epoch must survive this epoch's death — forward them.
+        leftovers = []
+        while True:
+            try:
+                item = q.get_nowait()
+            except queue.Empty:
+                break
+            if item is not None and item[4] > inc:
+                leftovers.append(item)
+        for item in leftovers:
+            self._send_q.put(item)
+            t1 = time.monotonic()
+            if ftype == HANDOFF:
+                self.transport.network_ms.record(t1 - t0)
+                if trace is not None and self.tracer.enabled:
+                    # The network hop as its own critical-path segment
+                    # (scripts/trace_report.py SEGMENT_OF), attributed
+                    # to the peer that received it.
+                    self.tracer.record_span(
+                        "handoff_network", trace.trace_id, t0, t1,
+                        parent_id=trace.parent_span_id, side="send",
+                        peer=self.peer_addr, transfer_bytes=nbytes,
+                        component="disagg_front", worker=self.worker_id,
+                    )
 
-    def _recv_loop(self) -> None:
+    def _recv_loop(self, sock, inc: int) -> None:
         # select-gated: the blocking read only STARTS once bytes exist,
         # so the socket's timeout bounds per-chunk stalls mid-frame (a
         # genuine peer hang) without a between-frames idle timeout ever
         # firing mid-read and desyncing the stream.
-        sock = self._sock
-        while not self._stop.is_set():
+        while not self._stop.is_set() and inc == self.incarnation:
             try:
                 readable, _, _ = select.select([sock], [], [], 0.05)
             except (OSError, ValueError):
@@ -654,9 +1016,10 @@ class RemoteDecodeWorker:
                 frame = recv_frame(sock)
             except (OSError, ConnectionError, ValueError) as e:
                 if not self._stop.is_set():
-                    self._on_peer_lost("recv", e)
+                    self._begin_reconnect("recv", e, inc)
                 break
-            self._inbox.put(frame)
+            self._last_rx = time.monotonic()
+            self._inbox.put((frame[0], frame[1], frame[2], inc))
             if frame[0] == BYE:
                 break  # graceful close: the EOF behind it is not a loss
 
@@ -675,6 +1038,8 @@ class RemoteDecodeWorker:
             "in_flight_frames": len(self._outstanding),
             "warmup_compiles": self.warmup_compiles,
             "recompilations": self.recompilations,
+            "incarnation": self.incarnation,
+            "reconnecting": self._reconnecting,
             "peer": peer,
         }
 
@@ -708,33 +1073,65 @@ def _resolve_factory(spec: str):
 
 
 class _HostFlights:
-    """The host's in-flight ledger: seq -> Flight, plus the pending
-    deque for handoffs that validated but found no free slot (retried
-    every loop pass — the front's pending semantics, host-side)."""
+    """One connection's in-flight ledger: seq -> Flight, plus the
+    pending deque for handoffs that validated but found no free slot
+    (retried every loop pass — the front's pending semantics,
+    host-side). Per-CONNECTION because each front numbers its seqs from
+    zero: two fronts' seq spaces must never collide in one dict."""
 
     def __init__(self):
         self.flights: dict[int, Flight] = {}
         self.pending: list[tuple[int, Flight, KVHandoff]] = []
 
 
+class _HostConn:
+    """One accepted front connection: its socket, its seq ledger, and
+    its own drain state (a SHUTDOWN drains THIS front's flights; other
+    fronts keep serving)."""
+
+    __slots__ = ("sock", "peer", "cid", "ledger", "draining")
+
+    def __init__(self, sock, peer, cid: int):
+        self.sock = sock
+        self.peer = peer
+        self.cid = cid
+        self.ledger = _HostFlights()
+        self.draining = False
+
+
 def serve_decode_host(factory: str, *, host: str = "127.0.0.1",
                       port: int = 0, worker_id: str = "remote-d0",
                       announce=None, idle_timeout: Optional[float] = None,
+                      persist: bool = False,
                       logger: Optional[logging.Logger] = None) -> dict:
     """Run one decode worker as a network peer (the child-process
     entrypoint behind ``python -m genrec_tpu.disagg.net``).
 
     Binds + announces the port FIRST (``GENREC_DECODE_PORT <n>`` on
     stdout — `spawn_decode_host` reads it), then builds and warms the
-    real `DecodeWorker` from the factory, then accepts the front's
-    connection; the front's connect/HELLO timeouts ride out warmup.
-    Serves until SHUTDOWN (drain + BYE) or peer disconnect. Returns the
-    final stats dict (useful when called in-process by tests)."""
+    real `DecodeWorker` from the factory, then serves an ACCEPT LOOP:
+    the warmed worker/pool outlive any one front, so the host survives
+    a front disconnect, accepts its reconnect, and serves several
+    fronts concurrently (each connection gets its own HELLO and its own
+    seq ledger). An abruptly-dropped front's resident flights are
+    orphaned — they finish decoding and free their slots, their results
+    discarded (the front re-submits through prefill on its side).
+
+    Exits after the LAST connected front completes a graceful SHUTDOWN
+    (drain + final STATS + BYE); with ``persist=True`` it instead keeps
+    listening until the process is signalled — the long-lived standby /
+    multi-front mode. Returns the final stats dict (useful when called
+    in-process by tests)."""
     log = logger or logging.getLogger("genrec_tpu")
+    from genrec_tpu.core import chaos as chaos_mod
+
+    # A spawned host installs its network-fault schedule from the env
+    # (it cannot enter the parent's `chaos.inject` block).
+    chaos_mod.install_net_plan_from_env()
     srv = socket_mod.socket(socket_mod.AF_INET, socket_mod.SOCK_STREAM)
     srv.setsockopt(socket_mod.SOL_SOCKET, socket_mod.SO_REUSEADDR, 1)
     srv.bind((host, port))
-    srv.listen(1)
+    srv.listen(8)
     bound_port = srv.getsockname()[1]
     import sys
 
@@ -785,19 +1182,60 @@ def serve_decode_host(factory: str, *, host: str = "127.0.0.1",
     }
     srv.settimeout(idle_timeout)
     try:
-        conn, peer = srv.accept()
+        conn0, peer0 = srv.accept()
     except socket_mod.timeout:
         srv.close()
         raise TimeoutError("no front connected before idle_timeout")
-    conn.setsockopt(socket_mod.IPPROTO_TCP, socket_mod.TCP_NODELAY, 1)
-    conn.settimeout(60.0)  # per-chunk bound once a frame has started
-    send_frame(conn, HELLO, hello)
-    log.info(f"disagg host {worker_id}: serving {head.name} to {peer}")
+    srv.settimeout(None)
 
-    ledger = _HostFlights()
-    draining = False
+    conns: dict[int, _HostConn] = {}
+    next_cid = [0]
+    # Flights whose front dropped without a SHUTDOWN: they finish
+    # decoding (freeing their slots/pages), their results discarded.
+    orphans: list[Flight] = []
 
-    def _host_stats() -> dict:
+    def _attach(raw, peer) -> None:
+        raw.setsockopt(socket_mod.IPPROTO_TCP, socket_mod.TCP_NODELAY, 1)
+        sock = chaosnet.maybe_wrap(raw, "host")
+        sock.settimeout(60.0)  # per-chunk bound once a frame has started
+        try:
+            send_frame(sock, HELLO, hello)
+        except (OSError, ConnectionError):
+            try:
+                sock.close()
+            except OSError:
+                pass
+            return
+        c = _HostConn(sock, peer, next_cid[0])
+        next_cid[0] += 1
+        conns[c.cid] = c
+        log.info(
+            f"disagg host {worker_id}: serving {head.name} to {peer} "
+            f"(conn {c.cid})"
+        )
+
+    def _drop(c: _HostConn, why: str) -> None:
+        """Abrupt loss of one front: orphan its resident flights, free
+        its never-admitted pending handoffs, close the socket — and
+        keep serving everyone else."""
+        if c.cid not in conns:
+            return
+        del conns[c.cid]
+        for fl in c.ledger.flights.values():
+            orphans.append(fl)
+        for _seq, _fl, h in c.ledger.pending:
+            transport.release(h)
+        try:
+            c.sock.close()
+        except OSError:
+            pass
+        log.warning(
+            f"disagg host {worker_id}: front (conn {c.cid}) dropped "
+            f"({why}) — {len(c.ledger.flights)} flights orphaned, "
+            f"serving {len(conns)} remaining front(s)"
+        )
+
+    def _host_stats(draining: bool) -> dict:
         return _jsonable({
             **worker.stats(),
             "pool": {
@@ -807,112 +1245,173 @@ def serve_decode_host(factory: str, *, host: str = "127.0.0.1",
                 "kv_tokens_resident": int(pool.seq_lens.sum()),
             },
             "transport": transport.stats(),
-            "pending": len(ledger.pending),
-            "in_flight": len(ledger.flights),
+            "pending": sum(len(c.ledger.pending) for c in conns.values()),
+            "in_flight": (sum(len(c.ledger.flights)
+                              for c in conns.values()) + len(orphans)),
+            "fronts": len(conns),
+            "orphaned": len(orphans),
             "draining": draining,
         })
 
-    def _try_admit(seq: int, fl: Flight, h: KVHandoff) -> bool:
+    def _try_admit(c: _HostConn, seq: int, fl: Flight,
+                   h: KVHandoff) -> bool:
         try:
             worker.validate(h)
             ok = worker.admit(fl, h)
         except Exception as e:  # noqa: BLE001 — refuse THIS seq typed
             transport.release(h)
-            send_frame(conn, REFUSED, {
+            send_frame(c.sock, REFUSED, {
                 "seq": seq, "error": str(e),
                 "etype": type(e).__name__,
             })
             return True
         if not ok:
             return False
-        ledger.flights[seq] = fl
+        c.ledger.flights[seq] = fl
         return True
 
+    def _handle_frame(c: _HostConn, ftype: int, meta: dict,
+                      payload: bytes) -> None:
+        if ftype == HANDOFF:
+            h, _k, _v = unpack_handoff(payload)
+            r = meta["req"]
+            req = Request(
+                head=r["head"],
+                history=np.asarray(r["history"], np.int64),
+                user_id=int(r["user_id"]),
+                timestamps=(np.asarray(r["timestamps"])
+                            if r.get("timestamps") is not None
+                            else None),
+                trace=h.trace,
+            )
+            fl = Flight(req)
+            if not _try_admit(c, meta["seq"], fl, h):
+                c.ledger.pending.append((meta["seq"], fl, h))
+        elif ftype == STATS_REQ:
+            send_frame(c.sock, STATS, _host_stats(c.draining))
+        elif ftype == SHUTDOWN:
+            c.draining = True
+
+    def _ship_receipts(c: _HostConn) -> None:
+        for seq, fl in list(c.ledger.flights.items()):
+            if not fl.fut.done():
+                continue
+            del c.ledger.flights[seq]
+            exc = fl.fut.exception()
+            if exc is not None:
+                send_frame(c.sock, REFUSED, {
+                    "seq": seq, "error": str(exc),
+                    "etype": type(exc).__name__,
+                })
+                continue
+            resp = fl.fut.result()
+            buf = io.BytesIO()
+            arrays = {"items": np.asarray(resp.items),
+                      "scores": np.asarray(resp.scores)}
+            if resp.sem_ids is not None:
+                arrays["sem_ids"] = np.asarray(resp.sem_ids)
+            np.savez(buf, **arrays)
+            send_frame(c.sock, RESULT, {
+                "seq": seq,
+                "head": resp.head,
+                "params_step": resp.params_step,
+                "catalog_version": resp.catalog_version,
+                "bucket": list(resp.bucket),
+                "queue_wait_s": resp.queue_wait_s,
+                "compute_s": resp.compute_s,
+                "prefill_worker_id": resp.prefill_worker_id,
+                "decode_worker_id": worker_id,
+            }, buf.getvalue())
+
     final_stats: dict = {}
+    exiting = False
     try:
+        _attach(conn0, peer0)
         while True:
-            busy = bool(ledger.flights or ledger.pending)
+            busy = bool(orphans) or any(
+                c.ledger.flights or c.ledger.pending
+                for c in conns.values()
+            )
+            by_sock = {c.sock: c for c in conns.values()}
             # select-gated read: never start a blocking frame read on an
             # idle wire (a poll timeout mid-frame would desync it).
-            readable, _, _ = select.select(
-                [conn], [], [], 0.0005 if busy else 0.05)
-            frame = None
-            if readable:
+            try:
+                readable, _, _ = select.select(
+                    [srv, *by_sock], [], [], 0.0005 if busy else 0.05)
+            except (OSError, ValueError):
+                readable = []
+            for r in readable:
+                if r is srv:
+                    try:
+                        raw, peer = srv.accept()
+                    except OSError:
+                        continue
+                    _attach(raw, peer)
+                    continue
+                c = by_sock[r]
                 try:
-                    frame = recv_frame(conn)
-                except (OSError, ConnectionError):
-                    log.warning(
-                        f"disagg host {worker_id}: front disconnected")
-                    break
-            if frame is not None:
-                ftype, meta, payload = frame
-                if ftype == HANDOFF:
-                    h, _k, _v = unpack_handoff(payload)
-                    r = meta["req"]
-                    req = Request(
-                        head=r["head"],
-                        history=np.asarray(r["history"], np.int64),
-                        user_id=int(r["user_id"]),
-                        timestamps=(np.asarray(r["timestamps"])
-                                    if r.get("timestamps") is not None
-                                    else None),
-                        trace=h.trace,
-                    )
-                    fl = Flight(req)
-                    if not _try_admit(meta["seq"], fl, h):
-                        ledger.pending.append((meta["seq"], fl, h))
-                elif ftype == STATS_REQ:
-                    send_frame(conn, STATS, _host_stats())
-                elif ftype == SHUTDOWN:
-                    draining = True
+                    ftype, meta, payload = recv_frame(r)
+                    _handle_frame(c, ftype, meta, payload)
+                except (OSError, ConnectionError, ValueError) as e:
+                    _drop(c, str(e))
             # Pending handoffs retry as slots free up (front semantics).
-            still = []
-            for seq, fl, h in ledger.pending:
-                if not _try_admit(seq, fl, h):
-                    still.append((seq, fl, h))
-            ledger.pending = still
+            for c in list(conns.values()):
+                still = []
+                send_err = None
+                for seq, fl, h in c.ledger.pending:
+                    try:
+                        if not _try_admit(c, seq, fl, h):
+                            still.append((seq, fl, h))
+                    except (OSError, ConnectionError) as e:
+                        send_err = e
+                        transport.release(h)
+                c.ledger.pending = still
+                if send_err is not None:
+                    _drop(c, str(send_err))
             worker.step()
-            # Ship every finished flight's receipt.
-            for seq, fl in list(ledger.flights.items()):
-                if not fl.fut.done():
+            orphans = [fl for fl in orphans if not fl.fut.done()]
+            # Ship every finished flight's receipt to its OWN front.
+            for c in list(conns.values()):
+                try:
+                    _ship_receipts(c)
+                except (OSError, ConnectionError) as e:
+                    _drop(c, str(e))
                     continue
-                del ledger.flights[seq]
-                exc = fl.fut.exception()
-                if exc is not None:
-                    send_frame(conn, REFUSED, {
-                        "seq": seq, "error": str(exc),
-                        "etype": type(exc).__name__,
-                    })
-                    continue
-                resp = fl.fut.result()
-                buf = io.BytesIO()
-                arrays = {"items": np.asarray(resp.items),
-                          "scores": np.asarray(resp.scores)}
-                if resp.sem_ids is not None:
-                    arrays["sem_ids"] = np.asarray(resp.sem_ids)
-                np.savez(buf, **arrays)
-                send_frame(conn, RESULT, {
-                    "seq": seq,
-                    "head": resp.head,
-                    "params_step": resp.params_step,
-                    "catalog_version": resp.catalog_version,
-                    "bucket": list(resp.bucket),
-                    "queue_wait_s": resp.queue_wait_s,
-                    "compute_s": resp.compute_s,
-                    "prefill_worker_id": resp.prefill_worker_id,
-                    "decode_worker_id": worker_id,
-                }, buf.getvalue())
-            if draining and not ledger.flights and not ledger.pending:
-                pool.release_scratch()
-                final_stats = _host_stats()
-                send_frame(conn, STATS, final_stats)
-                send_frame(conn, BYE, {})
+                if (c.draining and not c.ledger.flights
+                        and not c.ledger.pending):
+                    last = len(conns) == 1 and not persist
+                    if last and orphans:
+                        continue  # orphans still hold slots: drain them
+                    if last:
+                        pool.release_scratch()
+                        stats_out = final_stats = _host_stats(True)
+                    else:
+                        stats_out = _host_stats(True)
+                    try:
+                        send_frame(c.sock, STATS, stats_out)
+                        send_frame(c.sock, BYE, {})
+                    except (OSError, ConnectionError):
+                        pass
+                    del conns[c.cid]
+                    try:
+                        c.sock.close()
+                    except OSError:
+                        pass
+                    log.info(
+                        f"disagg host {worker_id}: front (conn {c.cid}) "
+                        "drained and closed"
+                    )
+                    if last:
+                        exiting = True
+            if exiting and not conns:
                 break
     finally:
-        try:
-            conn.close()
-        finally:
-            srv.close()
+        for c in list(conns.values()):
+            try:
+                c.sock.close()
+            except OSError:
+                pass
+        srv.close()
     log.info(f"disagg host {worker_id}: drained, exiting")
     return final_stats
 
@@ -920,6 +1419,7 @@ def serve_decode_host(factory: str, *, host: str = "127.0.0.1",
 def spawn_decode_host(factory: str, *, host: str = "127.0.0.1",
                       worker_id: str = "remote-d0",
                       env: Optional[dict] = None,
+                      persist: bool = False,
                       startup_timeout: float = 120.0):
     """Launch `serve_decode_host` in a fresh OS process and return
     ``(Popen, "host:port")`` once the child announces its port. ``env``
@@ -931,7 +1431,7 @@ def spawn_decode_host(factory: str, *, host: str = "127.0.0.1",
     import sys
 
     cfg = {"factory": factory, "host": host, "port": 0,
-           "worker_id": worker_id}
+           "worker_id": worker_id, "persist": persist}
     full_env = dict(os.environ)
     full_env.update(env or {})
     # The child must resolve genrec_tpu the same way the parent did.

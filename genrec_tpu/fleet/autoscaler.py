@@ -106,7 +106,8 @@ class Autoscaler:
             # under which the router surfaces OverloadError), or deaths
             # dropped the fleet below its floor — backfill after a kill
             # rides the same hysteresis clock.
-            breaching = alive < cfg.min_replicas or (
+            backfill = alive < cfg.min_replicas
+            breaching = backfill or (
                 alive > 0 and all(r["shedding"] for r in replicas.values())
             )
             idle = alive > 0 and all(
@@ -136,7 +137,16 @@ class Autoscaler:
                 self._idle_since = None
                 return None
         if action == "scale_out":
-            return self._scale_out(alive, fake_now)
+            # A backfill (deaths took the pool below its floor — a lost
+            # decode host, a crashed replica) is operationally distinct
+            # from capacity scale-out: the post-mortem should show WHY
+            # capacity was added, and a standby promotion that replaces
+            # a dead remote host reads differently from chasing load.
+            return self._scale_out(
+                alive, fake_now,
+                reason=("dead_replica_backfill" if backfill
+                        else "sustained fleet-wide shed"),
+            )
         return self._scale_in(replicas, alive, fake_now)
 
     def _start_cooldown(self, fake_now: Optional[float]) -> None:
@@ -152,7 +162,9 @@ class Autoscaler:
             self._cooldown_until = end + self.config.cooldown_s
 
     def _scale_out(self, alive: int,
-                   fake_now: Optional[float] = None) -> Optional[str]:
+                   fake_now: Optional[float] = None,
+                   reason: str = "sustained fleet-wide shed",
+                   ) -> Optional[str]:
         t0 = time.monotonic()
         try:
             rid = self.router.add_replica()
@@ -167,10 +179,10 @@ class Autoscaler:
             self.last_warmup_s = round(warmup_s, 3)
         self._flight.record(
             "scale_out", replica_id=rid, warmup_s=round(warmup_s, 3),
-            n_replicas=alive + 1, reason="sustained fleet-wide shed",
+            n_replicas=alive + 1, reason=reason,
         )
         self._log.warning(
-            f"fleet: scale-OUT -> {rid} (fleet was saturated; warmup "
+            f"fleet: scale-OUT -> {rid} ({reason}; warmup "
             f"{warmup_s:.2f}s, now {alive + 1} replicas)"
         )
         return "scale_out"

@@ -47,6 +47,11 @@ _COUNTER_LEAVES = frozenset({
     # serialize_ms/network_ms percentile leaves stay gauges.
     "frames_sent", "frames_admitted", "frames_refused", "wire_bytes",
     "receipts", "connects", "connect_retries", "peer_losses",
+    # Socket-tier self-healing totals (disagg/net.py reconnect machinery
+    # + front.py degraded mode); the `reconnecting` / `degraded_heads`
+    # leaves stay gauges.
+    "reconnects", "heartbeat_misses", "incarnation_discards",
+    "degraded_entered", "degraded_exited",
     # Speculative tree decode (genrec_spec_<head>_*): invocation/drafted/
     # accepted/slot-step totals; codes_per_invocation stays a gauge.
     "spec_steps", "drafted", "accepted", "slot_steps",

@@ -111,6 +111,18 @@ GATED_METRICS: dict[str, tuple[str, float]] = {
     # machine, the number that must hold when the peer is a real host).
     "serve/crosshost/handoff_p50_ms": ("lower", 60.0),
     "serve/crosshost/qps_vs_colocated": ("higher", 40.0),
+    # Chaos-hardened cross-host serving (PR 18): the same socket tier
+    # through a seeded network-fault schedule. qps_under_faults_vs_clean
+    # is a same-run same-backend ratio (the throughput tax of the
+    # self-healing machinery actually firing: CRC trip -> reconnect ->
+    # re-submit mid-trace, plus latency jitter) — but both numerator and
+    # denominator are saturated-CPU walls, so the band is wide.
+    # recovery_time_ms is submit-to-answer across a yanked decode
+    # connection (detection + backoff + handshake + re-admit + decode);
+    # scheduler noise on a shared host dominates the backoff constants,
+    # so the band is the widest in the serve section.
+    "serve/chaos/qps_under_faults_vs_clean": ("higher", 40.0),
+    "serve/chaos/recovery_time_ms": ("lower", 100.0),
     # Speculative tree decode (PR 14): codes committed per target-model
     # invocation is structural (drafter acceptance on the seeded trace —
     # tight band; the >2x acceptance bar lives in the committed

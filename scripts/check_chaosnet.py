@@ -1,0 +1,366 @@
+"""Chaos-hardened cross-host serving check (shared graftlint harness,
+genrec_tpu/analysis/ir.py — CLI, verdict JSON and rc conventions
+unchanged): does the socket tier really self-heal through the classic
+network failures without losing, duplicating, or hanging a single
+accepted request?
+
+ONE seeded fault schedule (core.chaos.ChaosPlan net_faults, injected by
+disagg/chaosnet.py at the frame boundary) through a real two-OS-process
+TIGER split, three live fault phases against one front:
+
+- **corrupt frames**: one decode host's child process carries a
+  GENREC_CHAOS_NET_PLAN env schedule that bit-flips a RESULT/STATS
+  frame on its first connection — the front's CRC32 codec fails it
+  TYPED, the proxy reconnects (new incarnation), stranded flights
+  re-submit through prefill at most once;
+- **partition/blackhole**: the parent's plan blackholes the OTHER
+  proxy's first connection send-side from frame 0 — no error ever
+  surfaces on the wire, so only the liveness deadline (peer hung, not
+  dead) can catch it: heartbeat_misses fires, the proxy reconnects,
+  phantom-admitted flights re-submit;
+- **SIGKILL + standby promotion**: kill -9 one decode host mid-batch —
+  backoff reconnect exhausts its budget fast (ECONNREFUSED), the proxy
+  dies typed, the front reaps + re-submits to the survivor, and a
+  `fleet.Autoscaler` over `role_pool("tiger", "decode")` backfills the
+  dead host from a STANDBY decode process (dead_replica_backfill).
+
+Because every fault is windowed to its connection ordinal
+(NetFault.at_conn/n_conns), the reconnect that recovers from a fault
+comes up clean — the whole run is deterministic per net_seed, and the
+zero-lost assertion is a guarantee, not a race.
+
+Asserts: zero lost accepted requests (every future resolves with a
+Response), zero duplicate finalizes (completed == submitted exactly),
+typed errors only, bounded recovery wall-time after the SIGKILL,
+zero steady-state recompiles on every surviving peer AND the front,
+answers bit-identical to a co-located engine after recovery, both
+pools (prefix retention included) clean after drain, surviving
+children exit rc 0.
+
+Run:  python scripts/check_chaosnet.py             (default shapes)
+      python scripts/check_chaosnet.py --small     (CI-speed shapes)
+Appends a verdict line to docs/PERF.md when --write-note is passed.
+Prints ONE JSON verdict line on stdout; rc 0 ok / 1 failed.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from genrec_tpu.analysis import ir  # noqa: E402
+
+
+def _shapes(small: bool):
+    if small:
+        return dict(
+            n_corpus=50,
+            arch=dict(embedding_dim=16, attn_dim=32, dropout=0.0,
+                      num_heads=4, n_layers=2, num_item_embeddings=8,
+                      num_user_embeddings=20, sem_id_dim=3),
+            ladder_args=((1, 2), (8,)), max_batch=2,
+            n_batch1=8, n_batch2=6, n_batch3=6, n_users=5,
+        )
+    return dict(
+        n_corpus=500,
+        arch=dict(embedding_dim=32, attn_dim=64, dropout=0.0, num_heads=4,
+                  n_layers=2, num_item_embeddings=32,
+                  num_user_embeddings=1000, sem_id_dim=3),
+        ladder_args=((1, 2), (8, 16)), max_batch=4,
+        n_batch1=16, n_batch2=10, n_batch3=10, n_users=8,
+    )
+
+
+def _build(small: bool):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from genrec_tpu.models.tiger import Tiger
+    from genrec_tpu.serving import BucketLadder, PagedConfig
+
+    s = _shapes(small)
+    D = s["arch"]["sem_id_dim"]
+    Kcb = s["arch"]["num_item_embeddings"]
+    ladder = BucketLadder(*s["ladder_args"])
+    max_hist = ladder.history_buckets[-1]
+    model = Tiger(**s["arch"])
+    rng = np.random.default_rng(0)
+    valid_ids = np.unique(rng.integers(0, Kcb, (s["n_corpus"], D)), axis=0)
+    B0, L0 = 2, 2 * D
+    params = model.init(
+        jax.random.key(0),
+        jnp.zeros((B0,), jnp.int32), jnp.zeros((B0, L0), jnp.int32),
+        jnp.zeros((B0, L0), jnp.int32), jnp.zeros((B0, D), jnp.int32),
+        jnp.zeros((B0, D), jnp.int32), jnp.ones((B0, L0), jnp.int32),
+    )["params"]
+    n_tok = 1 + max_hist * D
+    cfg = PagedConfig(max_slots=s["max_batch"], page_size=8,
+                      pages_per_slot=-(-n_tok // 8))
+    return model, valid_ids, params, ladder, cfg, s
+
+
+def make_decode_cfg():
+    """Decode-host factory (runs in the CHILD process; shape choice and
+    platform arrive via GENREC_CHAOSNET_* env vars the parent sets)."""
+    from genrec_tpu.serving.heads import TigerGenerativeHead
+
+    small = os.environ.get("GENREC_CHAOSNET_SMALL") == "1"
+    model, valid_ids, params, ladder, cfg, _ = _build(small)
+    return {
+        "head": TigerGenerativeHead(model, valid_ids, top_k=5),
+        "params": params,
+        "ladder": ladder,
+        "paged_config": cfg,
+        "params_step": 1,
+    }
+
+
+def _mk_reqs(rng, valid_ids, max_hist, n, n_users, histories):
+    from genrec_tpu.serving import Request
+    import numpy as np
+
+    out = []
+    for _ in range(n):
+        user = int(rng.integers(0, n_users))
+        if user not in histories or rng.random() >= 0.5:
+            histories[user] = rng.integers(
+                0, len(valid_ids), int(rng.integers(1, max_hist + 1)))
+        out.append(Request(head="tiger", history=np.asarray(histories[user]),
+                           user_id=user))
+    return out
+
+
+def _settle(futs, timeout):
+    """Resolve every future: (responses, typed_errors, lost)."""
+    from genrec_tpu.serving.types import ServingError
+
+    resps, errors, lost = [], [], 0
+    deadline = time.monotonic() + timeout
+    for f in futs:
+        try:
+            resps.append(f.result(max(deadline - time.monotonic(), 0.1)))
+        except ServingError as e:
+            errors.append(e)
+        except Exception:  # noqa: BLE001 — untyped/timeout = lost
+            lost += 1
+    return resps, errors, lost
+
+
+def main(argv=None):
+    args = ir.check_args(argv)
+
+    import jax
+
+    if args.platform:
+        from genrec_tpu.parallel.mesh import pin_platform
+
+        pin_platform(args.platform)
+
+    import numpy as np
+
+    from genrec_tpu.core import chaos
+    from genrec_tpu.core.chaos import ChaosPlan, NetFault
+    from genrec_tpu.disagg import DisaggFront, chaosnet, spawn_decode_host
+    from genrec_tpu.fleet.autoscaler import Autoscaler, AutoscalerConfig
+    from genrec_tpu.serving import ServingEngine
+    from genrec_tpu.serving.heads import TigerGenerativeHead
+
+    backend = jax.default_backend()
+    model, valid_ids, params, ladder, cfg, s = _build(args.small)
+    max_hist = ladder.history_buckets[-1]
+
+    child_env = {"GENREC_CHAOSNET_SMALL": "1" if args.small else "0"}
+    if backend == "cpu":
+        child_env["JAX_PLATFORMS"] = "cpu"
+    # Host a2 carries its own (child-side) schedule: bit-flip one frame
+    # it SENDS on its first accepted connection — the front must catch
+    # it at the codec (CRC), typed, and reconnect.
+    corrupt_env = dict(child_env)
+    corrupt_env[chaos.NET_PLAN_ENV] = chaos.net_plan_to_env(ChaosPlan(
+        net_seed=7,
+        net_faults=(NetFault(kind="corrupt", role="host", side="send",
+                             at_frame=4, n_frames=1, n_conns=1),),
+    ))
+    factory = f"{os.path.abspath(__file__)}:make_decode_cfg"
+    p1, a1 = spawn_decode_host(factory, worker_id="remote-d1",
+                               env=child_env, startup_timeout=600.0)
+    p2, a2 = spawn_decode_host(factory, worker_id="remote-d2",
+                               env=corrupt_env, startup_timeout=600.0)
+    p3, a3 = spawn_decode_host(factory, worker_id="remote-standby",
+                               env=child_env, startup_timeout=600.0)
+
+    # Parent-side schedule: blackhole the FIRST front connection (a1,
+    # connected first) send-side from frame 0 — a one-way partition no
+    # error ever surfaces for. n_conns=1 leaves every reconnect clean.
+    chaosnet.reset_conn_counts()
+    chaos.install(ChaosPlan(
+        net_seed=7,
+        net_faults=(NetFault(kind="drop", role="front", side="send",
+                             at_frame=0, n_frames=1_000_000, n_conns=1),),
+    ))
+
+    front = DisaggFront(
+        [TigerGenerativeHead(model, valid_ids, top_k=5)], params,
+        ladder=ladder, max_batch=s["max_batch"], max_wait_ms=2.0,
+        n_prefill=1, transport="socket", workers=[a1, a2],
+        standby_workers=[a3], paged_config=cfg, params_step=1,
+        remote_net=dict(liveness_timeout=1.5, reconnect_base=0.05,
+                        reconnect_cap=0.25, reconnect_seed=7),
+    ).start()
+    engine = ServingEngine(
+        [TigerGenerativeHead(model, valid_ids, top_k=5)], params,
+        ladder=ladder, max_batch=s["max_batch"], max_wait_ms=2.0,
+        handle_signals=False, paged_config=cfg, params_step=1,
+    ).start()
+
+    rng = np.random.default_rng(0)
+    histories: dict[int, np.ndarray] = {}
+    resps, errors, lost = [], [], 0
+    try:
+        # -- phase 1+2: corrupt (a2, child-injected) + partition (a1,
+        # parent-injected) fire DURING this batch; both recover live.
+        batch1 = _mk_reqs(rng, valid_ids, max_hist, s["n_batch1"],
+                          s["n_users"], histories)
+        r, e, n = _settle([front.submit(q) for q in batch1], 300)
+        resps += r
+        errors += e
+        lost += n
+        # Both faults are spent (conn-0 windows); drop the plan so the
+        # rest of the run — drain handshakes included — is clean wire.
+        chaos.install(None)
+
+        # -- phase 3: SIGKILL a1's host mid-batch; reconnect budget
+        # exhausts fast (ECONNREFUSED), the proxy dies typed, survivors
+        # absorb the re-submits; the autoscaler backfills from standby.
+        batch2 = _mk_reqs(rng, valid_ids, max_hist, s["n_batch2"],
+                          s["n_users"], histories)
+        futs2 = [front.submit(q) for q in batch2]
+        t_kill = time.monotonic()
+        p1.send_signal(signal.SIGKILL)
+        r, e, n = _settle(futs2, 300)
+        recovery_ms = (time.monotonic() - t_kill) * 1e3
+        resps += r
+        errors += e
+        lost += n
+
+        scaler = Autoscaler(front.role_pool("tiger", "decode"),
+                            AutoscalerConfig(min_replicas=2, max_replicas=3,
+                                             scale_out_after_s=0.0,
+                                             cooldown_s=0.0))
+        deadline = time.monotonic() + 120
+        while scaler.scale_outs == 0 and time.monotonic() < deadline:
+            scaler.tick()
+            time.sleep(0.05)
+
+        # -- phase 4: recovered steady state — survivor + promoted
+        # standby serve a final batch, bit-identical to co-located.
+        batch3 = _mk_reqs(rng, valid_ids, max_hist, s["n_batch3"],
+                          s["n_users"], histories)
+        r3, e, n = _settle([front.submit(q) for q in batch3], 300)
+        resps += r3
+        errors += e
+        lost += n
+        parity_ok = len(r3) == len(batch3)
+        for q, resp in zip(batch3, r3):
+            ref = engine.serve(q, timeout=300)
+            parity_ok = parity_ok and bool(
+                np.array_equal(resp.sem_ids, ref.sem_ids)
+                and np.array_equal(resp.items, ref.items)
+                and np.allclose(resp.scores, ref.scores, atol=1e-5)
+            )
+
+        group = front._groups["tiger"]
+        prefill_pool = group.prefill[0].pool
+        peers = [dw.refresh_stats(timeout=30.0)
+                 for dw in group.decode if not dw.dead]
+        final = front.stop()
+        engine.stop()
+        rc2, rc3 = p2.wait(60), p3.wait(60)
+    finally:
+        chaos.install(None)
+        for p in (p1, p2, p3):
+            p.kill()
+
+    submitted = s["n_batch1"] + s["n_batch2"] + s["n_batch3"]
+    d = final["disagg"]
+    net = d.get("transports", {}).get("socket", {}).get("network", {})
+    peer_pools = [p.get("pool", {}) for p in peers]
+
+    verdict = {
+        "backend": backend,
+        "submitted": submitted,
+        "completed": final["completed"],
+        "failed": len(errors),
+        "lost": lost,
+        "typed_only": lost == 0,
+        "reconnects": net.get("reconnects", 0),
+        "heartbeat_misses": net.get("heartbeat_misses", 0),
+        "incarnation_discards": net.get("incarnation_discards", 0),
+        "decode_worker_deaths": d["decode_worker_deaths"],
+        "degraded_entered": d["degraded_entered"],
+        "scale_outs": scaler.scale_outs,
+        "recovery_ms": round(recovery_ms, 1),
+        "recompilations_front": final["recompilations"],
+        "recompilations_peers": (sum(int(p.get("recompilations", -1))
+                                     for p in peers) if peers else -1),
+        "prefill_pages_final": prefill_pool.allocator.pages_in_use,
+        "peer_pages_final": sum(pp.get("pages_in_use", -1)
+                                for pp in peer_pools),
+        "peer_slots_final": sum(pp.get("slots_active", -1)
+                                for pp in peer_pools),
+        "parity_ok": parity_ok,
+        "child_rcs": [rc2, rc3],
+        "ok": False,
+    }
+    ok = (
+        lost == 0
+        and len(errors) == 0
+        and final["completed"] == submitted == len(resps)
+        and verdict["reconnects"] >= 2
+        and verdict["heartbeat_misses"] >= 1
+        and d["decode_worker_deaths"] == 1
+        and scaler.scale_outs == 1
+        and recovery_ms < 120_000
+        and final["recompilations"] == 0
+        and len(peers) == 2
+        and all(int(p.get("recompilations", -1)) == 0 for p in peers)
+        and prefill_pool.allocator.pages_in_use == 0
+        and all(pp.get("pages_in_use", -1) == 0 for pp in peer_pools)
+        and all(pp.get("slots_active", -1) == 0 for pp in peer_pools)
+        and parity_ok
+        and rc2 == 0
+        and rc3 == 0
+    )
+    verdict["ok"] = ok
+    ir.emit_verdict(verdict)
+
+    if args.write_note:
+        if ok:
+            msg = (
+                f"OK: {submitted} requests through a seeded "
+                "corrupt+partition+SIGKILL schedule — "
+                f"{verdict['reconnects']} reconnects, "
+                f"{verdict['heartbeat_misses']} liveness trips, 1 host "
+                f"death backfilled from standby in "
+                f"{verdict['recovery_ms']:.0f}ms, zero lost / zero "
+                "duplicates / typed-only, parity vs co-located, 0 "
+                "recompiles, pools clean"
+            )
+        else:
+            msg = ("ATTENTION: chaos schedule lost or duplicated work, "
+                   "hung, recompiled, or leaked pages/slots")
+        ir.append_perf_note(
+            f"\n- Chaosnet check (scripts/check_chaosnet.py, "
+            f"backend={backend}): {msg}\n"
+        )
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -33,6 +33,14 @@
 #                            answers bit-identical to a co-located
 #                            engine, both pools clean, child exits 0
 #                            with sockets closed
+#   check_chaosnet.py      — chaos-hardened cross-host serving: a
+#                            seeded network-fault schedule (blackhole,
+#                            corrupt frame, SIGKILL) against the
+#                            two-process split — liveness-driven
+#                            reconnects, at-most-once re-submit,
+#                            autoscaler standby backfill, zero lost
+#                            accepted requests, typed errors only,
+#                            zero recompiles, parity vs co-located
 #   check_quant_hlo.py     — quantized serving: int8 KV pool + int8
 #                            retrieval table on ONE engine under
 #                            mixed-dtype churn — zero steady-state
@@ -174,6 +182,18 @@ if [ "$MODE" = "--smoke" ]; then
     if [ -z "${GENREC_CI_SKIP_CROSSHOST:-}" ]; then
         run python scripts/check_crosshost.py --small --platform cpu
     fi
+    # Chaos-net smoke: the same two-process TIGER split under a SEEDED
+    # fault schedule — a blackholed peer (liveness deadline -> reconnect),
+    # an injected corrupt frame (CRC -> typed reconnect), a SIGKILL
+    # mid-burst (at-most-once re-submit) and an autoscaler standby
+    # backfill — zero lost accepted requests, typed errors only, zero
+    # steady-state recompiles, pools clean, parity vs co-located.
+    # GENREC_CI_SKIP_CHAOSNET=1 skips it for callers whose pytest pass
+    # already runs tests/test_chaosnet.py directly (same contract as
+    # the knobs above).
+    if [ -z "${GENREC_CI_SKIP_CHAOSNET:-}" ]; then
+        run python scripts/check_chaosnet.py --small --platform cpu
+    fi
     # Speculative-decode smoke: a warmed spec TIGER engine under
     # staggered churn — zero steady-state recompiles, exactly one tree
     # topology per slot rung, output bit-identical to a plain engine at
@@ -263,6 +283,7 @@ else
     run python scripts/check_fleet.py --write-note
     run python scripts/check_disagg.py --write-note
     run python scripts/check_crosshost.py --write-note
+    run python scripts/check_chaosnet.py --write-note
     run python scripts/check_spec_hlo.py --write-note
     run python scripts/check_quant_hlo.py --write-note
     run python scripts/check_lineage.py --write-note

@@ -1,0 +1,742 @@
+"""Chaos-hardened cross-host serving (genrec_tpu/disagg/chaosnet.py +
+the net.py self-healing machinery) — the PR-18 tentpole pins.
+
+Acceptance bars, each pinned here:
+
+- frame-codec fuzz: seeded bit-flips, truncations and insane lengths
+  anywhere in the wire bytes land as TYPED ConnectionErrors on the
+  reader — never a hang, never a silent mis-parse (the CRC32 covers the
+  payload, which the pre-checksum framing would have parsed clean);
+- chaosnet determinism: the same plan + seed replays the identical
+  fault sequence, and connection-ordinal windows (`n_conns`) confine a
+  fault to the first connection so the reconnect comes up clean;
+- at-most-once across reconnect: a stale incarnation's RESULT/REFUSED
+  frames are discarded (counted) and can never resolve — or
+  double-resolve — a flight that was stranded and re-submitted;
+- close() racing a reconnect neither leaks the in-flight connect
+  socket nor records a phantom peer loss (the satellite fix);
+- degraded mode: zero reachable decode peers sheds submits with the
+  recoverable OverloadError, and a promoted standby exits the mode;
+- a decode host serves a front, survives its ABRUPT disconnect (and a
+  garbage-frame probe), then serves a second front with bit-identical
+  parity vs the in-process serializing tier, exiting 0 after the last
+  graceful drain — the multi-front accept loop.
+
+The fake-host tests speak the wire protocol from a thread instead of
+spawning a decode-host process, so only the multi-front test pays a
+child's compile grid."""
+
+import io
+import queue
+import socket as socket_mod
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from genrec_tpu.core import chaos
+from genrec_tpu.core.chaos import ChaosPlan, NetFault
+from genrec_tpu.disagg import (
+    DisaggFront,
+    Flight,
+    HandoffRefusedError,
+    RemoteDecodeWorker,
+    SocketTransport,
+    chaosnet,
+    spawn_decode_host,
+)
+from genrec_tpu.disagg.chaosnet import (
+    ChaosInjectionError,
+    ChaosSocket,
+    validate_faults,
+)
+from genrec_tpu.disagg.net import (
+    BYE,
+    HANDOFF,
+    HELLO,
+    REFUSED,
+    RESULT,
+    SHUTDOWN,
+    STATS,
+    STATS_REQ,
+    recv_frame,
+    send_frame,
+)
+from genrec_tpu.models.tiger import Tiger
+from genrec_tpu.obs import prometheus_text
+from genrec_tpu.obs.flight_recorder import get_flight_recorder
+from genrec_tpu.serving import BucketLadder, PagedConfig, Request
+from genrec_tpu.serving.heads import TigerGenerativeHead
+from genrec_tpu.serving.metrics import ServingMetrics
+from genrec_tpu.serving.types import OverloadError
+
+K_CB = 8
+CFG = dict(max_slots=2, page_size=8, pages_per_slot=4)
+LADDER = ((1, 2), (8,))
+_CHILD_ENV = {"JAX_PLATFORMS": "cpu", "XLA_FLAGS": ""}
+
+#: The handshake identity a fake (thread) decode host announces —
+#: everything RemoteDecodeWorker.warmup()/the front's routing reads.
+_IDENTITY = {
+    "worker_id": "fake-d0", "head": "tiger",
+    "layout": [2, 4, 8, "float32"], "kv_dtype": "float32",
+    "params_step": 1, "catalog_version": None,
+    "max_slots": 2, "page_size": 8, "pages_per_slot": 4,
+    "warmup_compiles": 0,
+}
+
+
+def _tiger_parts():
+    valid = np.unique(
+        np.random.default_rng(7).integers(0, K_CB, (20, 3)), axis=0)
+    model = Tiger(embedding_dim=16, attn_dim=32, dropout=0.0, num_heads=4,
+                  n_layers=2, num_item_embeddings=K_CB,
+                  num_user_embeddings=20, sem_id_dim=3, max_pos=64)
+    params = model.init(
+        jax.random.key(0), jnp.zeros((2,), jnp.int32),
+        jnp.zeros((2, 6), jnp.int32), jnp.zeros((2, 6), jnp.int32),
+        jnp.zeros((2, 3), jnp.int32), jnp.zeros((2, 3), jnp.int32),
+        jnp.ones((2, 6), jnp.int32),
+    )["params"]
+    return model, valid, params
+
+
+def make_decode_cfg():
+    """Decode-host factory (runs in the CHILD process)."""
+    model, valid, params = _tiger_parts()
+    return {
+        "head": TigerGenerativeHead(model, valid, top_k=4, name="tiger"),
+        "params": params,
+        "ladder": BucketLadder(*LADDER),
+        "paged_config": PagedConfig(**CFG),
+        "params_step": 1,
+    }
+
+
+def _front(model, valid, params, **kw):
+    return DisaggFront(
+        [TigerGenerativeHead(model, valid, top_k=4, name="tiger")], params,
+        ladder=BucketLadder(*LADDER), max_batch=2, max_wait_ms=1.0,
+        paged_config=PagedConfig(**CFG), params_step=1, **kw,
+    )
+
+
+def _reqs(n=6, seed=3):
+    rng = np.random.default_rng(seed)
+    valid_n = len(np.unique(
+        np.random.default_rng(7).integers(0, K_CB, (20, 3)), axis=0))
+    lens = (3, 7, 5, 3, 7, 8, 1, 6)[:n]
+    return [Request(head="tiger",
+                    history=rng.integers(0, valid_n, ln),
+                    user_id=int(rng.integers(0, 20)))
+            for ln in lens]
+
+
+def _tcp_pair():
+    srv = socket_mod.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    cl = socket_mod.create_connection(srv.getsockname())
+    sv, _ = srv.accept()
+    srv.close()
+    for s in (cl, sv):
+        s.settimeout(5.0)
+    return cl, sv
+
+
+class _Capture:
+    """sendall sink: collects one frame's exact wire bytes."""
+
+    def __init__(self):
+        self.buf = b""
+
+    def sendall(self, data):
+        self.buf += bytes(data)
+
+
+def _wire_bytes(ftype=HANDOFF, meta=None, payload=b""):
+    cap = _Capture()
+    send_frame(cap, ftype, meta if meta is not None else {"seq": 1},
+               payload)
+    return cap.buf
+
+
+# -- frame-codec fuzz ---------------------------------------------------------
+
+
+def test_codec_fuzz_every_mutation_fails_typed():
+    """Seeded fuzz over the raw wire bytes: a single flipped bit, a
+    truncation at any offset, or a randomized length prefix must each
+    surface as ConnectionError on the reader — never a hang (the
+    sender closes, so a too-long length hits EOF) and never a clean
+    parse of corrupted bytes."""
+    rng = np.random.default_rng(1234)
+    payload = rng.bytes(512)
+    wire = _wire_bytes(RESULT, {"seq": 3, "head": "tiger"}, payload)
+    for trial in range(80):
+        mode = trial % 3
+        mutated = bytearray(wire)
+        if mode == 0:  # flip one bit anywhere (length prefix included)
+            pos = int(rng.integers(0, len(wire)))
+            mutated[pos] ^= 1 << int(rng.integers(0, 8))
+        elif mode == 1:  # truncate mid-frame
+            mutated = mutated[: int(rng.integers(1, len(wire)))]
+        else:  # garbage length prefix
+            mutated[:8] = bytes(rng.bytes(8))
+        a, b = socket_mod.socketpair()
+        try:
+            b.settimeout(5.0)
+            a.sendall(bytes(mutated))
+            a.close()  # EOF backstop: an inflated length reads to EOF
+            with pytest.raises(ConnectionError):
+                recv_frame(b)
+        finally:
+            a.close()
+            b.close()
+    # Sanity: the unmutated bytes round-trip.
+    a, b = socket_mod.socketpair()
+    try:
+        b.settimeout(5.0)
+        a.sendall(wire)
+        ftype, meta, got = recv_frame(b)
+        assert (ftype, meta["seq"], got) == (RESULT, 3, payload)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_codec_crc_catches_payload_corruption():
+    """A flipped bit in the PAYLOAD region parses clean under the
+    length/meta framing alone — only the CRC32 catches it. Pins the
+    checksum actually covering the payload bytes."""
+    payload = b"\x00" * 64
+    wire = bytearray(_wire_bytes(RESULT, {"seq": 9}, payload))
+    wire[-10] ^= 0x01  # well inside the payload region
+    a, b = socket_mod.socketpair()
+    try:
+        b.settimeout(5.0)
+        a.sendall(bytes(wire))
+        with pytest.raises(ConnectionError, match="checksum mismatch"):
+            recv_frame(b)
+    finally:
+        a.close()
+        b.close()
+
+
+# -- chaosnet: the injector itself -------------------------------------------
+
+
+def test_chaosnet_validates_faults():
+    validate_faults([NetFault(kind="drop", side="send")])
+    with pytest.raises(ValueError, match="not injectable"):
+        validate_faults([NetFault(kind="drop", side="recv")])
+    with pytest.raises(ValueError, match="side"):
+        validate_faults([NetFault(kind="drop", side="sideways")])
+    with pytest.raises(ValueError, match="role"):
+        validate_faults([NetFault(kind="drop", role="middlebox")])
+    with pytest.raises(ValueError, match="not injectable"):
+        validate_faults([NetFault(kind="unplug_cable")])
+
+
+def test_chaosnet_deterministic_replay():
+    """Same plan + seed -> the identical (side, frame, kind) fault
+    sequence, down to the probabilistic draws."""
+    plan = ChaosPlan(net_seed=11, net_faults=(
+        NetFault(kind="corrupt", role="front", side="send",
+                 at_frame=0, n_frames=50, p=0.5),
+    ))
+
+    def run():
+        a, b = socket_mod.socketpair()
+        try:
+            cs = ChaosSocket(a, "front", plan)
+            for i in range(30):
+                cs.sendall(b"frame-%02d" % i)
+            return list(cs.applied)
+        finally:
+            a.close()
+            b.close()
+
+    first, second = run(), run()
+    assert first == second
+    assert 0 < len(first) < 30  # p=0.5 genuinely probabilistic
+
+
+def test_chaosnet_conn_windows_confine_faults():
+    """n_conns=1 arms the fault for connection ordinal 0 only: the
+    reconnect (the next wrap of the same role) comes up clean, and the
+    other role's counter is independent."""
+    plan = ChaosPlan(net_seed=5, net_faults=(
+        NetFault(kind="drop", role="front", side="send",
+                 at_frame=0, n_frames=10**6, n_conns=1),
+    ))
+    chaos.install(plan)
+    socks = [socket_mod.socketpair() for _ in range(3)]
+    try:
+        chaosnet.reset_conn_counts()
+        w0 = chaosnet.maybe_wrap(socks[0][0], "front")
+        w1 = chaosnet.maybe_wrap(socks[1][0], "front")
+        wh = chaosnet.maybe_wrap(socks[2][0], "host")
+        assert (w0.conn_idx, w1.conn_idx, wh.conn_idx) == (0, 1, 0)
+        assert len(w0._faults) == 1   # first front connection: armed
+        assert len(w1._faults) == 0   # the reconnect: clean
+        assert len(wh._faults) == 0   # host role: never matched
+    finally:
+        chaos.install(None)
+        chaosnet.reset_conn_counts()
+        for a, b in socks:
+            a.close()
+            b.close()
+
+
+def test_chaosnet_no_plan_is_a_passthrough():
+    a, b = socket_mod.socketpair()
+    try:
+        assert chaosnet.maybe_wrap(a, "front") is a
+    finally:
+        a.close()
+        b.close()
+
+
+def test_chaosnet_kinds_on_the_wire():
+    """Each injectable kind produces its real-world observable: dropped
+    frames vanish without desyncing the stream, corruption fails typed
+    on the reader, truncate/reset kill both ends typed, recv-side
+    latency delays delivery, slow-loris still lands a whole frame."""
+    # drop: frame 0 vanishes, frame 1 parses — no desync.
+    cl, sv = _tcp_pair()
+    try:
+        cs = ChaosSocket(cl, "front", ChaosPlan(net_faults=(
+            NetFault(kind="drop", side="send", at_frame=0, n_frames=1),)))
+        send_frame(cs, STATS_REQ, {"gen": 0})
+        send_frame(cs, STATS_REQ, {"gen": 1})
+        ftype, meta, _ = recv_frame(sv)
+        assert (ftype, meta["gen"]) == (STATS_REQ, 1)
+        assert cs.applied == [("send", 0, "drop")]
+    finally:
+        cl.close()
+        sv.close()
+    # corrupt: the reader fails TYPED — the checksum error, or (when a
+    # flip lands in the length prefix and inflates it) the bounded
+    # socket timeout that the reconnect machinery treats identically.
+    cl, sv = _tcp_pair()
+    try:
+        sv.settimeout(1.0)
+        cs = ChaosSocket(cl, "front", ChaosPlan(net_faults=(
+            NetFault(kind="corrupt", side="send"),)))
+        send_frame(cs, STATS_REQ, {})
+        with pytest.raises(OSError):  # ConnectionError or timeout
+            recv_frame(sv)
+    finally:
+        cl.close()
+        sv.close()
+    # truncate: typed on BOTH sides (injector raises, peer sees EOF/RST).
+    cl, sv = _tcp_pair()
+    try:
+        cs = ChaosSocket(cl, "front", ChaosPlan(net_faults=(
+            NetFault(kind="truncate", side="send"),)))
+        with pytest.raises(ChaosInjectionError):
+            send_frame(cs, STATS_REQ, {})
+        with pytest.raises(ConnectionError):
+            recv_frame(sv)
+    finally:
+        cl.close()
+        sv.close()
+    # reset: ditto, without any bytes landing.
+    cl, sv = _tcp_pair()
+    try:
+        cs = ChaosSocket(cl, "front", ChaosPlan(net_faults=(
+            NetFault(kind="reset", side="send"),)))
+        with pytest.raises(ChaosInjectionError):
+            send_frame(cs, STATS_REQ, {})
+        with pytest.raises(ConnectionError):
+            recv_frame(sv)
+    finally:
+        cl.close()
+        sv.close()
+    # recv-side latency: the frame is delayed, then intact.
+    cl, sv = _tcp_pair()
+    try:
+        cs = ChaosSocket(sv, "host", ChaosPlan(net_faults=(
+            NetFault(kind="latency", role="host", side="recv",
+                     delay_s=0.15),)))
+        send_frame(cl, STATS, {"ok": True})
+        t0 = time.monotonic()
+        ftype, meta, _ = recv_frame(cs)
+        assert time.monotonic() - t0 >= 0.14
+        assert (ftype, meta["ok"]) == (STATS, True)
+    finally:
+        cl.close()
+        sv.close()
+    # slow-loris: dribbled in 64B chunks, still one whole parsed frame.
+    cl, sv = _tcp_pair()
+    try:
+        cs = ChaosSocket(cl, "front", ChaosPlan(net_faults=(
+            NetFault(kind="slow_loris", side="send", delay_s=0.002),)))
+        send_frame(cs, HANDOFF, {"seq": 4}, b"y" * 200)
+        ftype, meta, got = recv_frame(sv)
+        assert (ftype, meta["seq"], got) == (HANDOFF, 4, b"y" * 200)
+    finally:
+        cl.close()
+        sv.close()
+
+
+# -- incarnations: at-most-once across reconnect ------------------------------
+
+
+def _result_payload(n=4):
+    buf = io.BytesIO()
+    np.savez(buf, items=np.arange(n), scores=np.linspace(1.0, 0.1, n),
+             sem_ids=np.zeros((n, 3), np.int32))
+    return buf.getvalue()
+
+
+def _proxy(addr="127.0.0.1:1", **kw):
+    return RemoteDecodeWorker(
+        addr, transport=SocketTransport(),
+        metrics=ServingMetrics(), counters={"handoffs_refused": 0},
+        flight_recorder=get_flight_recorder().scoped("t"), **kw,
+    )
+
+
+def test_stale_incarnation_frames_discarded_no_double_resolve():
+    """The at-most-once pin across reconnect: a RESULT delivered by a
+    pre-reconnect epoch's reader is discarded (counted) and can never
+    resolve the flight; the current epoch's RESULT resolves it exactly
+    once; replays — stale or current — change nothing."""
+    w = _proxy()
+    w.identity = dict(_IDENTITY)
+    meta = {"seq": 0, "head": "tiger", "bucket": [1, 8], "params_step": 1}
+    payload = _result_payload()
+    fl = Flight(Request(head="tiger", history=np.arange(3), user_id=0))
+    w._outstanding[0] = (fl, 3, time.monotonic())
+    w.incarnation = 1  # a reconnect happened after the frame was sent
+    discards = w.transport.net_counters
+    assert w._dispatch(RESULT, meta, payload, inc=0) is False
+    assert discards["incarnation_discards"] == 1
+    assert not fl.fut.done()
+    assert 0 in w._outstanding  # stale frames never touch the ledger
+    # The current epoch's RESULT resolves the flight, once.
+    assert w._dispatch(RESULT, meta, payload, inc=1) is True
+    resp = fl.fut.result(0)
+    assert np.array_equal(resp.items, np.arange(4))
+    # Replaying the stale frame: still discarded, result unchanged.
+    assert w._dispatch(RESULT, meta, payload, inc=0) is False
+    assert discards["incarnation_discards"] == 2
+    assert fl.fut.result(0) is resp
+    # A current-incarnation duplicate (seq already finalized): dropped
+    # by the ledger — no exception, no double-resolve.
+    assert w._dispatch(RESULT, meta, payload, inc=1) is False
+    assert fl.fut.result(0) is resp
+    # Stale REFUSED frames ride the same discard.
+    fl2 = Flight(Request(head="tiger", history=np.arange(2), user_id=1))
+    w._outstanding[1] = (fl2, 2, time.monotonic())
+    refuse = {"seq": 1, "etype": "HandoffRefusedError", "error": "skew"}
+    assert w._dispatch(REFUSED, refuse, b"", inc=0) is False
+    assert discards["incarnation_discards"] == 3
+    assert not fl2.fut.done()
+    assert w._dispatch(REFUSED, refuse, b"", inc=1) is True
+    with pytest.raises(HandoffRefusedError, match="skew"):
+        fl2.fut.result(0)
+    assert w._counters["handoffs_refused"] == 1
+
+
+def test_close_racing_reconnect_leaks_nothing():
+    """The satellite fix: close() while the reconnect loop is mid-backoff
+    returns promptly, aborts the attempt without a phantom peer-loss
+    event, and leaves no socket — connected or in-flight — behind."""
+    srv = socket_mod.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    addr = "127.0.0.1:%d" % srv.getsockname()[1]
+    conns = []
+
+    def host():
+        conn, _ = srv.accept()
+        send_frame(conn, HELLO, _IDENTITY)
+        conns.append(conn)
+
+    t = threading.Thread(target=host, daemon=True)
+    t.start()
+    w = _proxy(addr, reconnect_max=5, reconnect_base=4.0,
+               reconnect_cap=8.0, reconnect_seed=1)
+    w.warmup()
+    t.join(5.0)
+    # Abrupt peer death -> the recv loop begins a reconnect whose first
+    # backoff sleeps for seconds — the window close() must win in.
+    conns[0].close()
+    srv.close()
+    deadline = time.monotonic() + 5.0
+    while not w.reconnecting and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert w.reconnecting
+    t0 = time.monotonic()
+    w.close(timeout=2.0)
+    assert time.monotonic() - t0 < 3.0  # not the 2-4s backoff sleep
+    assert w.sockets_closed
+    assert w._connecting_sock is None
+    assert not w.dead  # a deliberate close is not a peer loss...
+    assert w.transport.net_counters["peer_losses"] == 0  # ...nor counted
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and any(
+        addr in th.name for th in threading.enumerate()
+    ):
+        time.sleep(0.01)
+    assert not any(addr in th.name for th in threading.enumerate())
+
+
+def test_send_epoch_swap_never_loses_new_frames():
+    """The frame-loss race the chaos bench caught live: a handoff
+    admitted for the NEW epoch while the OLD epoch's sender still
+    drained a shared queue used to be pushed down the old (dead)
+    socket and silently lost — flight ledgered forever, caller hung to
+    its timeout, liveness blind (heartbeats kept flowing). Pin the
+    fix: a reconnect swaps in a per-epoch send queue, and a sender
+    that does see a newer epoch's item forwards it to the live queue
+    instead of writing it to its own socket."""
+    # 1) opening a new epoch swaps the queue object itself.
+    w = _proxy(reconnect_max=1, reconnect_base=0.01, reconnect_cap=0.02,
+               reconnect_seed=5)
+    w.identity = dict(_IDENTITY)
+    q0 = w._send_q
+    w._begin_reconnect("test", ConnectionResetError("boom"), 0)
+    assert w.incarnation == 1
+    assert w._send_q is not q0
+    w.close(timeout=2.0)  # reap the (hopeless) reconnect thread
+
+    # 2) an epoch-1 sender on a dead socket: the pre-epoch leftover is
+    # dropped, the newer-epoch item is forwarded to the live queue,
+    # and NOTHING is ever written to the dead socket.
+    w2 = _proxy()
+    w2.incarnation = 1
+    q_old = queue.Queue()
+    w2._send_q = q_old
+
+    class DeadSock:
+        def sendall(self, data):
+            raise AssertionError(
+                "old-epoch sender wrote to its dead socket")
+
+    t = threading.Thread(target=w2._send_loop, args=(DeadSock(), 1),
+                         daemon=True)
+    t.start()
+    q_old.put((HANDOFF, {"seq": 0}, b"old", None, 0))  # stale: epoch 0
+    time.sleep(0.05)
+    # The next reconnect installs epoch 2's live queue...
+    q_live = queue.Queue()
+    w2.incarnation = 2
+    w2._send_q = q_live
+    # ...and the admit race leaves one epoch-2 frame in the old queue.
+    newer = (HANDOFF, {"seq": 1}, b"new", None, 2)
+    q_old.put(newer)
+    t.join(5.0)
+    assert not t.is_alive()
+    assert q_live.get(timeout=1.0) is newer  # survived the epoch death
+    assert q_old.empty()
+
+
+# -- degraded mode (fake wire-protocol hosts, no child processes) -------------
+
+
+class _FakeHost(threading.Thread):
+    """A thread speaking just enough of the decode-host protocol:
+    HELLO on accept, STATS for STATS_REQ, STATS+BYE for SHUTDOWN."""
+
+    def __init__(self, identity=None):
+        super().__init__(daemon=True)
+        self.identity = dict(identity or _IDENTITY)
+        self.srv = socket_mod.socket()
+        self.srv.bind(("127.0.0.1", 0))
+        self.srv.listen(4)
+        self.addr = "127.0.0.1:%d" % self.srv.getsockname()[1]
+        self.conns = []
+        self._stop = threading.Event()
+
+    def run(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self.srv.accept()
+            except OSError:
+                return
+            if self._stop.is_set():
+                # kill() raced a blocked accept (close() does not wake
+                # it): refuse the late connection WITHOUT a HELLO, so a
+                # reconnecting proxy fails its handshake typed instead
+                # of resurrecting a host the test declared dead.
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                return
+            self.conns.append(conn)
+            threading.Thread(
+                target=self._serve, args=(conn,), daemon=True).start()
+
+    def _serve(self, conn):
+        try:
+            send_frame(conn, HELLO, self.identity)
+            while True:
+                ftype, _meta, _payload = recv_frame(conn)
+                if ftype == STATS_REQ:
+                    send_frame(conn, STATS, {"recompilations": 0})
+                elif ftype == SHUTDOWN:
+                    send_frame(conn, STATS, {"recompilations": 0})
+                    send_frame(conn, BYE, {})
+                    return
+        except (OSError, ConnectionError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def kill(self):
+        self._stop.set()
+        try:
+            self.srv.close()
+        except OSError:
+            pass
+        for c in self.conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+
+def test_degraded_mode_sheds_then_standby_promotion_exits():
+    """Losing the LAST reachable decode peer enters the head's degraded
+    mode: submits shed with the recoverable OverloadError, the state is
+    visible in stats(), and promoting a standby host exits it."""
+    host_a = _FakeHost()
+    host_a.start()
+    host_b = _FakeHost(dict(_IDENTITY, worker_id="fake-d1"))
+    host_b.start()
+    model, valid, params = _tiger_parts()
+    front = _front(
+        model, valid, params, transport="socket",
+        workers=[host_a.addr], standby_workers=[host_b.addr],
+        remote_net=dict(reconnect_max=1, reconnect_base=0.01,
+                        reconnect_cap=0.02, liveness_timeout=0,
+                        reconnect_seed=3),
+    ).start(run_loop=False)
+    try:
+        host_a.kill()  # the only peer: vanish, reconnect can't succeed
+        deadline = time.monotonic() + 30.0
+        while (time.monotonic() < deadline
+               and "tiger" not in front._degraded):
+            front.pump_once()
+            time.sleep(0.01)
+        st = front.stats()["disagg"]
+        assert st["degraded_heads"] == ["tiger"]
+        assert st["degraded_entered"] == 1
+        assert st["decode_worker_deaths"] == 1
+        with pytest.raises(OverloadError, match="degraded"):
+            front.submit(_reqs(1)[0])
+        # Standby promotion (the autoscaler's add_replica verb) brings
+        # a live peer back -> the head exits degraded on the next pump.
+        wid = front.role_pool("tiger", "decode").add_replica()
+        front.pump_once()
+        st = front.stats()["disagg"]
+        assert st["degraded_heads"] == []
+        assert st["degraded_exited"] == 1
+        fr = get_flight_recorder()
+        assert fr.events("degraded_mode_entered")
+        assert fr.events("degraded_mode_exited")
+        assert any(ev.get("worker") == wid
+                   for ev in fr.events("disagg_worker_added"))
+    finally:
+        front.stop(timeout=30.0)
+        host_a.kill()
+        host_b.kill()
+
+
+# -- multi-front decode host (one real child process) -------------------------
+
+
+def test_host_survives_front_disconnect_and_serves_second_front():
+    """The multi-front accept loop: a decode host serves front A,
+    survives A's ABRUPT disconnect (no SHUTDOWN) and a garbage-frame
+    probe, then serves front B with sem-ids bit-identical to the
+    in-process serializing tier, and exits 0 after B's graceful drain."""
+    model, valid, params = _tiger_parts()
+    base_front = _front(model, valid, params,
+                        transport="serializing").start()
+    base = [f.result(120) for f in [base_front.submit(r)
+                                    for r in _reqs(4)]]
+    base_front.stop()
+    proc, addr = spawn_decode_host(
+        f"{__file__}:make_decode_cfg", worker_id="remote-mf",
+        env=_CHILD_ENV,
+    )
+    try:
+        front_a = _front(model, valid, params, transport="socket",
+                         workers=[addr]).start()
+        out_a = [f.result(120) for f in [front_a.submit(r)
+                                         for r in _reqs(4)]]
+        for b, t in zip(base, out_a):
+            assert np.array_equal(np.asarray(b.sem_ids),
+                                  np.asarray(t.sem_ids))
+        # Abrupt disconnect: tear the proxy's socket down with NO
+        # graceful SHUTDOWN — to the host this is a front crash.
+        (dw,) = front_a._groups["tiger"].decode
+        dw._shutdown()
+        front_a.stop()
+        # Garbage probe: a connection that sends 16 random bytes. The
+        # host must drop IT, not itself.
+        probe = socket_mod.create_connection(
+            (addr.rpartition(":")[0], int(addr.rpartition(":")[2])),
+            timeout=5.0,
+        )
+        probe.sendall(np.random.default_rng(0).bytes(16))
+        probe.close()
+        time.sleep(0.5)
+        assert proc.poll() is None, "host died on a front crash/garbage"
+        # Front B: same host, fresh connection, bit-identical results.
+        front_b = _front(model, valid, params, transport="socket",
+                         workers=[addr]).start()
+        out_b = [f.result(120) for f in [front_b.submit(r)
+                                         for r in _reqs(4)]]
+        for b, t in zip(base, out_b):
+            assert np.array_equal(np.asarray(b.sem_ids),
+                                  np.asarray(t.sem_ids))
+            np.testing.assert_allclose(np.asarray(b.scores),
+                                       np.asarray(t.scores),
+                                       rtol=0, atol=1e-6)
+        st = front_b.stats()
+        assert st["recompilations"] == 0
+        front_b.stop()  # the LAST graceful drain: the host exits clean
+        assert proc.wait(30) == 0
+    finally:
+        proc.kill()
+
+
+# -- observability typing -----------------------------------------------------
+
+
+def test_self_healing_counters_prometheus_typing():
+    snap = {
+        "disagg": {
+            "degraded_entered": 1, "degraded_exited": 1,
+            "transports": {"socket": {"network": {
+                "reconnects": 2, "heartbeat_misses": 1,
+                "incarnation_discards": 3,
+            }}},
+        },
+    }
+    text = prometheus_text(snap)
+    for line in (
+        "# TYPE genrec_disagg_degraded_entered counter",
+        "# TYPE genrec_disagg_degraded_exited counter",
+        "# TYPE genrec_disagg_transports_socket_network_reconnects"
+        " counter",
+        "# TYPE genrec_disagg_transports_socket_network_heartbeat_misses"
+        " counter",
+        "# TYPE genrec_disagg_transports_socket_network"
+        "_incarnation_discards counter",
+    ):
+        assert line in text, line

@@ -47,6 +47,13 @@ CROSSHOST_KEYS = {"backend", "submitted", "completed", "failed", "replays",
                   "recompilations_peer", "prefill_pages_final",
                   "peer_pages_final", "peer_slots_final", "sockets_closed",
                   "child_rc", "parity_ok", "ok"}
+CHAOSNET_KEYS = {"backend", "submitted", "completed", "failed", "lost",
+                 "typed_only", "reconnects", "heartbeat_misses",
+                 "incarnation_discards", "decode_worker_deaths",
+                 "degraded_entered", "scale_outs", "recovery_ms",
+                 "recompilations_front", "recompilations_peers",
+                 "prefill_pages_final", "peer_pages_final",
+                 "peer_slots_final", "parity_ok", "child_rcs", "ok"}
 SPEC_KEYS = {"backend", "submitted", "completed", "recompilations", "rungs",
              "topology", "topologies_per_rung", "spec_steps",
              "plain_decode_steps", "spec_decode_steps",
@@ -109,8 +116,8 @@ def test_check_scripts_keep_their_cli():
     for script in ("check_decode_hlo", "check_packed_hlo",
                    "check_fused_ce_hlo", "check_serving_hlo",
                    "check_catalog_hlo", "check_fleet", "check_disagg",
-                   "check_crosshost", "check_spec_hlo", "check_lineage",
-                   "check_obs", "check_quant_hlo"):
+                   "check_crosshost", "check_chaosnet", "check_spec_hlo",
+                   "check_lineage", "check_obs", "check_quant_hlo"):
         proc = subprocess.run(
             [sys.executable, os.path.join(REPO, "scripts", f"{script}.py"),
              "--help"],
@@ -124,28 +131,29 @@ def test_check_scripts_keep_their_cli():
 def test_ci_checks_smoke_entrypoint():
     """The consolidated entrypoint runs every smoke check and exits 0
     (rc=2 inconclusives tolerated, real failures propagated)."""
-    # The chaos-unit, obs, graftlint, catalog and quant subsets are
-    # skipped here: this test runs INSIDE the suite that already
-    # executes tests/test_fault_tolerance.py, tests/test_obs.py,
-    # tests/test_analysis.py, tests/test_catalog.py and
-    # tests/test_quantized.py directly, and nesting them would
-    # double-pay their cold-start (~30-60s each) for no coverage
-    # (check_quant_hlo's verdict schema is pinned by the slow-marked
-    # test below). The (jax-free, sub-second) bench_gate self-test
-    # stays.
+    # The chaos-unit, obs, graftlint, catalog, quant and chaosnet
+    # subsets are skipped here: this test runs INSIDE the suite that
+    # already executes tests/test_fault_tolerance.py, tests/test_obs.py,
+    # tests/test_analysis.py, tests/test_catalog.py,
+    # tests/test_quantized.py and tests/test_chaosnet.py directly, and
+    # nesting them would double-pay their cold-start (~30s-4min each)
+    # for no coverage (check_quant_hlo's and check_chaosnet's verdict
+    # schemas are pinned by the slow-marked tests below). The
+    # (jax-free, sub-second) bench_gate self-test stays.
     proc = subprocess.run(
         ["bash", os.path.join(REPO, "scripts", "ci_checks.sh"), "--smoke"],
         capture_output=True, text=True, timeout=900,
         env={**os.environ, "JAX_PLATFORMS": "cpu",
              "GENREC_CI_SKIP_CHAOS": "1", "GENREC_CI_SKIP_OBS": "1",
              "GENREC_CI_SKIP_LINT": "1", "GENREC_CI_SKIP_CATALOG": "1",
-             "GENREC_CI_SKIP_QUANT": "1"},
+             "GENREC_CI_SKIP_QUANT": "1",
+             "GENREC_CI_SKIP_CHAOSNET": "1"},
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     # One verdict JSON per check on stdout (decode, fused-ce, packed,
     # serving, fleet, disagg, crosshost, spec, lineage, bench-gate
-    # self-test; the quant check is env-skipped above, so the
-    # unfiltered smoke emits one more).
+    # self-test; the quant and chaosnet checks are env-skipped above,
+    # so the unfiltered smoke emits two more).
     verdicts = [json.loads(l) for l in proc.stdout.splitlines() if l.strip()]
     assert len(verdicts) == 10
     lineage = [v for v in verdicts if "segment_sum_ok" in v]
@@ -183,6 +191,34 @@ def test_ci_checks_smoke_entrypoint():
     gate = [v for v in verdicts if v.get("check") == "bench_gate"]
     assert len(gate) == 1 and set(gate[0]) == BENCH_GATE_KEYS
     assert gate[0]["self_test"]["ok"] and gate[0]["ok"]
+
+
+@pytest.mark.slow
+def test_chaosnet_check_small():
+    """check_chaosnet's verdict schema + the self-healing pins (slow:
+    it spawns two decode-host children and runs a seeded partition +
+    corrupt-frame + SIGKILL + recovery schedule, ~3-4min — the tier-1
+    suite covers the same machinery via tests/test_chaosnet.py; this
+    pins the SMOKE CHECK's contract for the shell entrypoint, which
+    runs it unless GENREC_CI_SKIP_CHAOSNET is set)."""
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "scripts", "check_chaosnet.py"),
+         "--small", "--platform", "cpu"],
+        capture_output=True, text=True, timeout=900,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    verdict = json.loads(lines[-1])
+    assert set(verdict) == CHAOSNET_KEYS
+    assert verdict["lost"] == 0 and verdict["typed_only"]
+    assert verdict["reconnects"] >= 2
+    assert verdict["decode_worker_deaths"] == 1
+    assert verdict["scale_outs"] == 1 and verdict["parity_ok"]
+    assert verdict["recompilations_front"] == 0
+    assert verdict["recompilations_peers"] == 0
+    assert verdict["child_rcs"] == [0, 0]
 
 
 @pytest.mark.slow

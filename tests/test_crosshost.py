@@ -248,6 +248,15 @@ def test_socket_sigkill_mid_frame_at_most_once():
 
         assert len(results) + len(errors) == len(futs)
         assert all(isinstance(e, DisaggError) for e in errors), errors
+        # The death is declared only after the reconnect budget exhausts
+        # (the self-healing tier tries to get the peer back first) while
+        # the stranded flights re-submit through the survivor right away
+        # — so the futures above can resolve BEFORE the loss lands in
+        # stats. Poll for it.
+        deadline = time.monotonic() + 30.0
+        while (front.stats()["disagg"]["decode_worker_deaths"] < 1
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
         st = front.stats()
         assert st["disagg"]["decode_worker_deaths"] == 1
         deaths = fr.events("disagg_worker_dead")[deaths_before:]
