@@ -709,6 +709,13 @@ def _serve_bench(model, params, valid_ids, rng, batch: int = SERVE_BATCH,
         out["quant"] = _quant_serve_bench(model, params, valid_ids, rng)
     except Exception as e:
         print(f"bench: quant serve benchmark failed: {e!r}", file=sys.stderr)
+    # Guarded continuous rollout (serving/rollout.py): commit->first-
+    # served freshness through vet + canary + promote, and the qps tax
+    # of a 1s publish cadence on the hot path.
+    try:
+        out["pipeline"] = _pipeline_bench(model, params, valid_ids, rng)
+    except Exception as e:
+        print(f"bench: pipeline benchmark failed: {e!r}", file=sys.stderr)
     return out
 
 
@@ -2236,6 +2243,192 @@ def _quant_serve_bench(model, params, valid_ids, rng,
                 "lever and does not convert to CPU throughput)"
                 if backend != "tpu" else ""
             )
+        ),
+    )
+
+
+def _pipeline_bench(model, params, valid_ids, rng, batch: int = 8) -> dict:
+    """Guarded continuous rollout (serving/rollout.py) on a live 2-replica
+    pair — the serving half of the streaming-training loop:
+
+    - **freshness_p50_ms / freshness_p99_ms**: checkpoint-commit → the
+      first response actually served by the promoted step on a
+      NON-canary replica, over repeated guarded rollouts. Each rollout
+      runs the full guard: vet on the pinned batch, stage to the single
+      canary replica, windowed canary comparison, fleet-wide promote —
+      so this is the end-to-end freshness a streaming trainer's publish
+      buys, not a bare hot-swap time.
+    - **qps_with_rollouts_vs_none**: steady-state closed-loop qps
+      through both replicas with a 1s-cadence publish→vet→canary→promote
+      loop live, vs the same pair with no rollouts at all — the
+      throughput tax of continuous deployment on the hot path
+      (same-run same-backend ratio; vet/canary probes share the
+      replicas' queues with traffic).
+    """
+    import tempfile
+    import threading
+
+    import jax
+    import numpy as np
+
+    from genrec_tpu.core.checkpoint import CheckpointManager
+    from genrec_tpu.serving import (
+        BucketLadder, PagedConfig, Request, ServingEngine,
+    )
+    from genrec_tpu.serving.heads import TigerGenerativeHead
+    from genrec_tpu.serving.rollout import RolloutConfig, RolloutController
+
+    items = BENCH_ITEMS
+    n_tok = 1 + items * model.sem_id_dim
+    cfg = PagedConfig(max_slots=2 * batch, page_size=16,
+                      pages_per_slot=-(-n_tok // 16))
+
+    def make_engine(rid):
+        head = TigerGenerativeHead(model, valid_ids, top_k=DECODE_BEAM_K,
+                                   name="tiger")
+        # No ckpt_dir: the rollout controller owns all staging.
+        return ServingEngine(
+            [head], params, ladder=BucketLadder((1, batch), (items,)),
+            max_batch=batch, max_wait_ms=2.0, handle_signals=False,
+            paged_config=cfg, replica_id=rid,
+        ).start()
+
+    class _Router:
+        def __init__(self):
+            self._eng = {r: make_engine(r) for r in ("r0", "r1")}
+
+        def replica_ids(self):
+            return list(self._eng)
+
+        def engine(self, rid):
+            return self._eng[rid]
+
+    def mkreq(r):
+        return Request(head="tiger", history=r.integers(0, len(valid_ids),
+                                                        items),
+                       user_id=int(r.integers(0, 1_000_000)))
+
+    router = _Router()
+    for rid in ("r0", "r1"):
+        router.engine(rid).submit(mkreq(rng)).result(600)
+
+    work = tempfile.mkdtemp(prefix="genrec_bench_pipeline_")
+    publish_dir = os.path.join(work, "publish")
+    mgr = CheckpointManager(publish_dir)
+    vet = [mkreq(rng) for _ in range(2)]
+    ctrl = RolloutController(
+        router, TigerGenerativeHead(model, valid_ids, top_k=DECODE_BEAM_K,
+                                    name="tiger"),
+        publish_dir, params_like=params, vet_requests=vet,
+        state_path=os.path.join(work, "rollout_state.json"), initial_step=0,
+        # The guard's reaction speed IS the measurement, so the knobs sit
+        # at bench cadence; drift bound wide open — every publish here is
+        # a tiny perturbation of the serving tree and must promote.
+        config=RolloutConfig(poll_secs=0.05, canary_window_s=0.2,
+                             canary_min_responses=2,
+                             vet_max_score_drift=1e9),
+    ).start()
+
+    step = [0]
+
+    def publish_next() -> tuple[int, float]:
+        """Commit a distinct perturbed tree; returns (step, commit time)."""
+        step[0] += 1
+        scale = np.float32(1.0 + 1e-4 * step[0])
+        mgr.save(step[0], jax.tree_util.tree_map(
+            lambda x: np.asarray(x) * scale, params))
+        mgr.wait()
+        return step[0], time.perf_counter()
+
+    # Freshness: publish, then hammer the NON-canary replica until a
+    # response carries the new step's provenance (Response.params_step).
+    # The first rollout is warm-up (it compiles the guard's vet/score
+    # path — a one-time cost, not the steady-state freshness).
+    fresh_ms = []
+    for i in range(7):
+        k, t0 = publish_next()
+        while True:
+            if time.perf_counter() - t0 > 120.0:
+                raise RuntimeError(
+                    f"step {k} never reached r0 traffic: {ctrl.stats()}")
+            r = router.engine("r0").submit(mkreq(rng)).result(600)
+            if r.params_step == k:
+                if i > 0:
+                    fresh_ms.append((time.perf_counter() - t0) * 1e3)
+                break
+    fresh_ms.sort()
+
+    def pct(q: float) -> float:
+        return round(fresh_ms[min(len(fresh_ms) - 1,
+                                  int(q * len(fresh_ms)))], 1)
+
+    # Steady state: closed loop across both replicas (per-thread rngs —
+    # np.random.Generator is not thread-safe).
+    rids = ("r0", "r1")
+
+    def closed_loop(window_s: float) -> float:
+        stop = threading.Event()
+        counts = [0] * (2 * batch)
+
+        def worker(i):
+            eng = router.engine(rids[i % 2])
+            r = np.random.default_rng(1000 + i)
+            while not stop.is_set():
+                eng.submit(mkreq(r)).result(600)
+                counts[i] += 1
+
+        threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+                   for i in range(len(counts))]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        time.sleep(window_s)
+        stop.set()
+        for t in threads:
+            t.join(timeout=600)
+        return sum(counts) / (time.perf_counter() - t0)
+
+    qps_none = closed_loop(2.5)
+
+    cadence_s = 1.0
+    pub_stop = threading.Event()
+
+    def publisher():
+        while not pub_stop.is_set():
+            publish_next()
+            pub_stop.wait(cadence_s)
+
+    pub_thread = threading.Thread(target=publisher, daemon=True)
+    pub_thread.start()
+    qps_roll = closed_loop(2.5)
+    pub_stop.set()
+    pub_thread.join(timeout=600)
+
+    stats = ctrl.stop()
+    for rid in rids:
+        router.engine(rid).stop()
+    mgr.close()
+
+    return dict(
+        backend=jax.default_backend(),
+        replicas=2,
+        rollouts_timed=len(fresh_ms),
+        freshness_p50_ms=pct(0.50),
+        freshness_p99_ms=pct(0.99),
+        rollout_cadence_s=cadence_s,
+        closed_loop_qps_no_rollouts=round(qps_none, 2),
+        closed_loop_qps_with_rollouts=round(qps_roll, 2),
+        qps_with_rollouts_vs_none=round(qps_roll / max(qps_none, 1e-9), 3),
+        promotions=stats["promotions"],
+        vetoes=stats["vetoes"],
+        rollbacks=stats["rollbacks"],
+        last_freshness_s=stats["freshness_s"],
+        note=(
+            "freshness = checkpoint commit -> first r0 (non-canary) "
+            "response carrying the promoted params_step, through the "
+            "full guard (vet on the pinned batch, canary window on r1, "
+            "fleet promote); qps ratio = closed loop through both "
+            f"replicas with a {cadence_s}s publish cadence live vs none"
         ),
     )
 

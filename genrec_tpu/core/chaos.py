@@ -79,6 +79,21 @@ class ChaosPlan:
     # mid-save. Drives the coordinated-commit guarantee: the step must
     # never end up with a commit marker.
     die_in_save_at_step: int | None = None
+    # Streaming-pipeline chaos (docs/training.md "Streaming training"):
+    # record index at which StreamLogWriter.append dies HARD with a REAL
+    # torn frame on disk (header + partial payload, fsync'd, then
+    # SIGKILL) — drives torn-tail recovery against actual torn bytes.
+    die_in_append_at_record: int | None = None
+    # Step at which the streaming trainer dies HARD while its params
+    # PUBLISH (the serving-facing checkpoint, distinct from the trainer's
+    # own resume commit above) is still in flight — the published step
+    # must never gain a commit marker, so the rollout guard never sees it.
+    die_in_publish_at_step: int | None = None
+    # Rollout-controller chaos: name a stage boundary ("canary" |
+    # "promote") at which `maybe_crash` raises ChaosCrashError, killing
+    # the controller's poll thread exactly where a process crash would —
+    # its durable rollout state file is all a restarted controller gets.
+    crash_rollout_at: str | None = None
     # Multi-host chaos: restrict every injection above to ONE simulated
     # host (jax.process_index()). None = fire on every process (the
     # single-process default, where process_index() is 0).
@@ -198,6 +213,64 @@ def maybe_die_in_save(step: int) -> None:
             "chaos_die_in_save", reason="chaos_die_in_save", step=step,
         )
         os.kill(os.getpid(), signal.SIGKILL)
+
+
+def maybe_die_in_append(record: int, partial_write=None) -> None:
+    """Die HARD (SIGKILL) when the plan names this log record, leaving a
+    REAL torn tail: ``partial_write`` (the caller's torn-frame writer —
+    StreamLogWriter passes one that puts the header plus half the
+    payload durably on disk) runs first, then the process is killed with
+    the frame incomplete. Called by `StreamLogWriter.append` BEFORE the
+    full frame write."""
+    plan = _ACTIVE
+    if plan is None or not _this_process_targeted(plan):
+        return
+    if plan.die_in_append_at_record == record:
+        if partial_write is not None:
+            partial_write()
+        _flight_record_and_dump(
+            "chaos_die_in_append", reason="chaos_die_in_append",
+            record=record,
+        )
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+def maybe_die_in_publish(step: int) -> None:
+    """Die HARD (SIGKILL) when the plan names this PUBLISHED step, while
+    the publish checkpoint's async write is still in flight — the
+    serving-facing step must never end up committed. Called by the
+    streaming trainer between starting the publish save and waiting on
+    it."""
+    plan = _ACTIVE
+    if plan is None or not _this_process_targeted(plan):
+        return
+    if plan.die_in_publish_at_step == step:
+        _flight_record_and_dump(
+            "chaos_die_in_publish", reason="chaos_die_in_publish", step=step,
+        )
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+class ChaosCrashError(RuntimeError):
+    """Raised by `maybe_crash` to kill a component's thread in place —
+    the in-process analogue of SIGKILL for components (the rollout
+    controller) whose crash-consistency contract is a durable state
+    file, not a checkpoint."""
+
+
+def maybe_crash(stage: str) -> None:
+    """Raise ChaosCrashError when the plan's ``crash_rollout_at`` names
+    this stage boundary. The caller must NOT catch it — the owning
+    thread dies, and recovery is exercised by constructing a fresh
+    component over the same durable state."""
+    plan = _ACTIVE
+    if plan is None or not _this_process_targeted(plan):
+        return
+    if plan.crash_rollout_at == stage:
+        _flight_record_and_dump(
+            "chaos_crash", reason="chaos_crash", stage=stage,
+        )
+        raise ChaosCrashError(f"chaos crash at rollout stage {stage!r}")
 
 
 def _flight_record_and_dump(kind: str, reason: str, **fields) -> None:

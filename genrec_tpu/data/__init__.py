@@ -9,6 +9,23 @@ recompilation poison for XLA (SURVEY.md §7 "static shapes everywhere").
 
 from genrec_tpu.data.schemas import SeqBatch
 from genrec_tpu.data.batching import batch_iterator, pad_to_batch
+from genrec_tpu.data.stream_log import (
+    CursorStore,
+    StreamLogCorruptError,
+    StreamLogError,
+    StreamLogReader,
+    StreamLogWriter,
+)
 from genrec_tpu.data.synthetic import SyntheticSeqDataset
 
-__all__ = ["SeqBatch", "batch_iterator", "pad_to_batch", "SyntheticSeqDataset"]
+__all__ = [
+    "CursorStore",
+    "SeqBatch",
+    "StreamLogCorruptError",
+    "StreamLogError",
+    "StreamLogReader",
+    "StreamLogWriter",
+    "SyntheticSeqDataset",
+    "batch_iterator",
+    "pad_to_batch",
+]

@@ -326,6 +326,19 @@ class FleetRouter:
                 f"fleet: reaping killed replica {rep.replica_id} failed"
             )
 
+    def engine(self, replica_id: str):
+        """The live replica's engine — the per-replica handle the
+        rollout controller (serving/rollout.py) needs to stage candidate
+        params on ONE canary and then fleet-wide (`stage_params`) and to
+        read `params_step` provenance. Raises KeyError for unknown or
+        dead replicas; routing/draining state is unaffected by anything
+        the caller does except the engine's own staging path."""
+        with self._lock:
+            rep = self._replicas.get(replica_id)
+            if rep is None or rep.dead:
+                raise KeyError(f"no live replica {replica_id!r}")
+            return rep.engine
+
     def remove_replica(self, replica_id: str, timeout: float = 60.0) -> dict:
         """Graceful scale-in: stop routing to the replica, drain it (the
         PR 5 path — queued + in-flight complete, their fleet futures

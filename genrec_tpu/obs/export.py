@@ -59,6 +59,13 @@ _COUNTER_LEAVES = frozenset({
     # engine/front stats): lifetime recording totals; ring occupancy/
     # capacity/enabled stay gauges.
     "spans_recorded", "traces_started",
+    # Checkpoint-watcher robustness + guarded rollout
+    # (serving/rollout.RolloutController.stats() under "rollout", and
+    # the engine's watcher_errors): failed poll passes and the
+    # staged/promoted/vetoed/rolled-back decision totals. The
+    # last_good_step / canary_step / freshness_s / quarantined_steps
+    # leaves stay gauges.
+    "watcher_errors", "staged", "promotions", "vetoes", "rollbacks",
 }) | frozenset(
     # Accept-length histogram leaves (genrec_spec_<head>_accept_len_hist
     # _accept_len_N): one bucket per possible accept length — depth is

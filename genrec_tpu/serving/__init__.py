@@ -20,6 +20,11 @@ from genrec_tpu.serving.heads import (
     TigerGenerativeHead,
 )
 from genrec_tpu.serving.metrics import LatencyHistogram, ServingMetrics
+from genrec_tpu.serving.rollout import (
+    RolloutConfig,
+    RolloutController,
+    RolloutError,
+)
 from genrec_tpu.serving.types import (
     DrainingError,
     HBMBudgetError,
@@ -50,6 +55,9 @@ __all__ = [
     "Request",
     "Response",
     "RetrievalHead",
+    "RolloutConfig",
+    "RolloutController",
+    "RolloutError",
     "SLOTarget",
     "ServingEngine",
     "ServingError",

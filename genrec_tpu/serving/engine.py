@@ -150,6 +150,16 @@ from genrec_tpu.serving.aot import sds_tree as _sds
 PAGED_DECODE_DONATE_ARGNUMS = (2,)
 
 
+def is_transient_fs_error(e: BaseException) -> bool:
+    """Classify a poll-loop failure as a transient filesystem condition
+    (an NFS blip, a listing racing a writer's mid-rename window, a stale
+    handle) vs a real bug. Shared by the engine's checkpoint watcher and
+    the rollout controller's publish-dir poll (serving/rollout.py): a
+    transient error is retried with backoff, never treated as "no new
+    step"."""
+    return isinstance(e, OSError)
+
+
 class _PagedRunner:
     """Slot-level continuous batching for ONE paged generative head.
 
@@ -1714,12 +1724,64 @@ class ServingEngine:
 
     # -- hot checkpoint reload -----------------------------------------------
 
+    @property
+    def params_step(self) -> Optional[int]:
+        """The checkpoint step currently serving (Response.params_step
+        provenance) — None until a versioned tree is installed."""
+        return self._step
+
+    def stage_params(self, tree, step: Optional[int], *,
+                     source: str = "rollout") -> None:
+        """Stage an externally-provided params tree for the atomic
+        between-micro-batches swap — the rollout controller's entry
+        point (serving/rollout.py), sharing the watcher's staging path
+        (`_check_like` aval validation, `_apply_pending_params` swap
+        barrier, prefix-cache invalidation). Unlike the watcher this is
+        NOT monotonic: a rollback legitimately stages a step OLDER than
+        the serving one. The swap applies at the next idle batcher pass;
+        poll `params_step` to observe it."""
+        self._check_like(tree)
+        with self._lock:
+            self._pending_params = (tree, step)
+            self._work.notify()
+        self._flight.record("hot_reload_staged", step=step, source=source)
+        self._log.info(
+            f"serving: staged params step {step} (source={source})"
+        )
+
     def _watch_loop(self) -> None:
-        while not self._stop_watch.wait(self._ckpt_poll_secs):
+        # Transient filesystem errors (an NFS blip, a listing that races
+        # a writer's rename) used to be indistinguishable from "no new
+        # step": both silently skipped the poll. Classify them instead —
+        # every failed pass counts in `watcher_errors` and leaves a
+        # flight event, and transient ones back off exponentially
+        # (bounded) so a flapping mount isn't hammered at poll rate.
+        backoff = 0.0
+        while not self._stop_watch.wait(self._ckpt_poll_secs + backoff):
             try:
                 self._check_reload()
-            except Exception:  # noqa: BLE001 — keep serving on watcher errors
-                self._log.exception("serving: checkpoint watcher pass failed")
+                backoff = 0.0
+            except Exception as e:  # noqa: BLE001 — keep serving on watcher errors
+                transient = is_transient_fs_error(e)
+                self.metrics.record_watcher_error()
+                self._flight.record(
+                    "watcher_error", transient=transient,
+                    error=f"{type(e).__name__}: {e}",
+                )
+                if transient:
+                    backoff = min(
+                        max(2 * backoff, self._ckpt_poll_secs), 30.0
+                    )
+                    self._log.warning(
+                        "serving: transient checkpoint watcher error "
+                        f"({type(e).__name__}: {e}); retrying in "
+                        f"{self._ckpt_poll_secs + backoff:.1f}s"
+                    )
+                else:
+                    backoff = 0.0
+                    self._log.exception(
+                        "serving: checkpoint watcher pass failed"
+                    )
 
     def _check_reload(self) -> None:
         mgr = self._ckpt_mgr

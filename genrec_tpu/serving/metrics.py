@@ -79,6 +79,10 @@ class ServingMetrics:
         self.warmup_compiles = 0
         self.recompilations = 0  # post-warmup compiles: steady state => 0
         self.params_swaps = 0
+        # Checkpoint-watcher poll failures (transient FS errors included)
+        # — a silently skipped poll must still be visible (docs/
+        # OBSERVABILITY.md; the flight event carries the classification).
+        self.watcher_errors = 0
         # Live-catalog subsystem: swaps applied, and AOT compiles done by
         # the catalog STAGING path on capacity-rung growth — intentional
         # off-hot-path work, counted apart from steady-state
@@ -268,6 +272,10 @@ class ServingMetrics:
         with self._lock:
             self.params_swaps += 1
 
+    def record_watcher_error(self) -> None:
+        with self._lock:
+            self.watcher_errors += 1
+
     def record_catalog_swap(self) -> None:
         with self._lock:
             self.catalog_swaps += 1
@@ -353,6 +361,7 @@ class ServingMetrics:
                 warmup_compiles=self.warmup_compiles,
                 recompilations=self.recompilations,
                 params_swaps=self.params_swaps,
+                watcher_errors=self.watcher_errors,
                 catalog_swaps=self.catalog_swaps,
                 catalog_compiles=self.catalog_compiles,
                 admits=self.admits,

@@ -142,6 +142,18 @@ GATED_METRICS: dict[str, tuple[str, float]] = {
     # claim on a compute-bound host.
     "serve/quant/streams_improvement": ("higher", 10.0),
     "serve/quant/int8_vs_fp32_qps": ("higher", 40.0),
+    # Guarded continuous rollout (PR 19): checkpoint-commit -> first
+    # response served by the promoted step on a non-canary replica,
+    # through the FULL guard (vet on the pinned batch, canary window,
+    # fleet promote). The floor is the configured poll/canary windows;
+    # the rest is scheduler noise on a shared CPU host, so the bands
+    # are wide. qps_with_rollouts_vs_none is a same-run same-backend
+    # ratio (closed-loop qps with a 1s publish cadence live vs none) —
+    # it defends the hot path against the guard machinery growing a
+    # throughput tax, with both sides saturated-CPU walls (wide band).
+    "serve/pipeline/freshness_p50_ms": ("lower", 100.0),
+    "serve/pipeline/freshness_p99_ms": ("lower", 100.0),
+    "serve/pipeline/qps_with_rollouts_vs_none": ("higher", 40.0),
 }
 
 

@@ -1,0 +1,536 @@
+"""Streaming-pipeline check (built on the shared graftlint harness,
+genrec_tpu/analysis/ir.py — CLI, verdict JSON and rc conventions
+unchanged): does the crash-consistent loop actually close, end to end,
+on ONE model?
+
+One scenario: a seeded interaction stream is appended to the CRC-framed
+`data.stream_log`, a `StreamTrainer` tails it into a real (CI-shape)
+TIGER model, publishes params on its commit cadence, and a
+`RolloutController` guards every publish into a live 2-replica serving
+pair — with REAL ``SIGKILL``s at two stages (subprocess workers; this
+script re-executes itself with ``--worker``):
+
+1. the log **appender** is SIGKILL'd mid-stream
+   (``ChaosPlan.die_in_append_at_record``) and rerun — zero lost, zero
+   duplicated records against the seeded reference;
+2. the **trainer** is SIGKILL'd mid-commit
+   (``ChaosPlan.die_in_save_at_step``) and rerun — per-step loss parity
+   <= 1e-5 against an UNINTERRUPTED oracle run over the same log, and
+   every published param tree matches the oracle's step for step
+   (that agreement IS the exact-resume claim);
+3. the published steps flow through vet -> canary -> promote onto real
+   warmed engines; a **garbage** publish (scaled params, unbounded
+   score drift) is vetoed and quarantined while the fleet keeps serving
+   last-good; a further live append -> train -> publish round promotes
+   with bounded commit->serving freshness;
+4. a background prober samples responses the whole time: **no response
+   ever carries an unvetted or quarantined ``params_step``**, and both
+   replicas' KV pools account clean after drain.
+
+Run:  python scripts/check_pipeline.py             (default shapes)
+      python scripts/check_pipeline.py --small     (CI-speed shapes)
+Appends a verdict line to docs/PERF.md when --write-note is passed.
+Prints ONE JSON verdict line on stdout; rc 0 ok / 1 failed.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# ---------------------------------------------------------------------------
+# shared fixture — ONE definition for parent, workers, and the oracle, or
+# cross-process loss/param parity would mean nothing
+# ---------------------------------------------------------------------------
+
+ARCH = dict(embedding_dim=16, attn_dim=32, dropout=0.0, num_heads=4,
+            n_layers=2, num_item_embeddings=8, num_user_embeddings=20,
+            sem_id_dim=3)
+ITEMS = 4                      # history items per training example
+D = ARCH["sem_id_dim"]
+L = ITEMS * D
+ROW_INTS = 1 + L + D           # user id + input ids + target ids
+CHUNK_RECORDS = 16
+ROWS_PER_STEP = 8              # 2 optimizer steps per chunk
+
+
+def _gen_records(n, seed):
+    """The seeded record stream: one int32 row per example."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    rows = np.concatenate([
+        rng.integers(0, ARCH["num_user_embeddings"], (n, 1)),
+        rng.integers(0, ARCH["num_item_embeddings"], (n, L)),
+        rng.integers(0, ARCH["num_item_embeddings"], (n, D)),
+    ], axis=1).astype(np.int32)
+    return [r.tobytes() for r in rows]
+
+
+def _make_arrays(payloads, epoch):
+    import numpy as np
+
+    rows = np.stack([np.frombuffer(p, np.int32) for p in payloads])
+    B = len(rows)
+    return {
+        "user_ids": rows[:, 0].copy(),
+        "item_input_ids": rows[:, 1:1 + L].copy(),
+        "token_type_ids": np.tile(np.arange(D, dtype=np.int32), (B, ITEMS)),
+        "target_ids": rows[:, 1 + L:].copy(),
+        "target_token_type_ids": np.tile(np.arange(D, dtype=np.int32),
+                                         (B, 1)),
+        "seq_mask": np.ones((B, L), np.int32),
+    }
+
+
+def _model_and_params():
+    import jax
+    import jax.numpy as jnp
+
+    from genrec_tpu.models.tiger import Tiger
+
+    model = Tiger(**ARCH)
+    params = model.init(
+        jax.random.key(0),
+        jnp.zeros((1,), jnp.int32), jnp.zeros((1, L), jnp.int32),
+        jnp.zeros((1, L), jnp.int32), jnp.zeros((1, D), jnp.int32),
+        jnp.zeros((1, D), jnp.int32), jnp.ones((1, L), jnp.int32),
+    )["params"]
+    return model, params
+
+
+def _build_trainer(cfg, handle_signals=True):
+    import jax
+    import optax
+
+    from genrec_tpu.core.harness import jit_train_step, make_train_step
+    from genrec_tpu.core.state import TrainState
+    from genrec_tpu.trainers.stream_trainer import StreamTrainer
+
+    model, params = _model_and_params()
+    optimizer = optax.adamw(1e-3, weight_decay=0.01)
+
+    def loss_fn(p, batch, step_rng):
+        out = model.apply(
+            {"params": p},
+            batch["user_ids"], batch["item_input_ids"],
+            batch["token_type_ids"], batch["target_ids"],
+            batch["target_token_type_ids"], batch["seq_mask"],
+            deterministic=False, rngs={"dropout": step_rng},
+        )
+        return out.loss, {}
+
+    step_fn = jit_train_step(
+        make_train_step(loss_fn, optimizer, accum_steps=1, clip_norm=1.0)
+    )
+    state = TrainState.create(params, optimizer, jax.random.key(1))
+    return StreamTrainer(
+        log_dir=cfg["log_dir"], save_dir_root=cfg["save_dir"], state=state,
+        step_fn=step_fn, make_arrays=_make_arrays,
+        chunk_records=CHUNK_RECORDS, rows_per_step=ROWS_PER_STEP,
+        row_len=ROW_INTS, seed=0, publish_dir=cfg["publish_dir"],
+        commit_every_steps=1, publish_every_steps=0,
+        handle_signals=handle_signals,
+    )
+
+
+# ---------------------------------------------------------------------------
+# --worker modes (the SIGKILL-able subprocess stages)
+# ---------------------------------------------------------------------------
+
+
+def _worker_append(cfg):
+    from genrec_tpu.core import chaos
+    from genrec_tpu.data.stream_log import StreamLogWriter
+
+    records = _gen_records(cfg["n"], cfg["seed"])
+    plan = (chaos.ChaosPlan(die_in_append_at_record=cfg["die_at"])
+            if cfg.get("die_at") is not None else None)
+    with StreamLogWriter(cfg["log_dir"]) as w:
+        start = w.records_committed
+        with chaos.inject(plan) if plan else contextlib.nullcontext():
+            for i in range(start, cfg["n"]):
+                w.append(records[i])
+        committed = w.records_committed
+    return {"resumed_from": start, "committed": committed}
+
+
+def _worker_train(cfg):
+    from genrec_tpu.core import chaos
+
+    plan = (chaos.ChaosPlan(die_in_save_at_step=cfg["die_in_save"])
+            if cfg.get("die_in_save") is not None else None)
+    trainer = _build_trainer(cfg)
+    with chaos.inject(plan) if plan else contextlib.nullcontext():
+        return trainer.run(max_chunks=cfg.get("max_chunks"),
+                           idle_timeout_s=cfg.get("idle_timeout_s", 5.0))
+
+
+def _worker_main(mode, cfg_json):
+    cfg = json.loads(cfg_json)
+    out = {"append": _worker_append, "train": _worker_train}[mode](cfg)
+    print("WORKER " + json.dumps(out), file=sys.stderr, flush=True)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# the check
+# ---------------------------------------------------------------------------
+
+
+def _losses_by_step(save_dir, allow_replay=False):
+    """Step -> loss from metrics.jsonl. A SIGKILL'd run replays the steps
+    after its last durable commit; every replayed value must then agree
+    with the original — that agreement is part of the exactness claim."""
+    out, replay_err = {}, 0.0
+    with open(os.path.join(save_dir, "metrics.jsonl")) as fh:
+        for line in fh:
+            rec = json.loads(line)
+            if "train/loss" in rec and "global_step" in rec:
+                step = int(rec["global_step"])
+                if step in out:
+                    if not allow_replay:
+                        raise AssertionError(f"step {step} logged twice")
+                    replay_err = max(replay_err,
+                                     abs(out[step] - rec["train/loss"]))
+                out[step] = rec["train/loss"]
+    return out, replay_err
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "--worker":
+        return _worker_main(argv[1], argv[2])
+
+    from genrec_tpu.analysis import ir
+
+    args = ir.check_args(argv)
+
+    import subprocess
+    import tempfile
+    import threading
+    import time
+
+    import jax
+
+    if args.platform:
+        from genrec_tpu.parallel.mesh import pin_platform
+
+        pin_platform(args.platform)
+
+    import numpy as np
+
+    from genrec_tpu.core.checkpoint import CheckpointManager
+    from genrec_tpu.data.stream_log import StreamLogReader, StreamLogWriter
+    from genrec_tpu.serving import (
+        BucketLadder, PagedConfig, Request, ServingEngine,
+    )
+    from genrec_tpu.serving.heads import TigerGenerativeHead
+    from genrec_tpu.serving.rollout import RolloutConfig, RolloutController
+
+    backend = jax.default_backend()
+    # Same model/chunk shapes in both modes (the CI arch is the point —
+    # the loop is the scenario, not the scale); full mode streams more
+    # chunks through every stage.
+    n_chunks = 3 if args.small else 5
+    n_records = n_chunks * CHUNK_RECORDS
+    steps_per_chunk = CHUNK_RECORDS // ROWS_PER_STEP
+    final_step = n_chunks * steps_per_chunk
+
+    work = tempfile.mkdtemp(prefix="genrec_pipeline_")
+    log_dir = os.path.join(work, "log")
+    save_dir = os.path.join(work, "train")
+    publish_dir = os.path.join(work, "publish")
+    oracle_dir = os.path.join(work, "oracle")
+    env = dict(os.environ)
+    if args.platform:
+        env["JAX_PLATFORMS"] = args.platform
+
+    def run_worker(mode, cfg, expect_sigkill=False):
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--worker", mode, json.dumps(cfg)],
+            capture_output=True, text=True, timeout=600, env=env, cwd=REPO,
+        )
+        if expect_sigkill:
+            assert proc.returncode == -9, (
+                f"worker {mode} survived its chaos kill: rc="
+                f"{proc.returncode}\n{proc.stderr[-2000:]}"
+            )
+            return None
+        assert proc.returncode == 0, (
+            f"worker {mode} failed rc={proc.returncode}\n"
+            f"{proc.stderr[-2000:]}"
+        )
+        line = [l for l in proc.stderr.splitlines()
+                if l.startswith("WORKER ")][-1]
+        return json.loads(line[len("WORKER "):])
+
+    problems = []
+
+    def check(cond, what):
+        if not cond:
+            problems.append(what)
+        return cond
+
+    # -- stage 1: append with a mid-stream SIGKILL --------------------------
+    reference = _gen_records(n_records, seed=7)
+    die_at = n_records // 2
+    run_worker("append", {"log_dir": log_dir, "n": n_records, "seed": 7,
+                          "die_at": die_at}, expect_sigkill=True)
+    ap = run_worker("append", {"log_dir": log_dir, "n": n_records,
+                               "seed": 7})
+    got = StreamLogReader(log_dir).read()
+    lost = len([r for r in reference if r not in set(got)])
+    dup = len(got) - len(set(got))
+    check(ap["resumed_from"] == die_at, "appender resumed at wrong record")
+    check(got == reference, "recovered log != seeded reference")
+
+    # -- stage 2: oracle train (uninterrupted, in-process) -------------------
+    oracle = _build_trainer(
+        {"log_dir": log_dir, "save_dir": oracle_dir,
+         "publish_dir": os.path.join(work, "oracle_publish")},
+        handle_signals=False,
+    )
+    osum = oracle.run(max_chunks=n_chunks, idle_timeout_s=5.0)
+    oracle_losses, _ = _losses_by_step(oracle_dir)
+    check(osum["global_step"] == final_step, "oracle step count off")
+
+    # -- stage 3: trainer SIGKILL'd mid-commit, rerun to completion ---------
+    tcfg = {"log_dir": log_dir, "save_dir": save_dir,
+            "publish_dir": publish_dir, "max_chunks": n_chunks}
+    run_worker("train", {**tcfg, "die_in_save": final_step // 2},
+               expect_sigkill=True)
+    tsum = run_worker("train", tcfg)
+    losses, replay_err = _losses_by_step(save_dir, allow_replay=True)
+    parity_err = replay_err
+    check(sorted(losses) == sorted(oracle_losses) ==
+          list(range(1, final_step + 1)), "trained step sets differ")
+    for step, loss in oracle_losses.items():
+        parity_err = max(parity_err, abs(loss - losses.get(step, np.inf)))
+    published = [s * steps_per_chunk for s in range(1, n_chunks + 1)]
+    check(tsum["global_step"] == final_step, "resumed trainer step count off")
+
+    _, init_params = _model_and_params()
+    mgr = CheckpointManager(publish_dir)
+    param_err = 0.0
+    for step in published:
+        tree = mgr.validate_and_restore(init_params, step)
+        otree = CheckpointManager(
+            os.path.join(work, "oracle_publish")
+        ).validate_and_restore(init_params, step)
+        diffs = jax.tree_util.tree_map(
+            lambda a, b: float(np.max(np.abs(np.asarray(a, np.float64)
+                                             - np.asarray(b, np.float64)))),
+            tree, otree,
+        )
+        param_err = max(param_err, max(jax.tree_util.tree_leaves(diffs)))
+    resume_exact = parity_err <= 1e-5 and param_err <= 1e-5
+    check(resume_exact, f"resume drifted: loss {parity_err}, params "
+                        f"{param_err}")
+
+    # -- stage 4: guarded rollout onto real warmed engines ------------------
+    model, _ = _model_and_params()
+    ladder = BucketLadder((1, 2), (8,))
+    rng = np.random.default_rng(0)
+    valid_ids = np.unique(
+        rng.integers(0, ARCH["num_item_embeddings"], (50, D)), axis=0
+    )
+    n_tok = 1 + ladder.history_buckets[-1] * D
+    pcfg = PagedConfig(max_slots=4, page_size=8,
+                       pages_per_slot=-(-n_tok // 8))
+
+    def make_engine(rid):
+        head = TigerGenerativeHead(model, valid_ids, top_k=5)
+        return ServingEngine(
+            [head], init_params, ladder=ladder, max_batch=2,
+            max_wait_ms=2.0, handle_signals=False, paged_config=pcfg,
+            replica_id=rid,
+        ).start()
+
+    class MiniRouter:
+        def __init__(self):
+            self._eng = {r: make_engine(r) for r in ("r0", "r1")}
+
+        def replica_ids(self):
+            return list(self._eng)
+
+        def engine(self, rid):
+            return self._eng[rid]
+
+    router = MiniRouter()
+    for rid in ("r0", "r1"):
+        router.engine(rid).submit(
+            Request(head="tiger", history=np.array([1, 2]))
+        ).result(timeout=300)
+
+    # Background prober: every response's params_step is provenance the
+    # verdict audits — nothing unvetted or quarantined may ever serve.
+    served = []
+    stop_probe = threading.Event()
+
+    def probe_loop():
+        while not stop_probe.is_set():
+            for rid in ("r0", "r1"):
+                with contextlib.suppress(Exception):
+                    r = router.engine(rid).submit(Request(
+                        head="tiger", history=np.array([3, 4, 5]),
+                    )).result(timeout=60)
+                    served.append((rid, r.params_step))
+            stop_probe.wait(0.05)
+
+    prober = threading.Thread(target=probe_loop, daemon=True)
+    prober.start()
+
+    vet = [Request(head="tiger", history=np.array([1, 2, 3])),
+           Request(head="tiger", history=np.array([4, 5]))]
+    ctrl = RolloutController(
+        router, TigerGenerativeHead(model, valid_ids, top_k=5), publish_dir,
+        params_like=init_params, vet_requests=vet,
+        state_path=os.path.join(work, "rollout_state.json"), initial_step=0,
+        # Drift bound sized to the fixture: real training moves the vet
+        # scores by O(10) over a few chunks; the garbage publish below
+        # drifts by O(1e11). The bound separates those regimes, not noise.
+        config=RolloutConfig(poll_secs=0.1, canary_window_s=0.3,
+                             canary_min_responses=2,
+                             vet_max_score_drift=1e6),
+    ).start()
+
+    def wait_for(pred, what, secs=120.0):
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < secs:
+            if pred():
+                return True
+            time.sleep(0.1)
+        return check(False, f"timeout waiting for {what}: {ctrl.stats()}")
+
+    wait_for(lambda: ctrl.stats()["last_good_step"] == final_step,
+             f"promote of step {final_step}")
+    check(router.engine("r0").params_step == final_step
+          and router.engine("r1").params_step == final_step,
+          "fleet not on the promoted step")
+
+    # Garbage publish: scaled params blow the pinned vet batch's score
+    # drift bound — vetoed + quarantined while the fleet serves last-good.
+    garbage_step = final_step + 1
+    mgr.save(garbage_step, jax.tree_util.tree_map(
+        lambda x: np.asarray(x) * 60.0, init_params))
+    mgr.wait()
+    wait_for(lambda: ctrl.stats()["vetoes"] >= 1, "garbage veto")
+    s = ctrl.stats()
+    check(s["last_good_step"] == final_step
+          and router.engine("r0").params_step == final_step
+          and router.engine("r1").params_step == final_step,
+          "fleet moved off last-good after a garbage publish")
+
+    # Live round: append one more chunk, train it, and time the promote —
+    # commit -> fleet-serving freshness is the loop's latency.
+    with StreamLogWriter(log_dir) as w:
+        for rec in _gen_records(n_records + CHUNK_RECORDS, seed=7)[n_records:]:
+            w.append(rec)
+    run_worker("train", {**tcfg, "max_chunks": n_chunks + 1})
+    live_step = final_step + steps_per_chunk
+    t_pub = time.monotonic()
+    wait_for(lambda: ctrl.stats()["last_good_step"] == live_step,
+             f"live promote of step {live_step}")
+    first_serve_s = None
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < 120.0:
+        r = router.engine("r0").submit(
+            Request(head="tiger", history=np.array([6, 7]))
+        ).result(timeout=60)
+        if r.params_step == live_step:
+            first_serve_s = round(time.monotonic() - t_pub, 3)
+            break
+        time.sleep(0.05)
+    check(first_serve_s is not None, "new step never reached r0 traffic")
+
+    stop_probe.set()
+    prober.join(timeout=120.0)
+    stats = ctrl.stop()
+
+    # None = the engines' untagged initial params (served before the
+    # controller's first stage) — the same tree initial_step=0 names.
+    allowed = {None, 0, live_step, *published}
+    unvetted = [s_ for _, s_ in served if s_ not in allowed]
+    garbage_served = sum(1 for _, s_ in served if s_ == garbage_step)
+    pages = slots = 0
+    for rid in ("r0", "r1"):
+        eng = router.engine(rid)
+        eng.stop()
+        snap = eng.stats()
+        pages += sum(g.get("pages_in_use", 0)
+                     for g in (snap.get("kv_pool") or {}).values())
+        slots += sum(g.get("slots_active", 0)
+                     for g in (snap.get("kv_pool") or {}).values())
+    mgr.close()
+
+    verdict = {
+        "backend": backend,
+        "records_appended": len(got),
+        "records_lost": lost,
+        "records_duplicated": dup,
+        "sigkills": 2,
+        "steps_trained": tsum["global_step"],
+        "published_steps": published + [live_step],
+        "loss_parity_max_err": float(parity_err),
+        "param_parity_max_err": float(param_err),
+        "resume_exact": bool(resume_exact),
+        "promotions": stats["promotions"],
+        "vetoes": stats["vetoes"],
+        "rollbacks": stats["rollbacks"],
+        "quarantined_steps": stats["quarantined_steps"],
+        "last_good_step": stats["last_good_step"],
+        "responses_served": len(served),
+        "unvetted_serves": len(unvetted),
+        "garbage_served": garbage_served,
+        "freshness_s": stats["freshness_s"],
+        "first_serve_s": first_serve_s,
+        "pages_in_use_final": pages,
+        "slots_active_final": slots,
+        "ok": False,
+    }
+    ok = (
+        not problems
+        and lost == 0 and dup == 0
+        and resume_exact
+        and stats["promotions"] == 2
+        and stats["vetoes"] == 1
+        and stats["last_good_step"] == live_step
+        and len(served) > 0
+        and len(unvetted) == 0 and garbage_served == 0
+        and first_serve_s is not None and 0.0 < first_serve_s < 120.0
+        and 0.0 < stats["freshness_s"] < 120.0
+        and pages == 0 and slots == 0
+    )
+    verdict["ok"] = ok
+    ir.emit_verdict(verdict)
+    if problems:
+        print("check_pipeline problems: " + "; ".join(problems),
+              file=sys.stderr)
+
+    if args.write_note:
+        if ok:
+            msg = (
+                f"OK: {len(got)} records streamed through append->train->"
+                f"publish->canary->promote with 2 SIGKILLs — 0 lost/dup, "
+                f"loss parity {parity_err:.2e}, garbage publish vetoed, "
+                f"{len(served)} audited responses all on vetted steps, "
+                f"commit->serving freshness {first_serve_s}s, pools clean"
+            )
+        else:
+            msg = "ATTENTION: streaming pipeline lost data or served unvetted params"
+        ir.append_perf_note(
+            f"\n- Pipeline check (scripts/check_pipeline.py, "
+            f"backend={backend}): {msg}\n"
+        )
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -41,6 +41,16 @@
 #                            autoscaler standby backfill, zero lost
 #                            accepted requests, typed errors only,
 #                            zero recompiles, parity vs co-located
+#   check_pipeline.py      — streaming pipeline: seeded log -> stream
+#                            trainer -> publish -> canary -> promote on
+#                            ONE tiny TIGER, with real SIGKILLs at the
+#                            append and commit stages — zero lost/dup
+#                            CRC-verified records, per-step loss parity
+#                            vs an uninterrupted oracle, garbage publish
+#                            vetoed while the fleet serves last-good,
+#                            no response on an unvetted params_step,
+#                            bounded commit->serving freshness, pools
+#                            clean after drain
 #   check_quant_hlo.py     — quantized serving: int8 KV pool + int8
 #                            retrieval table on ONE engine under
 #                            mixed-dtype churn — zero steady-state
@@ -204,6 +214,16 @@ if [ "$MODE" = "--smoke" ]; then
     if [ -z "${GENREC_CI_SKIP_SPEC:-}" ]; then
         run python scripts/check_spec_hlo.py --small --platform cpu
     fi
+    # Streaming-pipeline smoke: append -> train -> publish -> canary ->
+    # promote on one tiny TIGER with real SIGKILLs at two stages — zero
+    # lost/dup records, oracle-exact resume, garbage publish vetoed,
+    # zero unvetted serves, pools clean. GENREC_CI_SKIP_PIPELINE=1
+    # skips it for callers whose pytest pass already runs
+    # tests/test_pipeline.py + tests/test_stream_log.py directly (same
+    # contract as the knobs above).
+    if [ -z "${GENREC_CI_SKIP_PIPELINE:-}" ]; then
+        run python scripts/check_pipeline.py --small --platform cpu
+    fi
     # Quantized-serving smoke: int8 KV + int8 retrieval table on one
     # engine under mixed-dtype churn — zero recompiles, ledger ==
     # quantized byte math, no whole-pool fp32 upcast in optimized HLO.
@@ -284,6 +304,7 @@ else
     run python scripts/check_disagg.py --write-note
     run python scripts/check_crosshost.py --write-note
     run python scripts/check_chaosnet.py --write-note
+    run python scripts/check_pipeline.py --write-note
     run python scripts/check_spec_hlo.py --write-note
     run python scripts/check_quant_hlo.py --write-note
     run python scripts/check_lineage.py --write-note

@@ -67,6 +67,14 @@ LINEAGE_KEYS = {"backend", "submitted", "completed", "traces_checked",
                 "max_segment_sum_error_ms", "segments", "wire_trace_ok",
                 "recompilations", "trace_path", "ok"}
 QUANT_KEYS = {"backend", "churn", "pool_hlo", "recompilations", "ok"}
+PIPELINE_KEYS = {"backend", "records_appended", "records_lost",
+                 "records_duplicated", "sigkills", "steps_trained",
+                 "published_steps", "loss_parity_max_err",
+                 "param_parity_max_err", "resume_exact", "promotions",
+                 "vetoes", "rollbacks", "quarantined_steps",
+                 "last_good_step", "responses_served", "unvetted_serves",
+                 "garbage_served", "freshness_s", "first_serve_s",
+                 "pages_in_use_final", "slots_active_final", "ok"}
 # bench_gate is the new perf regression gate (one verdict line,
 # graftlint mold); check_obs's grown verdict (memory + slo sections) is
 # exercised by its own full run in ci_checks, not re-run here.
@@ -117,7 +125,8 @@ def test_check_scripts_keep_their_cli():
                    "check_fused_ce_hlo", "check_serving_hlo",
                    "check_catalog_hlo", "check_fleet", "check_disagg",
                    "check_crosshost", "check_chaosnet", "check_spec_hlo",
-                   "check_lineage", "check_obs", "check_quant_hlo"):
+                   "check_lineage", "check_obs", "check_quant_hlo",
+                   "check_pipeline"):
         proc = subprocess.run(
             [sys.executable, os.path.join(REPO, "scripts", f"{script}.py"),
              "--help"],
@@ -131,13 +140,14 @@ def test_check_scripts_keep_their_cli():
 def test_ci_checks_smoke_entrypoint():
     """The consolidated entrypoint runs every smoke check and exits 0
     (rc=2 inconclusives tolerated, real failures propagated)."""
-    # The chaos-unit, obs, graftlint, catalog, quant and chaosnet
-    # subsets are skipped here: this test runs INSIDE the suite that
-    # already executes tests/test_fault_tolerance.py, tests/test_obs.py,
-    # tests/test_analysis.py, tests/test_catalog.py,
-    # tests/test_quantized.py and tests/test_chaosnet.py directly, and
-    # nesting them would double-pay their cold-start (~30s-4min each)
-    # for no coverage (check_quant_hlo's and check_chaosnet's verdict
+    # The chaos-unit, obs, graftlint, catalog, quant, chaosnet and
+    # pipeline subsets are skipped here: this test runs INSIDE the suite
+    # that already executes tests/test_fault_tolerance.py,
+    # tests/test_obs.py, tests/test_analysis.py, tests/test_catalog.py,
+    # tests/test_quantized.py, tests/test_chaosnet.py and
+    # tests/test_pipeline.py directly, and nesting them would double-pay
+    # their cold-start (~30s-4min each) for no coverage
+    # (check_quant_hlo's, check_chaosnet's and check_pipeline's verdict
     # schemas are pinned by the slow-marked tests below). The
     # (jax-free, sub-second) bench_gate self-test stays.
     proc = subprocess.run(
@@ -147,13 +157,14 @@ def test_ci_checks_smoke_entrypoint():
              "GENREC_CI_SKIP_CHAOS": "1", "GENREC_CI_SKIP_OBS": "1",
              "GENREC_CI_SKIP_LINT": "1", "GENREC_CI_SKIP_CATALOG": "1",
              "GENREC_CI_SKIP_QUANT": "1",
-             "GENREC_CI_SKIP_CHAOSNET": "1"},
+             "GENREC_CI_SKIP_CHAOSNET": "1",
+             "GENREC_CI_SKIP_PIPELINE": "1"},
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     # One verdict JSON per check on stdout (decode, fused-ce, packed,
     # serving, fleet, disagg, crosshost, spec, lineage, bench-gate
-    # self-test; the quant and chaosnet checks are env-skipped above,
-    # so the unfiltered smoke emits two more).
+    # self-test; the quant, chaosnet and pipeline checks are env-skipped
+    # above, so the unfiltered smoke emits three more).
     verdicts = [json.loads(l) for l in proc.stdout.splitlines() if l.strip()]
     assert len(verdicts) == 10
     lineage = [v for v in verdicts if "segment_sum_ok" in v]
@@ -219,6 +230,38 @@ def test_chaosnet_check_small():
     assert verdict["recompilations_front"] == 0
     assert verdict["recompilations_peers"] == 0
     assert verdict["child_rcs"] == [0, 0]
+
+
+@pytest.mark.slow
+def test_pipeline_check_small():
+    """check_pipeline's verdict schema + the closed-loop pins (slow: it
+    streams a seeded log through append -> train -> publish -> canary ->
+    promote with two subprocess SIGKILLs and two warmed engines, ~2min —
+    the tier-1 suite covers the same machinery via tests/test_pipeline.py
+    and tests/test_stream_log.py; this pins the SMOKE CHECK's contract
+    for the shell entrypoint, which runs it unless
+    GENREC_CI_SKIP_PIPELINE is set)."""
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "scripts", "check_pipeline.py"),
+         "--small", "--platform", "cpu"],
+        capture_output=True, text=True, timeout=900,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    verdict = json.loads(lines[-1])
+    assert set(verdict) == PIPELINE_KEYS
+    assert verdict["records_lost"] == 0
+    assert verdict["records_duplicated"] == 0
+    assert verdict["sigkills"] == 2 and verdict["resume_exact"]
+    assert verdict["loss_parity_max_err"] <= 1e-5
+    assert verdict["promotions"] == 2 and verdict["vetoes"] == 1
+    assert verdict["unvetted_serves"] == 0
+    assert verdict["garbage_served"] == 0
+    assert verdict["pages_in_use_final"] == 0
+    assert verdict["slots_active_final"] == 0
+    assert 0.0 < verdict["freshness_s"] < 120.0
 
 
 @pytest.mark.slow

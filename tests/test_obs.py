@@ -716,6 +716,16 @@ def test_prometheus_text_exposition():
             "roles": {"tiger": {"prefill": {"headroom": 0.9},
                                 "decode": {"headroom": 0.5}}},
         },
+        # Guarded rollout (serving/rollout.RolloutController.stats(),
+        # exported under "rollout") + the engine's checkpoint-watcher
+        # error counter: decision totals and failed poll passes are
+        # counters; the step gauges and freshness are gauges.
+        "watcher_errors": 2,
+        "rollout": {
+            "staged": 4, "promotions": 3, "vetoes": 1, "rollbacks": 0,
+            "watcher_errors": 1, "last_good_step": 120, "canary_step": -1,
+            "quarantined_steps": 1, "freshness_s": 0.42,
+        },
     })
     lines = text.splitlines()
     assert "# TYPE genrec_completed counter" in lines
@@ -733,6 +743,16 @@ def test_prometheus_text_exposition():
     assert "# TYPE genrec_disagg_pending_handoffs gauge" in lines
     assert "# TYPE genrec_disagg_transfer_ms_p50 gauge" in lines
     assert "# TYPE genrec_disagg_roles_tiger_prefill_headroom gauge" in lines
+    assert "# TYPE genrec_watcher_errors counter" in lines
+    assert "# TYPE genrec_rollout_watcher_errors counter" in lines
+    assert "# TYPE genrec_rollout_staged counter" in lines
+    assert "# TYPE genrec_rollout_promotions counter" in lines
+    assert "# TYPE genrec_rollout_vetoes counter" in lines
+    assert "# TYPE genrec_rollout_rollbacks counter" in lines
+    assert "# TYPE genrec_rollout_last_good_step gauge" in lines
+    assert "# TYPE genrec_rollout_canary_step gauge" in lines
+    assert "# TYPE genrec_rollout_quarantined_steps gauge" in lines
+    assert "# TYPE genrec_rollout_freshness_s gauge" in lines
 
 
 def test_trace_report_cli_summarizes(tmp_path, capsys):

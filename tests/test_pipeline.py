@@ -1,0 +1,582 @@
+"""Kill-at-every-stage chaos suite for the streaming pipeline:
+append-only log -> StreamTrainer -> publish dir -> guarded rollout.
+
+Mirrors tests/test_fault_tolerance.py's discipline: inject the fault
+through `core.chaos`, restart the component, and assert EXACTNESS (loss
+parity, zero lost/duplicated records, durable quarantine) rather than
+mere survival. The stages and their kill points:
+
+- **log append** — ``die_in_append_at_record``: a REAL SIGKILL in a
+  subprocess (tests/_pipeline_worker.py) after a torn frame hits disk;
+  the restarted producer resumes from ``records_committed`` with zero
+  loss and zero duplication. (Byte-level truncate/garble sweeps live in
+  tests/test_stream_log.py.)
+- **trainer mid-commit** — SIGTERM (``kill_at_step``, in-process) and
+  SIGKILL (``die_in_save_at_step``, subprocess, @slow): the resumed run
+  matches an uninterrupted one per step.
+- **publish** — ``die_in_publish_at_step`` (subprocess, @slow): the torn
+  marker-less publish is quarantined on restart and never served.
+- **rollout mid-canary / mid-promote** — ``crash_rollout_at``: the
+  controller thread dies at the transition; a successor rolls the canary
+  back (candidate re-vetted) or finishes the durable promote. Exercised
+  on duck-typed fake engines so the guard's state machine is pinned
+  without paying serving-engine compiles; the real-engine integration
+  run is scripts/check_pipeline.py.
+"""
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+import time
+from concurrent.futures import Future
+from types import SimpleNamespace
+
+import jax
+import numpy as np
+import pytest
+
+from genrec_tpu.core import chaos
+from genrec_tpu.core.checkpoint import _COMMIT_MARKER, CheckpointManager
+from genrec_tpu.data.stream_log import StreamLogReader, StreamLogWriter
+from genrec_tpu.obs.flight_recorder import get_flight_recorder
+from genrec_tpu.serving import Request
+from genrec_tpu.serving.rollout import RolloutConfig, RolloutController
+
+from tests._pipeline_worker import toy_stream_trainer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_WORKER = os.path.join(REPO, "tests", "_pipeline_worker.py")
+
+
+def _run_worker(mode, cfg, expect_sigkill=False):
+    env = dict(os.environ, PYTHONPATH=REPO)
+    proc = subprocess.run(
+        [sys.executable, _WORKER, mode, json.dumps(cfg)],
+        capture_output=True, text=True, cwd=REPO, timeout=600, env=env,
+    )
+    if expect_sigkill:
+        assert proc.returncode == -9, (proc.returncode, proc.stderr[-2000:])
+        return None
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = [l for l in proc.stdout.splitlines() if l.startswith("WORKER ")]
+    assert line, proc.stdout[-2000:]
+    return json.loads(line[-1][len("WORKER "):])
+
+
+def _expected_rows(n, seed):
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal((n, 6)).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# stage: log append (SIGKILL with a torn frame on disk)
+# ---------------------------------------------------------------------------
+
+
+def test_append_sigkill_resumes_with_zero_loss_zero_duplication(tmp_path):
+    log_dir = str(tmp_path / "log")
+    cfg = {"log_dir": log_dir, "n": 20, "seed": 3}
+    _run_worker("append", {**cfg, "die_at": 7}, expect_sigkill=True)
+    # Records 0..6 committed; record 7 is a REAL torn frame on disk.
+    reader = StreamLogReader(log_dir)
+    assert reader.count() == 7
+    # Restarted producer: resumes at the committed index, replays nothing.
+    out = _run_worker("append", cfg)
+    assert out == {"resumed_from": 7, "committed": 20}
+    got = [np.frombuffer(p, np.float32) for p in reader.read()]
+    np.testing.assert_array_equal(np.stack(got), _expected_rows(20, 3))
+
+
+# ---------------------------------------------------------------------------
+# stage: trainer (SIGTERM mid-chunk, in-process)
+# ---------------------------------------------------------------------------
+
+
+def _fill_log(log_dir, n, seed=0):
+    with StreamLogWriter(log_dir) as w:
+        for row in _expected_rows(n, seed):
+            w.append(row.tobytes())
+
+
+def _losses_by_step(save_dir, allow_replay=False):
+    """Step -> loss from metrics.jsonl. A SIGTERM'd+resumed run may not
+    log any step twice; a SIGKILL'd run legitimately replays the steps
+    after its last durable commit — then every replayed value must agree
+    with the original to 1e-5 (that agreement IS the exactness claim)."""
+    out = {}
+    with open(os.path.join(save_dir, "metrics.jsonl")) as f:
+        for line in f:
+            rec = json.loads(line)
+            if "train/loss" in rec and "global_step" in rec:
+                step = int(rec["global_step"])
+                if step in out:
+                    assert allow_replay, f"step {step} logged twice"
+                    assert out[step] == pytest.approx(
+                        rec["train/loss"], abs=1e-5
+                    ), f"replayed step {step} diverged"
+                out[step] = rec["train/loss"]
+    return out
+
+
+def _trainer_cfg(tmp_path, name, **kw):
+    return {
+        "log_dir": str(tmp_path / "log"), "save_dir": str(tmp_path / name),
+        "publish_dir": str(tmp_path / name / "publish"), "max_chunks": 3,
+        **kw,
+    }
+
+
+def _restore_published(publish_dir, step):
+    mgr = CheckpointManager(publish_dir)
+    try:
+        return mgr.validate_and_restore(
+            {"w": np.zeros((4, 2), np.float32)}, step
+        )
+    finally:
+        mgr.close()
+
+
+def test_stream_trainer_sigterm_midchunk_resumes_exactly(tmp_path):
+    _fill_log(str(tmp_path / "log"), 48)
+    cfg_a = _trainer_cfg(tmp_path, "uninterrupted")
+    summary = toy_stream_trainer(cfg_a).run(max_chunks=3, idle_timeout_s=1.0)
+    assert summary["chunks_done"] == 3 and summary["global_step"] == 6
+    assert summary["published_steps"] == [2, 4, 6]
+
+    cfg_b = _trainer_cfg(tmp_path, "interrupted")
+    with chaos.inject(chaos.ChaosPlan(kill_at_step=3)):
+        out = toy_stream_trainer(cfg_b).run(max_chunks=3, idle_timeout_s=1.0)
+    assert out["preempted"] and out["global_step"] == 3
+    out = toy_stream_trainer(cfg_b).run(max_chunks=3, idle_timeout_s=1.0)
+    assert not out["preempted"] and out["global_step"] == 6
+    assert out["records_consumed"] == 48
+
+    la = _losses_by_step(cfg_a["save_dir"])
+    lb = _losses_by_step(cfg_b["save_dir"])
+    assert sorted(la) == sorted(lb) == [1, 2, 3, 4, 5, 6]
+    for s in la:
+        assert la[s] == pytest.approx(lb[s], abs=1e-5), f"diverged at {s}"
+    # The published param trees match step for step.
+    for step in (2, 4, 6):
+        jax.tree_util.tree_map(
+            lambda u, v: np.testing.assert_allclose(
+                np.asarray(u), np.asarray(v), atol=1e-5
+            ),
+            _restore_published(cfg_a["publish_dir"], step),
+            _restore_published(cfg_b["publish_dir"], step),
+        )
+    # The durable cursor names the fully-consumed stream position.
+    cur = json.load(open(os.path.join(cfg_b["save_dir"], "stream_cursor.json")))
+    assert cur["record"] == 48 and cur["meta"]["global_step"] == 6
+
+
+def test_stream_trainer_waits_for_records_then_consumes(tmp_path):
+    """The tail loop blocks on chunk availability — a half-written chunk
+    is never repacked — and picks up records appended while idle."""
+    log_dir = str(tmp_path / "log")
+    _fill_log(log_dir, 8)  # half a chunk
+    cfg = _trainer_cfg(tmp_path, "run", max_chunks=1)
+    t = toy_stream_trainer(cfg)
+    summary = t.run(max_chunks=1, idle_timeout_s=0.5)
+    assert summary["chunks_done"] == 0 and summary["global_step"] == 0
+    with StreamLogWriter(log_dir) as w:
+        for row in _expected_rows(16, 0)[8:]:
+            w.append(row.tobytes())
+    summary = toy_stream_trainer(cfg).run(max_chunks=1, idle_timeout_s=0.5)
+    assert summary["chunks_done"] == 1 and summary["global_step"] == 2
+
+
+# ---------------------------------------------------------------------------
+# stage: trainer mid-commit / publish (SIGKILL, subprocess)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_trainer_sigkill_mid_commit_resumes_exactly(tmp_path):
+    _fill_log(str(tmp_path / "log"), 48)
+    cfg_a = _trainer_cfg(tmp_path, "uninterrupted")
+    toy_stream_trainer(cfg_a).run(max_chunks=3, idle_timeout_s=1.0)
+
+    cfg_b = _trainer_cfg(tmp_path, "interrupted")
+    _run_worker("train", {**cfg_b, "die_in_save": 3}, expect_sigkill=True)
+    out = _run_worker("train", cfg_b)
+    assert out["global_step"] == 6 and not out["preempted"]
+
+    la = _losses_by_step(cfg_a["save_dir"])
+    lb = _losses_by_step(cfg_b["save_dir"], allow_replay=True)
+    assert sorted(la) == sorted(lb) == [1, 2, 3, 4, 5, 6]
+    for s in la:
+        assert la[s] == pytest.approx(lb[s], abs=1e-5), f"diverged at {s}"
+    jax.tree_util.tree_map(
+        lambda u, v: np.testing.assert_allclose(
+            np.asarray(u), np.asarray(v), atol=1e-5
+        ),
+        _restore_published(cfg_a["publish_dir"], 6),
+        _restore_published(cfg_b["publish_dir"], 6),
+    )
+
+
+@pytest.mark.slow
+def test_trainer_sigkill_mid_publish_never_commits_torn_step(tmp_path):
+    """SIGKILL with the publish write in flight. The async save may
+    leave nothing, an orbax tmp dir, or a marker-less step dir — in
+    every case step 2 must never become a COMMITTED publish, and the
+    restarted trainer must carry on exactly with later publishes."""
+    _fill_log(str(tmp_path / "log"), 48)
+    cfg = _trainer_cfg(tmp_path, "run")
+    _run_worker("train", {**cfg, "die_in_publish": 2}, expect_sigkill=True)
+    assert not os.path.exists(
+        os.path.join(cfg["publish_dir"], "2", _COMMIT_MARKER)
+    )
+
+    out = _run_worker("train", cfg)
+    assert out["global_step"] == 6
+    # Exact resume lands BEFORE the interrupted boundary publish, so the
+    # restarted run re-publishes step 2 properly (identical params —
+    # that's what exact resume means) and carries on: every published
+    # step is now committed with a marker and restorable.
+    for step in (2, 4, 6):
+        assert os.path.exists(
+            os.path.join(cfg["publish_dir"], str(step), _COMMIT_MARKER)
+        )
+        assert np.all(np.isfinite(np.asarray(
+            _restore_published(cfg["publish_dir"], step)["w"]
+        )))
+    losses = _losses_by_step(cfg["save_dir"], allow_replay=True)
+    assert sorted(losses) == [1, 2, 3, 4, 5, 6]
+
+
+def test_trainer_quarantines_marker_less_publish_on_start(tmp_path):
+    """The deterministic half of the torn-publish story: a digit step
+    dir without orbax's commit marker (the SIGKILL landing after the
+    rename, before the marker) is quarantined at the next trainer start
+    — it can never collide with a re-publish or reach the rollout
+    guard."""
+    _fill_log(str(tmp_path / "log"), 48)
+    cfg = _trainer_cfg(tmp_path, "run")
+    t = toy_stream_trainer(cfg)
+    summary = t.run(max_chunks=1, idle_timeout_s=1.0)
+    assert summary["published_steps"] == [2]
+    chaos.drop_commit_marker(cfg["publish_dir"], 2)
+
+    out = toy_stream_trainer(cfg).run(max_chunks=3, idle_timeout_s=1.0)
+    assert out["global_step"] == 6
+    # The torn dir went out of discovery (quarantine nests per-process:
+    # quarantine/pN/2) — and exact resume then RE-published step 2
+    # properly, marker and all, into the now-free slot.
+    quarantined = [
+        name for _, dirs, _ in os.walk(
+            os.path.join(cfg["publish_dir"], "quarantine")
+        ) for name in dirs
+    ]
+    assert "2" in quarantined
+    for step in (2, 4, 6):
+        assert os.path.exists(
+            os.path.join(cfg["publish_dir"], str(step), _COMMIT_MARKER)
+        )
+
+
+# ---------------------------------------------------------------------------
+# stage: guarded rollout (fake fleet — the state machine, not the engines)
+# ---------------------------------------------------------------------------
+
+
+class FakeHead:
+    """Duck-typed serving head: scores are an affine function of the
+    params, so score drift tracks param damage exactly."""
+
+    name = "fake"
+
+    def natural_len(self, req):
+        return 4
+
+    def make_fn(self, B, L):
+        def fn(params, x):
+            return (x @ params["w"],)
+
+        return fn
+
+    def make_batch(self, reqs, B, L):
+        return (np.ones((B, 4), np.float32),)
+
+    def runtime_operands(self):
+        return ()
+
+    def finalize(self, outputs, reqs):
+        (scores,) = outputs
+        return [{"items": np.zeros(2, np.int64), "scores": scores[i]}
+                for i in range(len(reqs))]
+
+
+class FakeEngine:
+    """Duck-typed replica: staged params apply instantly (the real
+    engine's swap barrier is pinned by tests/test_serving.py)."""
+
+    def __init__(self, rid, params, step=0):
+        self.replica_id = rid
+        self._params = params
+        self._step = step
+        self.staged_log = []
+        self.bad_serving_steps = set()
+
+    @property
+    def params_step(self):
+        return self._step
+
+    def stage_params(self, tree, step, *, source="rollout"):
+        self.staged_log.append((step, source))
+        self._params, self._step = tree, step
+
+    def submit(self, req):
+        fut = Future()
+        bad = self._step in self.bad_serving_steps
+        fut.set_result(SimpleNamespace(
+            params_step=self._step,
+            items=np.full(2, -1 if bad else 1, np.int64),
+            scores=np.asarray(np.sum(self._params["w"]) * np.ones(2),
+                              np.float64),
+        ))
+        return fut
+
+
+class FakeRouter:
+    def __init__(self, params, rids=("r0", "r1")):
+        self.engines = {r: FakeEngine(r, params) for r in rids}
+
+    def replica_ids(self):
+        return list(self.engines)
+
+    def engine(self, rid):
+        return self.engines[rid]
+
+
+def _params(scale=1.0):
+    return {"w": np.full((4, 2), scale, np.float32)}
+
+
+def _rollout(tmp_path, router, **kw):
+    cfg = RolloutConfig(poll_secs=0.02, canary_window_s=0.05,
+                        canary_min_responses=1, vet_max_score_drift=1.0,
+                        swap_timeout_s=5.0, probe_timeout_s=5.0)
+    return RolloutController(
+        router, FakeHead(), str(tmp_path / "publish"),
+        params_like=_params(1.0),
+        vet_requests=[Request(head="fake", history=np.array([1, 2]))],
+        state_path=str(tmp_path / "rollout_state.json"),
+        initial_step=0, config=cfg, **kw,
+    )
+
+
+def _publish(tmp_path, step, tree):
+    mgr = CheckpointManager(str(tmp_path / "publish"))
+    mgr.save(step, tree)
+    mgr.wait()
+    mgr.close()
+
+
+def _wait(pred, secs=20.0):
+    deadline = time.monotonic() + secs
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError("condition not reached")
+
+
+def test_rollout_vets_canary_promotes_with_provenance(tmp_path):
+    router = FakeRouter(_params(1.0))
+    for e in router.engines.values():
+        e._step = 0
+    ctrl = _rollout(tmp_path, router).start()
+    try:
+        _publish(tmp_path, 1, _params(1.001))
+        _wait(lambda: ctrl.stats()["last_good_step"] == 1)
+        s = ctrl.stats()
+        assert s["staged"] == 1 and s["promotions"] == 1
+        assert s["vetoes"] == 0 and s["rollbacks"] == 0
+        assert s["canary_step"] == -1 and s["freshness_s"] >= 0.0
+        for e in router.engines.values():
+            assert e.params_step == 1
+            assert e.submit(None).result().params_step == 1
+        # The canary replica saw the candidate BEFORE the fleet did.
+        canary = router.engines["r1"]
+        assert canary.staged_log[0] == (1, "rollout_canary")
+        fr = get_flight_recorder()
+        assert fr.events("rollout_staged") and fr.events("rollout_promoted")
+    finally:
+        ctrl.stop()
+
+
+def test_rollout_vetoes_garbage_and_quarantines_forever(tmp_path):
+    router = FakeRouter(_params(1.0))
+    ctrl = _rollout(tmp_path, router).start()
+    try:
+        _publish(tmp_path, 1, _params(50.0))  # finite but wildly drifted
+        _wait(lambda: ctrl.stats()["vetoes"] == 1)
+        s = ctrl.stats()
+        assert s["last_good_step"] == 0 and s["quarantined_steps"] == 1
+        # The garbage NEVER touched a replica.
+        for e in router.engines.values():
+            assert e.params_step == 0 and e.staged_log == []
+        assert get_flight_recorder().events("rollout_vetoed")
+    finally:
+        ctrl.stop()
+    # Quarantine is durable: a fresh controller never retries the step.
+    ctrl2 = _rollout(tmp_path, router).start()
+    try:
+        time.sleep(0.3)
+        s = ctrl2.stats()
+        assert s["vetoes"] == 0 and s["staged"] == 0
+        assert s["quarantined_steps"] == 1 and s["last_good_step"] == 0
+    finally:
+        ctrl2.stop()
+
+
+def test_rollout_rolls_back_bad_canary_window(tmp_path):
+    """A candidate that passes the vet but misbehaves under live probes
+    (trie-invalid answers) is rolled back: the canary replica returns to
+    last-good, the step is quarantined, the fleet never saw it."""
+    router = FakeRouter(_params(1.0))
+    router.engines["r1"].bad_serving_steps.add(1)
+    ctrl = _rollout(tmp_path, router).start()
+    try:
+        _publish(tmp_path, 1, _params(1.0004))
+        _wait(lambda: ctrl.stats()["rollbacks"] == 1)
+        s = ctrl.stats()
+        assert s["promotions"] == 0 and s["quarantined_steps"] == 1
+        assert s["last_good_step"] == 0
+        canary = router.engines["r1"]
+        assert canary.params_step == 0
+        assert canary.staged_log[-1][1] == "rollout_rollback"
+        assert router.engines["r0"].staged_log == []
+        assert get_flight_recorder().events("rollout_rolled_back")
+    finally:
+        ctrl.stop()
+
+
+def test_rollout_crash_mid_canary_rolls_back_and_requeues(tmp_path):
+    router = FakeRouter(_params(1.0))
+    ctrl = _rollout(tmp_path, router).start()
+    try:
+        with chaos.inject(chaos.ChaosPlan(crash_rollout_at="canary")):
+            _publish(tmp_path, 1, _params(1.001))
+            _wait(lambda: not ctrl.alive)
+        # Died with the candidate on the canary replica and the durable
+        # intent record pointing at it.
+        assert router.engines["r1"].params_step == 1
+        assert ctrl.stats()["canary_step"] == 1
+    finally:
+        ctrl.stop()
+    ctrl2 = _rollout(tmp_path, router)
+    ctrl2.start()
+    try:
+        # Recovery rolled the canary back to last-good, then the poll
+        # loop legitimately re-vetted the (unjudged) candidate and
+        # promoted it.
+        assert (0, "rollout_recovery") in router.engines["r1"].staged_log
+        _wait(lambda: ctrl2.stats()["last_good_step"] == 1)
+        assert ctrl2.stats()["promotions"] == 1
+        assert router.engines["r0"].params_step == 1
+    finally:
+        ctrl2.stop()
+
+
+def test_rollout_crash_mid_promote_finishes_promote(tmp_path):
+    router = FakeRouter(_params(1.0))
+    ctrl = _rollout(tmp_path, router).start()
+    try:
+        with chaos.inject(chaos.ChaosPlan(crash_rollout_at="promote")):
+            _publish(tmp_path, 1, _params(1.001))
+            _wait(lambda: not ctrl.alive)
+    finally:
+        ctrl.stop()
+    # The canary verdict was durable: recovery completes the promote
+    # during start(), before the poll loop runs.
+    ctrl2 = _rollout(tmp_path, router)
+    ctrl2.start()
+    try:
+        s = ctrl2.stats()
+        assert s["last_good_step"] == 1 and s["promotions"] == 1
+        for e in router.engines.values():
+            assert e.params_step == 1
+    finally:
+        ctrl2.stop()
+
+
+def test_rollout_transient_poll_errors_back_off_then_recover(tmp_path):
+    """An NFS blip on the publish dir is not 'no new step': classified
+    transient, counted, narrated, retried with backoff — and the
+    candidate still lands once the dir heals."""
+    router = FakeRouter(_params(1.0))
+    ctrl = _rollout(tmp_path, router)
+    real_reload, blips = ctrl._mgr.reload, [0]
+
+    def flaky_reload():
+        if blips[0] < 2:
+            blips[0] += 1
+            raise OSError("stale file handle")
+        return real_reload()
+
+    ctrl._mgr.reload = flaky_reload
+    fr = get_flight_recorder()
+    before = len(fr.events("watcher_error"))
+    ctrl.start()
+    try:
+        _publish(tmp_path, 1, _params(1.001))
+        _wait(lambda: ctrl.stats()["last_good_step"] == 1)
+        assert ctrl.stats()["watcher_errors"] == 2
+        events = fr.events("watcher_error")[before:]
+        assert len(events) == 2
+        assert all(e["transient"] for e in events)
+    finally:
+        ctrl.stop()
+
+
+def test_is_transient_fs_error_classification():
+    from genrec_tpu.serving.engine import is_transient_fs_error
+
+    assert is_transient_fs_error(OSError("stale file handle"))
+    assert is_transient_fs_error(FileNotFoundError("gone"))
+    assert is_transient_fs_error(TimeoutError("nfs"))  # OSError subclass
+    assert not is_transient_fs_error(ValueError("a bug"))
+    assert not is_transient_fs_error(KeyError("a bug"))
+
+
+def test_serving_metrics_watcher_errors_counter():
+    from genrec_tpu.serving.metrics import ServingMetrics
+
+    m = ServingMetrics()
+    assert m.snapshot()["watcher_errors"] == 0
+    m.record_watcher_error()
+    m.record_watcher_error()
+    assert m.snapshot()["watcher_errors"] == 2
+
+
+def test_rollout_probe_requests_are_copied():
+    """_probe must not mutate or share the pinned request objects."""
+    req = Request(head="fake", history=np.array([1, 2]))
+    router = FakeRouter(_params(1.0))
+    eng = router.engines["r0"]
+    seen = []
+    orig = eng.submit
+
+    def submit(r):
+        seen.append(r)
+        return orig(r)
+
+    eng.submit = submit
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        ctrl = RolloutController(
+            router, FakeHead(), os.path.join(d, "pub"),
+            params_like=_params(0.0), vet_requests=[req],
+            state_path=os.path.join(d, "s.json"), initial_step=0,
+            config=RolloutConfig(probe_timeout_s=5.0),
+        )
+        ctrl._probe(eng, 5.0)
+        ctrl._mgr.close()
+    assert seen and all(s is not req for s in seen)
+    assert dataclasses.asdict(req)["head"] == "fake"
