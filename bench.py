@@ -551,12 +551,15 @@ def _serve_bench(model, params, valid_ids, rng, batch: int = SERVE_BATCH,
     def exec_time(head, B: int) -> float:
         ex = engine._exec[(head.name, B, items)]
         p = all_params[head.name]
+        # Catalog operands (the trie) are runtime ARGUMENTS threaded
+        # between params and the batch in every compiled call.
+        ops = head.runtime_operands()
         args = head.make_batch([mkreq(head.name) for _ in range(B)], B, items)
-        np.asarray(ex(p, *args)[0])  # sync warm call
+        np.asarray(ex(p, *ops, *args)[0])  # sync warm call
         t0 = time.perf_counter()
         n = 0
         while time.perf_counter() - t0 < 2.0 or n < 3:
-            out = ex(p, *args)
+            out = ex(p, *ops, *args)
             n += 1
         np.asarray(out[0])
         return (time.perf_counter() - t0) / n
@@ -675,6 +678,14 @@ def _serve_bench(model, params, valid_ids, rng, batch: int = SERVE_BATCH,
         obs.update(out["fleet"].pop("tracing", {}))
     except Exception as e:
         print(f"bench: fleet benchmark failed: {e!r}", file=sys.stderr)
+    # Multi-tenant serving plane (genrec_tpu/tenancy/): victim p99 with
+    # an admission-capped aggressor surging vs alone, A/B split
+    # exactness vs the pure bucketing hash, and the shadow mirror's
+    # closed-loop qps tax.
+    try:
+        out["tenancy"] = _tenancy_bench(model, params, valid_ids, rng)
+    except Exception as e:
+        print(f"bench: tenancy benchmark failed: {e!r}", file=sys.stderr)
     # Disaggregated serving (genrec_tpu/disagg/): handoff latency
     # through both transports, wire bytes per handoff, and qps at
     # parity traffic vs the co-located engine.
@@ -1171,6 +1182,182 @@ def _fleet_bench(model, params, valid_ids, rng, batch: int = 8) -> dict:
     )
 
 
+def _tenancy_bench(model, params, valid_ids, rng, batch: int = 8) -> dict:
+    """Multi-tenant serving plane (genrec_tpu/tenancy/): a `TenantFront`
+    hosting an aggressor ("acme") and a victim ("globex") tenant on one
+    engine, with acme running a live A/B experiment (arm b = a second
+    engine) and a shadow engine mirroring its routed traffic. Three
+    gated numbers:
+
+    - **victim_p99_with_aggressor_vs_alone**: globex's p99 on the mixed
+      trace (acme surging 4x through the burst windows, bounded by its
+      per-tenant admission cap) over its p99 serving the same share of
+      traffic alone — the co-tenancy isolation tax the front's
+      per-tenant admission defends. Both sides are saturated-CPU walls,
+      so the band is wide.
+    - **ab_split_abs_err**: |observed arm-a share - exact `bucket_arm`
+      share| over acme's completed responses. Routing is a pure
+      deterministic hash, so the baseline is 0.0 and the gate bands in
+      absolute units — any drift means the router stopped honoring the
+      bucketing function.
+    - **shadow_overhead_pct**: closed-loop qps through the front with
+      the experiment's shadow mirror attached vs the same experiment
+      without it (arms identical both times, so the delta is the mirror
+      machinery alone: one extra async submit + pairing bookkeeping per
+      request, with the shadow compute on its own engine).
+    """
+    import jax
+    import numpy as np
+
+    from genrec_tpu.fleet import (
+        Burst, TenantTraffic, TraceConfig, generate_trace, replay,
+    )
+    from genrec_tpu.serving import BucketLadder, Request, ServingEngine
+    from genrec_tpu.serving.heads import TigerGenerativeHead
+    from genrec_tpu.tenancy import (
+        ExperimentConfig, TenantConfig, TenantFront, bucket_arm,
+    )
+
+    items = BENCH_ITEMS
+
+    def make_engine(head_names, rid):
+        heads = [TigerGenerativeHead(model, valid_ids, top_k=DECODE_BEAM_K,
+                                     name=n) for n in head_names]
+        eng = ServingEngine(
+            heads, {n: params for n in head_names},
+            ladder=BucketLadder((1, batch), (items,)), max_batch=batch,
+            max_wait_ms=2.0, handle_signals=False, replica_id=rid,
+            params_by_head=True,
+        )
+        eng.start()
+        return eng
+
+    # Primary serves BOTH tenants' heads (the co-tenancy under test);
+    # arm-b and shadow engines serve only acme's head.
+    eng = make_engine(["t_a", "t_b"], "arm_a")
+    eng_b = make_engine(["t_a"], "arm_b")
+    eng_sh = make_engine(["t_a"], "shadow")
+
+    front = TenantFront(eng, tenants=[
+        TenantConfig(name="acme", head="t_a", max_inflight=2 * batch),
+        TenantConfig(name="globex", head="t_b"),
+    ])
+
+    exp_seed, exp_split = 23, 0.5
+    arms = {"a": eng, "b": eng_b}
+    lat_rng = np.random.default_rng(5)
+
+    def closed_loop(window_s: float) -> float:
+        n = 0
+        t_end = time.perf_counter() + window_s
+        while time.perf_counter() < t_end:
+            front.submit(Request(
+                head="t_a",
+                history=lat_rng.integers(0, len(valid_ids), items),
+                user_id=int(lat_rng.integers(0, 1_000_000)),
+            )).result(300)
+            n += 1
+        return n / window_s
+
+    # Shadow overhead: same experiment arms with and without the mirror
+    # (warm-up ride: the first window also warms all three engines'
+    # steady state before anything is measured).
+    front.start_experiment(
+        "acme", ExperimentConfig(name="ab-plain", seed=exp_seed,
+                                 split=exp_split), arms=arms)
+    closed_loop(0.5)  # settle
+    qps_plain = closed_loop(1.5)
+    front.conclude_experiment("acme")
+    front.start_experiment(
+        "acme", ExperimentConfig(name="ab-shadow", seed=exp_seed,
+                                 split=exp_split), arms=arms, shadow=eng_sh)
+    qps_shadow = closed_loop(1.5)
+    front.conclude_experiment("acme")
+
+    # Victim alone: globex serving ITS share of the schedule with the
+    # aggressor absent (half the mixed base rate, no burst surge).
+    alone = replay(generate_trace(TraceConfig(
+        n_requests=140, n_users=1_000_000, max_items=items,
+        corpus_size=len(valid_ids), seed=12, base_rate_qps=12.0,
+        diurnal_period_s=8.0, diurnal_amplitude=0.4,
+        tenants=(TenantTraffic("globex", "t_b"),),
+    )), front.submit, gather_timeout_s=600.0)
+
+    # Mixed: acme concentrates the 6x burst (burst_mult=4) while globex
+    # keeps its share; acme's A/B + shadow experiment live throughout.
+    exp = front.start_experiment(
+        "acme", ExperimentConfig(name="ab-mixed", seed=exp_seed,
+                                 split=exp_split), arms=arms, shadow=eng_sh)
+    acme_done = []  # (user_id, replica_id) of completed acme requests
+    orig_submit = front.submit
+
+    def submit(req):
+        fut = orig_submit(req)
+        if req.head == "t_a":
+            uid = int(req.user_id)
+
+            def done(f):
+                if f.exception() is None:
+                    acme_done.append((uid, f.result().replica_id))
+
+            fut.add_done_callback(done)
+        return fut
+
+    mixed = replay(generate_trace(TraceConfig(
+        n_requests=280, n_users=1_000_000, max_items=items,
+        corpus_size=len(valid_ids), seed=12, base_rate_qps=24.0,
+        diurnal_period_s=8.0, diurnal_amplitude=0.4,
+        bursts=(Burst(3.0, 2.0, 6.0),),
+        tenants=(TenantTraffic("acme", "t_a", burst_mult=4.0),
+                 TenantTraffic("globex", "t_b")),
+    )), submit, gather_timeout_s=600.0)
+    exp_summary = front.conclude_experiment("acme")["summary"]
+
+    front.stop()
+    stats = [e.stats() for e in (eng, eng_b, eng_sh)]
+    for e in (eng, eng_b, eng_sh):
+        e.stop()
+
+    observed_a = sum(1 for _uid, rid in acme_done if rid == "arm_a")
+    exact_a = sum(1 for uid, _rid in acme_done
+                  if bucket_arm(exp_seed, uid, exp_split) == "a")
+    n_acme = max(len(acme_done), 1)
+    ab_split_abs_err = abs(observed_a - exact_a) / n_acme
+
+    p99_alone = alone.tenants["globex"]["p99_ms"]
+    p99_mixed = mixed.tenants["globex"]["p99_ms"]
+
+    return dict(
+        backend=jax.default_backend(),
+        victim_p99_alone_ms=p99_alone,
+        victim_p99_with_aggressor_ms=p99_mixed,
+        victim_p99_with_aggressor_vs_alone=round(
+            p99_mixed / max(p99_alone, 1e-9), 3),
+        victim_shed_rate=mixed.tenants["globex"]["shed_rate"],
+        aggressor_shed_rate=mixed.tenants["acme"]["shed_rate"],
+        ab_split_abs_err=round(ab_split_abs_err, 4),
+        ab_observed_a=observed_a,
+        ab_exact_a=exact_a,
+        ab_completed=len(acme_done),
+        shadow_mirrored=exp_summary["shadow_mirrored"],
+        shadow_errors=exp_summary["shadow_errors"],
+        closed_qps_ab_plain=round(qps_plain, 2),
+        closed_qps_ab_shadow=round(qps_shadow, 2),
+        shadow_overhead_pct=round(
+            100.0 * (1.0 - qps_shadow / max(qps_plain, 1e-9)), 2),
+        recompilations_steady=sum(s["recompilations"] for s in stats),
+        note=(
+            "two tenants (aggressor acme with per-tenant admission cap, "
+            "victim globex) on one engine behind a TenantFront; acme "
+            "runs a seeded A/B experiment (arm b + shadow on their own "
+            "engines); victim p99 on the mixed 6x-burst trace (acme "
+            "burst_mult=4) vs serving its share alone; A/B split error "
+            "vs the pure bucket_arm hash; shadow mirror qps tax at "
+            "identical arms"
+        ),
+    )
+
+
 def _disagg_bench(model, params, valid_ids, rng, batch: int = 8) -> dict:
     """Disaggregated serving (genrec_tpu/disagg/): the prefill/decode
     split vs the co-located engine, at parity traffic.
@@ -1413,11 +1600,12 @@ def _tp_topk_probe():
                             user_id=0)
                     for _ in range(SERVE_BATCH)]
             args = head.make_batch(reqs, SERVE_BATCH, items)
-            np.asarray(ex(p, *args)[0])  # sync warm call
+            ops = head.runtime_operands()
+            np.asarray(ex(p, *ops, *args)[0])  # sync warm call
             t0 = time.perf_counter()
             n = 0
             while time.perf_counter() - t0 < 2.0 or n < 3:
-                out = ex(p, *args)
+                out = ex(p, *ops, *args)
                 n += 1
             np.asarray(out[0])
             return (time.perf_counter() - t0) / n

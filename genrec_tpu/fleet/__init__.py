@@ -25,6 +25,7 @@ from genrec_tpu.fleet.router import FleetRouter, ReplicaLostError
 from genrec_tpu.fleet.traffic import (
     Burst,
     ReplayReport,
+    TenantTraffic,
     Trace,
     TraceConfig,
     generate_trace,
@@ -39,6 +40,7 @@ __all__ = [
     "FleetRouter",
     "ReplayReport",
     "ReplicaLostError",
+    "TenantTraffic",
     "Trace",
     "TraceConfig",
     "generate_trace",

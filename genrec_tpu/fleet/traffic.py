@@ -70,6 +70,32 @@ class Burst:
 
 
 @dataclasses.dataclass(frozen=True)
+class TenantTraffic:
+    """One tenant's slice of a mixed trace (``TraceConfig.tenants``).
+
+    ``rate_share`` is the tenant's relative weight in the arrival mix
+    (normalized across tenants per arrival); ``burst_mult`` multiplies
+    that weight inside burst windows — the hot-tenant knob: an
+    aggressor with ``burst_mult=6`` surges to ~6x its share mid-burst
+    while the TOTAL offered rate still follows the config's burst
+    envelope, which is exactly the co-tenancy victim scenario the
+    isolation bench gates. ``n_users`` bounds the tenant's OWN user-id
+    space (defaults to the config's); tenant ids never collide —
+    tenant ``i``'s users live at ``i * n_users + local``.
+    """
+
+    name: str
+    head: str
+    rate_share: float = 1.0
+    n_users: Optional[int] = None
+    burst_mult: float = 1.0
+
+    def __post_init__(self):
+        if self.rate_share <= 0 or self.burst_mult <= 0:
+            raise ValueError(f"invalid tenant traffic {self}")
+
+
+@dataclasses.dataclass(frozen=True)
 class TraceConfig:
     """Shape of one deterministic traffic trace.
 
@@ -78,7 +104,12 @@ class TraceConfig:
     retrieval-head traces use 1-based vocab ids (0 = pad). The diurnal
     factor is ``1 + diurnal_amplitude * sin(2π t / diurnal_period_s)``
     — one synthetic "day" per period, compressed so tests and benches
-    see a full cycle in seconds.
+    see a full cycle in seconds. ``tenants`` turns the trace into a
+    multi-tenant mix: each arrival is assigned a tenant (and that
+    tenant's head + user space) from a SECOND seeded stream, so adding
+    tenants never perturbs the base schedule's draw order — a
+    tenant-free config stays bit-identical to what it generated before
+    tenants existed.
     """
 
     n_requests: int = 256
@@ -94,12 +125,16 @@ class TraceConfig:
     diurnal_amplitude: float = 0.5
     bursts: tuple[Burst, ...] = ()
     item_lo: int = 0  # retrieval heads: 1 (0 is the pad id)
+    tenants: tuple[TenantTraffic, ...] = ()
 
     def __post_init__(self):
         if not 0 <= self.diurnal_amplitude < 1:
             raise ValueError("diurnal_amplitude must be in [0, 1)")
         if self.base_rate_qps <= 0 or self.n_requests <= 0:
             raise ValueError(f"invalid trace config {self}")
+        names = [t.name for t in self.tenants]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate tenant names in {names}")
 
     def rate_at(self, t: float) -> float:
         """Instantaneous arrival rate (QPS) at trace time ``t``."""
@@ -144,6 +179,8 @@ class Arrival:
     user_id: int
     history: np.ndarray
     in_burst: bool
+    tenant: Optional[str] = None  # multi-tenant mixes only
+    head: Optional[str] = None    # tenant's head; None -> config.head
 
 
 @dataclasses.dataclass(frozen=True)
@@ -161,7 +198,8 @@ class Trace:
 
     def requests(self) -> list[Request]:
         cfg = self.config
-        return [Request(head=cfg.head, history=a.history, user_id=a.user_id)
+        return [Request(head=a.head or cfg.head, history=a.history,
+                        user_id=a.user_id)
                 for a in self.arrivals]
 
 
@@ -170,6 +208,28 @@ def _zipf_probs(n_users: int, zipf_a: float) -> np.ndarray:
     p = ranks ** -zipf_a
     p /= p.sum()
     return p
+
+
+#: Salt for the tenant-assignment stream: a SECOND generator seeded
+#: from (cfg.seed, salt) so tenant draws never touch the base stream's
+#: order — tenant-free configs stay bit-identical across this feature.
+_TENANT_STREAM_SALT = 0x7E9A97
+
+
+def _assign_tenant(cfg: TraceConfig, trng, burst: bool):
+    """One tenant pick: a single uniform draw against the (burst-
+    adjusted, normalized) rate shares — exactly one draw per arrival,
+    so the tenant stream's order is as pinned as the base stream's."""
+    weights = [t.rate_share * (t.burst_mult if burst else 1.0)
+               for t in cfg.tenants]
+    total = sum(weights)
+    draw = trng.random() * total
+    acc = 0.0
+    for idx, w in enumerate(weights):
+        acc += w
+        if draw < acc:
+            return idx
+    return len(weights) - 1  # float round-off on the last edge
 
 
 def generate_trace(cfg: TraceConfig) -> Trace:
@@ -192,13 +252,28 @@ def generate_trace(cfg: TraceConfig) -> Trace:
     # 2) Users: one vectorized Zipfian draw over the full id space.
     users = rng.choice(cfg.n_users, size=cfg.n_requests,
                        p=_zipf_probs(cfg.n_users, cfg.zipf_a))
-    # 3) Histories: per-user session state, created lazily on first
+    # 3) Tenant assignment (multi-tenant mixes): a SECOND seeded stream
+    # so the base stream's draw order above is untouched — configs with
+    # tenants=() generate bit-identically to pre-tenant versions.
+    trng = (np.random.default_rng(
+        np.random.SeedSequence([cfg.seed, _TENANT_STREAM_SALT]))
+        if cfg.tenants else None)
+    # 4) Histories: per-user session state, created lazily on first
     # visit (ids drawn per arrival in order, so the dict never holds
     # more than the VISITED users — the id space can be millions wide).
+    # In tenant mixes the session key is the NAMESPACED id (tenant i's
+    # users live at i * n_users + local), so user spaces never bleed.
     histories: dict[int, list] = {}
     arrivals = []
     for t, user in zip(times, users):
         user = int(user)
+        burst = cfg.in_burst(float(t))
+        tenant = head = None
+        if trng is not None:
+            idx = _assign_tenant(cfg, trng, burst)
+            ten = cfg.tenants[idx]
+            tenant, head = ten.name, ten.head
+            user = idx * cfg.n_users + user % (ten.n_users or cfg.n_users)
         h = histories.get(user)
         if h is None:
             n0 = int(rng.integers(3, cfg.max_items + 1))
@@ -210,7 +285,8 @@ def generate_trace(cfg: TraceConfig) -> Trace:
         arrivals.append(Arrival(
             t=float(t), user_id=user,
             history=np.asarray(h, np.int64),
-            in_burst=cfg.in_burst(float(t)),
+            in_burst=burst,
+            tenant=tenant, head=head,
         ))
     return Trace(config=cfg, arrivals=tuple(arrivals))
 
@@ -236,6 +312,10 @@ class ReplayReport:
     burst_shed: int = 0
     p99_under_burst_ms: Optional[float] = None
     late_submits: int = 0  # arrivals dispatched >1 tick behind schedule
+    #: Multi-tenant mixes: {tenant: {submitted, completed, shed,
+    #: shed_rate, p50_ms, p99_ms, burst_submitted, burst_shed}} — the
+    #: victim-vs-aggressor split the isolation bench gates.
+    tenants: dict = dataclasses.field(default_factory=dict)
 
     @property
     def shed_rate(self) -> float:
@@ -263,6 +343,7 @@ class ReplayReport:
             "burst_shed_rate": round(self.burst_shed_rate, 4),
             "p99_under_burst_ms": self.p99_under_burst_ms,
             "late_submits": self.late_submits,
+            **({"tenants": self.tenants} if self.tenants else {}),
         }
 
 
@@ -290,6 +371,19 @@ def replay(
     two scheduled arrivals, exactly like a preemption would."""
     pending: list[tuple[Arrival, object]] = []
     report = ReplayReport()
+    per_tenant: dict[str, dict] = {}
+    tenant_lat: dict[str, list] = {}
+
+    def _tstats(name: str) -> dict:
+        st = per_tenant.get(name)
+        if st is None:
+            st = per_tenant[name] = {
+                "submitted": 0, "completed": 0, "shed": 0,
+                "burst_submitted": 0, "burst_shed": 0,
+            }
+            tenant_lat[name] = []
+        return st
+
     hooks = sorted(chaos, key=lambda c: c[0])
     hook_i = 0
     t0 = time.monotonic()
@@ -306,14 +400,23 @@ def replay(
         report.submitted += 1
         if arr.in_burst:
             report.burst_submitted += 1
-        req = Request(head=trace.config.head, history=arr.history,
-                      user_id=arr.user_id)
+        tstats = _tstats(arr.tenant) if arr.tenant is not None else None
+        if tstats is not None:
+            tstats["submitted"] += 1
+            if arr.in_burst:
+                tstats["burst_submitted"] += 1
+        req = Request(head=arr.head or trace.config.head,
+                      history=arr.history, user_id=arr.user_id)
         try:
             fut = submit(req)
         except OverloadError:
             report.shed += 1
             if arr.in_burst:
                 report.burst_shed += 1
+            if tstats is not None:
+                tstats["shed"] += 1
+                if arr.in_burst:
+                    tstats["burst_shed"] += 1
             continue
         except DrainingError:
             report.rejected += 1
@@ -337,12 +440,21 @@ def replay(
         lat.append(resp.total_s)
         if arr.in_burst:
             burst_lat.append(resp.total_s)
+        if arr.tenant is not None:
+            _tstats(arr.tenant)["completed"] += 1
+            tenant_lat[arr.tenant].append(resp.total_s)
     report.wall_s = time.monotonic() - t0
     report.offered_qps = report.submitted / report.wall_s \
         if report.wall_s > 0 else 0.0
     report.p50_ms = _pct(lat, 0.50)
     report.p99_ms = _pct(lat, 0.99)
     report.p99_under_burst_ms = _pct(burst_lat, 0.99)
+    for name, st in per_tenant.items():
+        st["shed_rate"] = round(st["shed"] / st["submitted"], 4) \
+            if st["submitted"] else 0.0
+        st["p50_ms"] = _pct(tenant_lat[name], 0.50)
+        st["p99_ms"] = _pct(tenant_lat[name], 0.99)
+    report.tenants = per_tenant
     return report
 
 

@@ -349,6 +349,7 @@ def generate_topk_constrained(
     beam_width: int = 10,
     temperature: float = 1.0,
     max_cache: int | None = None,
+    trie=None,
 ):
     """Constrained beam search over the codebook-token cascade.
 
@@ -356,6 +357,13 @@ def generate_topk_constrained(
     row into a KV cache; the cache is then broadcast across beams and C
     decode steps run with the static per-step vocabulary slice. Fully
     jittable (static shapes, no host callbacks).
+
+    ``trie`` (optional, DenseTrie/PackedTrie/TensorTrie interface)
+    restricts every step to corpus-valid sem-id tuples: each beam tracks
+    its prefix rank through ``trie.advance`` and the step's codebook
+    slice is masked with ``trie.legal_mask`` before top-k, so every
+    surviving beam is a complete catalog item. With ``trie=None`` the
+    search is exactly the unconstrained cascade.
     """
     B, L = input_ids.shape
     W = beam_width
@@ -388,6 +396,10 @@ def generate_topk_constrained(
 
     beam_tokens = jnp.zeros((B, W, C), jnp.int32)
     beam_scores = jnp.full((B, W), -jnp.inf).at[:, 0].set(0.0)
+    # Per-beam trie rank of the emitted prefix; root rank is 0. Dead
+    # beams carry the trie's sentinel rank, whose legal_mask is all
+    # False — their scores stay -inf from the step that killed them.
+    beam_rank = jnp.zeros((B, W), jnp.int32)
 
     for c in range(C):
         lo = base_vocab + c * K
@@ -396,6 +408,11 @@ def generate_topk_constrained(
         )
         logp_w = jax.lax.dynamic_slice_in_dim(logp, lo, K, axis=1)
         if c == 0:
+            if trie is not None:
+                root = jnp.zeros((B,), jnp.int32)
+                logp_w = jnp.where(
+                    trie.legal_mask(root, 0), logp_w, -jnp.inf
+                )
             # First step: all beams identical; expand from the B-row
             # logits. With beam_width > codebook_size only K distinct
             # first tokens exist — fill the rest with -inf beams (they
@@ -411,14 +428,27 @@ def generate_topk_constrained(
                 )
             beam_scores = scores
             beam_tokens = beam_tokens.at[:, :, 0].set(toks)
+            if trie is not None:
+                beam_rank = trie.advance(
+                    jnp.zeros((B, W), jnp.int32), toks.astype(jnp.int32), 0
+                )
         else:
             logp_w = logp_w.reshape(B, W, K)
+            if trie is not None:
+                logp_w = jnp.where(
+                    trie.legal_mask(beam_rank, c), logp_w, -jnp.inf
+                )
             combined = (beam_scores[..., None] + logp_w).reshape(B, W * K)
             beam_scores, idx = jax.lax.top_k(combined, W)
             parent = idx // K
             tok = idx % K
             beam_tokens = jnp.take_along_axis(beam_tokens, parent[..., None], axis=1)
             beam_tokens = beam_tokens.at[:, :, c].set(tok)
+            if trie is not None:
+                beam_rank = trie.advance(
+                    jnp.take_along_axis(beam_rank, parent, axis=1),
+                    tok.astype(jnp.int32), c,
+                )
             # Reorder caches to follow the selected parents.
             flat_parent = (parent + jnp.arange(B)[:, None] * W).reshape(B * W)
             caches = [

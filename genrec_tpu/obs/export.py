@@ -66,6 +66,12 @@ _COUNTER_LEAVES = frozenset({
     # last_good_step / canary_step / freshness_s / quarantined_steps
     # leaves stay gauges.
     "watcher_errors", "staged", "promotions", "vetoes", "rollbacks",
+    # Multi-tenant front (genrec_tpu/tenancy/, stats()["tenancy"] +
+    # ["experiments"]): per-tenant admission/shed/mirror and per-arm
+    # routing totals. The inflight / p99_ms / shedding / split leaves
+    # stay gauges.
+    "shed", "shadow_mirrored", "exp_arm_a", "exp_arm_b",
+    "routed_a", "routed_b", "shadow_errors", "shadow_mismatches",
 }) | frozenset(
     # Accept-length histogram leaves (genrec_spec_<head>_accept_len_hist
     # _accept_len_N): one bucket per possible accept length — depth is

@@ -16,6 +16,8 @@ from genrec_tpu.serving.kv_pool import (
 )
 from genrec_tpu.serving.heads import (
     CobraGenerativeHead,
+    LCRecGenerativeHead,
+    NoteLLMRetrievalHead,
     RetrievalHead,
     TigerGenerativeHead,
 )
@@ -46,7 +48,9 @@ __all__ = [
     "DrainingError",
     "HBMBudgetError",
     "KVPagePool",
+    "LCRecGenerativeHead",
     "LatencyHistogram",
+    "NoteLLMRetrievalHead",
     "OverloadError",
     "PageAllocator",
     "PagedConfig",

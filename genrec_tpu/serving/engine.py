@@ -150,6 +150,17 @@ from genrec_tpu.serving.aot import sds_tree as _sds
 PAGED_DECODE_DONATE_ARGNUMS = (2,)
 
 
+def _operand_avals(operands) -> tuple:
+    """Shape/dtype signature of a runtime-operand tuple — the facts that
+    decide whether compiled executables accept it (stage_catalog's
+    rung-change test, generalized from TensorTrie.aval_signature so
+    non-trie catalog operands — NoteLLM's scoring bank — participate)."""
+    return tuple(
+        (tuple(int(s) for s in leaf.shape), str(leaf.dtype))
+        for leaf in jax.tree_util.tree_leaves(operands)
+    )
+
+
 def is_transient_fs_error(e: BaseException) -> bool:
     """Classify a poll-loop failure as a transient filesystem condition
     (an NFS blip, a listing racing a writer's mid-rename window, a stale
@@ -1897,23 +1908,26 @@ class ServingEngine:
             prepare = getattr(head, "prepare_snapshot", None)
             if prepare is not None:
                 prepare(snapshot)
-            new_trie = snapshot.device_trie()
+            # The operand tuple this snapshot would install (the trie for
+            # trie-operand heads, NoteLLM's scoring bank, ...) — the aval
+            # source for rung-change detection and the AOT precompile.
+            new_ops = head.snapshot_operands(snapshot)
             # Effective aval: what the executables will expect AT APPLY
             # time. While a swap is pending, that is the pending
-            # snapshot's trie — and replacing the pending entry must
+            # snapshot's operands — and replacing the pending entry must
             # INHERIT its precompiled executables (it may be a
             # rung-change whose executables are not installed yet; the
             # dict holds one entry per head, so dropping them would swap
-            # a new-rung trie against old-rung executables).
+            # new-rung operands against old-rung executables).
             if staged is not None:
-                base = staged[0].device_trie()
+                base_ops = head.snapshot_operands(staged[0])
                 dense_exec, runner_exec = staged[1], staged[2]
             else:
-                base = head.trie
+                base_ops = head.runtime_operands()
                 dense_exec = runner_exec = None
-            same_rung = new_trie.aval_signature() == base.aval_signature()
+            same_rung = _operand_avals(new_ops) == _operand_avals(base_ops)
             if not same_rung:
-                dense_exec, runner_exec = self._precompile_catalog(head, new_trie)
+                dense_exec, runner_exec = self._precompile_catalog(head, new_ops)
             with self._lock:
                 self._pending_catalog[head_name] = (
                     snapshot, dense_exec, runner_exec
@@ -1931,11 +1945,11 @@ class ServingEngine:
         )
         return True
 
-    def _precompile_catalog(self, head, new_trie):
+    def _precompile_catalog(self, head, operands):
         """Capacity-rung growth: AOT-compile every executable the head
-        owns against the NEW trie aval (staging thread; the live tables
-        keep serving the old catalog until the swap installs these)."""
-        operands = (new_trie,)
+        owns against the NEW operand avals (staging thread; the live
+        tables keep serving the old catalog until the swap installs
+        these)."""
         runner = self._runners.get(head.name)
         if runner is not None:
             if runner.spec_topology is not None:

@@ -150,6 +150,16 @@ class Head:
     def validate_snapshot(self, snapshot) -> None:
         raise NotImplementedError(f"head {self.name!r} has no swappable catalog")
 
+    def snapshot_operands(self, snapshot) -> tuple:
+        """The runtime-operand tuple ``snapshot`` would install — the
+        aval source for the engine's staging path (rung-change detection
+        + AOT catalog precompile, engine.stage_catalog). Default: the
+        snapshot's device trie, matching every trie-operand head; heads
+        whose catalog installs a different operand (NoteLLM's scoring
+        bank) override so a bank-rung change is detected and precompiled
+        exactly like a trie-rung change."""
+        return (snapshot.device_trie(),)
+
     def validate(self, req) -> None:
         """Reject malformed requests AT SUBMIT TIME, so the error goes to
         the one bad caller — not (via the batch-failure path) to every
@@ -910,6 +920,342 @@ class RetrievalHead(Head):
                  sem_ids=None)
             for i in range(len(reqs))
         ]
+
+
+class LCRecGenerativeHead(Head):
+    """LCRec constrained beam search over the extended-vocab LLM.
+
+    Requests carry ITEM ids into the catalog; ``make_batch`` maps each
+    history item to its D codebook tokens (``base_vocab + c*K + code``,
+    the ``extend_vocab`` layout) and LEFT-pads the prompt — the KV-cached
+    decode reads the last position, so the newest item must sit at the
+    right edge (models/lcrec.py's HF left-pad convention). Decoding runs
+    ``generate_topk_constrained`` with the snapshot's TensorTrie as a
+    runtime operand: every emitted tuple is a corpus item, mapped back to
+    an item id through ``_CorpusLookup`` exactly like TIGER/COBRA. Dense
+    family only (``supports_paged=False``): warmup AOT-compiles every
+    ladder combo and steady state never recompiles.
+    """
+
+    generative = True
+    supports_catalog = True
+
+    def __init__(self, model, base_vocab: int, num_codebooks: int,
+                 codebook_size: int, item_sem_ids: Optional[np.ndarray] = None,
+                 top_k: int = 10, name: str = "lcrec", catalog=None):
+        self.model = model
+        self.name = name
+        self.top_k = top_k
+        self.base_vocab = int(base_vocab)
+        self.num_codebooks = int(num_codebooks)
+        self.codebook_size = int(codebook_size)
+        cfg = getattr(model, "cfg", None)
+        if cfg is not None and (
+            self.base_vocab + self.num_codebooks * self.codebook_size
+            > cfg.vocab_size
+        ):
+            raise ValueError(
+                f"codebook region [{self.base_vocab}, "
+                f"{self.base_vocab + self.num_codebooks * self.codebook_size})"
+                f" exceeds model vocab {cfg.vocab_size}"
+            )
+        # Position table bound: a prompt is L*C tokens + C decode steps.
+        max_pos = int(getattr(cfg, "max_position_embeddings", 0) or 0)
+        self._max_len = (
+            max(1, (max_pos - self.num_codebooks) // self.num_codebooks)
+            if max_pos else None
+        )
+        if catalog is None:
+            if item_sem_ids is None:
+                raise ValueError("need item_sem_ids or catalog=")
+            catalog = CatalogSnapshot.build(
+                np.asarray(item_sem_ids, np.int64), self.codebook_size
+            )
+        self.validate_snapshot(catalog)
+        self.set_catalog(catalog)
+
+    def validate_snapshot(self, snapshot) -> None:
+        if snapshot.depth != self.num_codebooks:
+            raise ValueError(
+                f"catalog depth {snapshot.depth} != head num_codebooks "
+                f"{self.num_codebooks}"
+            )
+        if snapshot.codebook_size != self.codebook_size:
+            raise ValueError(
+                f"catalog codebook {snapshot.codebook_size} != head "
+                f"codebook_size {self.codebook_size}"
+            )
+
+    def prepare_snapshot(self, snapshot) -> None:
+        snapshot.device_trie()
+        snapshot.item_index()
+
+    def set_catalog(self, snapshot) -> None:
+        self.catalog = snapshot
+        self.item_sem_ids = snapshot.item_sem_ids
+        self.trie = snapshot.device_trie()
+        self._place_trie()
+        self._lookup = _CorpusLookup(snapshot)
+
+    @property
+    def catalog_version(self) -> Optional[str]:
+        return self.catalog.version
+
+    def runtime_operands(self) -> tuple:
+        return (self.trie,)
+
+    def max_item_id(self):
+        return len(self.item_sem_ids) - 1
+
+    def _clamp(self, L: int) -> int:
+        return min(L, self._max_len) if self._max_len else L
+
+    def make_batch(self, reqs, B: int, L: int):
+        L = self._clamp(L)
+        C = self.num_codebooks
+        tok_base = self.base_vocab + np.arange(C, dtype=np.int64) * self.codebook_size
+        ids = np.zeros((B, L * C), np.int32)
+        mask = np.zeros((B, L * C), np.int32)
+        for i, r in enumerate(reqs):
+            # Same shrink-swap drop rule as TIGER: a queued request may
+            # reference items a smaller hot-swapped catalog removed.
+            h = _clip_history(r.history, L)
+            h = h[h < len(self.item_sem_ids)]
+            if len(h):
+                toks = (self.item_sem_ids[h] + tok_base).reshape(-1)
+                ids[i, L * C - len(toks):] = toks
+                mask[i, L * C - len(toks):] = 1
+        # Degenerate rows (emptied history, B-padding): one attended
+        # position keeps the softmax over attention weights finite.
+        mask[:, -1] = 1
+        return jnp.asarray(ids), jnp.asarray(mask)
+
+    def make_fn(self, B: int, L: int):
+        from genrec_tpu.models.lcrec import generate_topk_constrained
+
+        L = self._clamp(L)
+        C = self.num_codebooks
+
+        def fn(params, trie, ids, mask):
+            out = generate_topk_constrained(
+                self.model, params, ids, mask, self.base_vocab, C,
+                self.codebook_size, beam_width=self.top_k,
+                max_cache=L * C + C, trie=trie,
+            )
+            return out.sem_ids, out.log_probas
+
+        return fn
+
+    def finalize(self, outputs, reqs) -> list[dict]:
+        sem_ids, logp = outputs
+        return [
+            dict(items=self._lookup(sem_ids[i]), scores=np.asarray(logp[i]),
+                 sem_ids=np.asarray(sem_ids[i]))
+            for i in range(len(reqs))
+        ]
+
+
+class NoteLLMRetrievalHead(Head):
+    """NoteLLM Query2Embedding retrieval: ``[EMB]`` hidden -> item top-k.
+
+    Requests carry query TOKEN ids (``Request.history`` is the tokenized
+    query); ``make_batch`` appends the ``[EMB]`` special token after the
+    clipped query and the compiled fn reads its L2-normalized hidden
+    state (``query2embedding_forward``), then scores it against the
+    catalog's precomputed item-note embeddings through the same sharded
+    ``item_topk`` path the SASRec/HSTU heads use.
+
+    The item bank is a CATALOG artifact and a RUNTIME OPERAND: snapshot
+    ``item_vecs`` (N, d) padded to a ``capacity_for`` rung as an
+    AUGMENTED (cap, d+1) fp32 table — row i+1 carries item i plus a bias
+    column of 0, pad rows carry a -1e9 bias, and the query side appends a
+    1 — so pad rows can never win top-k through the UNCHANGED item_topk
+    kernel, and same-rung catalog swaps are pure operand changes (a rung
+    change is AOT-precompiled by the engine staging path via
+    ``snapshot_operands``). Row 0 is the pad row item_topk always masks;
+    returned row r maps to item r-1.
+    """
+
+    supports_catalog = True
+
+    #: Bias given to pad rows (and earned by none of the real rows, whose
+    #: scores are cosine-bounded): a pad row can never reach the top-k.
+    _PAD_BIAS = -1e9
+
+    def __init__(self, model, emb_token_id: int,
+                 item_sem_ids: Optional[np.ndarray] = None,
+                 item_vecs: Optional[np.ndarray] = None,
+                 codebook_size: Optional[int] = None,
+                 top_k: int = 10, name: str = "notellm", catalog=None,
+                 mesh=None, model_axis: str = "model"):
+        self.model = model
+        self.name = name
+        self.top_k = top_k
+        self.emb_token_id = int(emb_token_id)
+        self.mesh = mesh
+        self.model_axis = model_axis
+        self._bank = None          # live augmented device bank
+        self._bank_cache: dict = {}  # version -> augmented bank (staging)
+        cfg = getattr(model, "cfg", None)
+        max_pos = int(getattr(cfg, "max_position_embeddings", 0) or 0)
+        self._max_len = max(1, max_pos - 1) if max_pos else None
+        if catalog is None:
+            if item_sem_ids is None or item_vecs is None:
+                raise ValueError("need (item_sem_ids, item_vecs) or catalog=")
+            item_sem_ids = np.asarray(item_sem_ids, np.int64)
+            if codebook_size is None:
+                codebook_size = int(item_sem_ids.max()) + 1
+            catalog = CatalogSnapshot.build(
+                item_sem_ids, codebook_size, item_vecs=np.asarray(item_vecs)
+            )
+        self.validate_snapshot(catalog)
+        self.set_catalog(catalog)
+
+    def validate_snapshot(self, snapshot) -> None:
+        if snapshot.item_vecs is None:
+            raise ValueError(
+                "NoteLLM catalog snapshot needs item_vecs (the precomputed "
+                "item-note embeddings — the retrieval bank has to come from "
+                "somewhere)"
+            )
+        cfg = getattr(self.model, "cfg", None)
+        d = int(snapshot.item_vecs.shape[-1])
+        if cfg is not None and d != cfg.hidden_size:
+            raise ValueError(
+                f"snapshot item_vecs dim {d} != model hidden_size "
+                f"{cfg.hidden_size}"
+            )
+        cur = getattr(self, "catalog", None)
+        if cur is not None and d != int(cur.item_vecs.shape[-1]):
+            raise ValueError(
+                f"snapshot item_vecs dim {d} != serving bank dim "
+                f"{int(cur.item_vecs.shape[-1])} — operand avals would drift"
+            )
+
+    def _augmented_bank(self, snapshot) -> np.ndarray:
+        """(cap, d+1) fp32: row i+1 = [item_vecs[i], 0]; row 0 (the pad
+        row item_topk masks) and capacity-padding rows get the -1e9 bias
+        column. ``capacity_for`` rungs keep the aval stable across
+        same-size snapshots."""
+        from genrec_tpu.catalog.tensor_trie import capacity_for
+
+        vecs = np.asarray(snapshot.item_vecs, np.float32)
+        n, d = vecs.shape
+        cap = capacity_for(n + 1)
+        bank = np.zeros((cap, d + 1), np.float32)
+        bank[1:n + 1, :d] = vecs
+        bank[0, d] = self._PAD_BIAS
+        bank[n + 1:, d] = self._PAD_BIAS
+        return bank
+
+    def prepare_snapshot(self, snapshot) -> None:
+        """Staging-thread hook: build + upload the augmented bank ahead
+        of the swap, so set_catalog is a pointer swap on the batcher."""
+        snapshot.device_trie()
+        if snapshot.version not in self._bank_cache:
+            self._bank_cache[snapshot.version] = jnp.asarray(
+                self._augmented_bank(snapshot)
+            )
+
+    def snapshot_operands(self, snapshot) -> tuple:
+        """The engine's staging aval source: the bank this snapshot would
+        install (NOT the trie — a bank-rung change must be detected and
+        precompiled even when the trie rung is unchanged)."""
+        self.prepare_snapshot(snapshot)
+        return (self._bank_cache[snapshot.version],)
+
+    def set_catalog(self, snapshot) -> None:
+        self.catalog = snapshot
+        bank = self._bank_cache.get(snapshot.version)
+        if bank is None:
+            bank = jnp.asarray(self._augmented_bank(snapshot))
+        self._bank = bank
+        self._bank_cache = {snapshot.version: bank}
+        self._place_bank()
+
+    def place_operands(self, mesh, model_axis: str = "model") -> None:
+        super().place_operands(mesh, model_axis)
+        if self.mesh is None:
+            self.mesh = mesh
+            self.model_axis = model_axis
+        self._place_bank()
+
+    def _place_bank(self) -> None:
+        if self._bank is None or self._serve_mesh is None:
+            return
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        # Replicated, like the trie: item_topk's shard_map re-partitions
+        # the rows itself when the mesh path is taken.
+        self._bank = jax.device_put(
+            self._bank, NamedSharding(self._serve_mesh, PartitionSpec())
+        )
+
+    @property
+    def catalog_version(self) -> Optional[str]:
+        return self.catalog.version
+
+    def runtime_operands(self) -> tuple:
+        return (self._bank,)
+
+    def max_item_id(self):
+        # History ids are query TOKEN ids: anything below the [EMB]
+        # token (appended by make_batch, never by the caller) is legal.
+        return self.emb_token_id - 1
+
+    def _clamp(self, L: int) -> int:
+        return min(L, self._max_len) if self._max_len else L
+
+    def make_batch(self, reqs, B: int, L: int):
+        L = self._clamp(L)
+        ids = np.zeros((B, L + 1), np.int32)
+        mask = np.zeros((B, L + 1), np.int32)
+        emb_idx = np.zeros((B, 1), np.int32)
+        for i, r in enumerate(reqs):
+            h = _clip_history(r.history, L)
+            ids[i, :len(h)] = h
+            ids[i, len(h)] = self.emb_token_id
+            mask[i, :len(h) + 1] = 1
+            emb_idx[i, 0] = len(h)
+        # B-padding rows keep their defaults: [EMB] at position 0 with
+        # mask zeroed elsewhere — ids[i, 0] must still be the token the
+        # row reads, so stamp it for the unfilled rows too.
+        for i in range(len(reqs), B):
+            ids[i, 0] = self.emb_token_id
+            mask[i, 0] = 1
+        return jnp.asarray(ids), jnp.asarray(mask), jnp.asarray(emb_idx)
+
+    def make_fn(self, B: int, L: int):
+        from genrec_tpu.models.notellm import query2embedding_forward
+        from genrec_tpu.parallel.shardings import item_topk
+
+        del B, L  # shapes come from make_batch (same clamp)
+
+        def fn(params, bank, ids, mask, emb_idx):
+            out = query2embedding_forward(
+                self.model, params, ids, mask, emb_idx,
+                tau=jnp.float32(0.0), return_loss=False,
+            )
+            emb = out.sentence_embedding  # (B, d) fp32, L2-normalized
+            ones = jnp.ones((emb.shape[0], 1), emb.dtype)
+            return item_topk(
+                jnp.concatenate([emb, ones], axis=1), bank, self.top_k,
+                mesh=self.mesh, model_axis=self.model_axis,
+            )
+
+        return fn
+
+    def finalize(self, outputs, reqs) -> list[dict]:
+        scores, rows = outputs
+        out = []
+        for i in range(len(reqs)):
+            s = np.asarray(scores[i])
+            r = np.asarray(rows[i])
+            # Rows that only the pad bias could fill (top_k > n_items)
+            # report item -1, never a phantom id.
+            items = np.where(s < self._PAD_BIAS / 2, -1, r - 1)
+            out.append(dict(items=items, scores=s, sem_ids=None))
+        return out
 
 
 # ---------------------------------------------------------------------------

@@ -148,6 +148,13 @@ class ServingMetrics:
         # nor evict a quiet head's samples out of evaluation.
         self._recent_window = recent_window
         self._recent_lat: dict = {}
+        # PER-TENANT rings parallel to the per-head ones: the tenancy
+        # front (genrec_tpu/tenancy) attributes each completed response
+        # to the SUBMITTING tenant, so its SLO monitor evaluates tenant
+        # p99 over tenant traffic only — a head shared by two tenants
+        # (or renamed bindings) can never smear one tenant's tail onto
+        # another's shed decision. Head rings stay untouched.
+        self._recent_lat_tenant: dict = {}
         self._started = time.monotonic()
         self._warm = False
 
@@ -301,18 +308,37 @@ class ServingMetrics:
                 )
             ring.append((now, float(total)))
 
+    def record_tenant_response(self, tenant: str, total: float) -> None:
+        """Attribute one completed response's total latency to a TENANT
+        ring (the tenancy front's done-callback; head-side recording
+        already happened via record_response — tenant rings are a
+        parallel index, not a second count)."""
+        now = time.monotonic()
+        with self._lock:
+            ring = self._recent_lat_tenant.get(tenant)
+            if ring is None:
+                ring = self._recent_lat_tenant[tenant] = collections.deque(
+                    maxlen=self._recent_window
+                )
+            ring.append((now, float(total)))
+
     def recent_p99_ms(self, window_s: float, head: str | None = None,
-                      q: float = 0.99, min_count: int = 20) -> float | None:
+                      q: float = 0.99, min_count: int = 20,
+                      tenant: str | None = None) -> float | None:
         """Total-latency quantile over responses completed within the
-        last ``window_s`` seconds — one head's ring when given, pooled
-        over every head otherwise — or None below ``min_count`` samples
-        (an empty window must not read as 'SLO met at 0ms' — the SLO
-        monitor skips the latency dimension instead). Only the ring
-        copy happens under the lock; filter + sort run outside it, off
-        the response hot path."""
+        last ``window_s`` seconds — one head's ring when given, one
+        TENANT's ring when ``tenant=`` is given (fed by
+        record_tenant_response), pooled over every head otherwise — or
+        None below ``min_count`` samples (an empty window must not read
+        as 'SLO met at 0ms' — the SLO monitor skips the latency
+        dimension instead). Only the ring copy happens under the lock;
+        filter + sort run outside it, off the response hot path."""
         cut = time.monotonic() - window_s
         with self._lock:
-            if head is None:
+            if tenant is not None:
+                ring = self._recent_lat_tenant.get(tenant)
+                samples = list(ring) if ring else []
+            elif head is None:
                 samples = [s for ring in self._recent_lat.values()
                            for s in ring]
             else:

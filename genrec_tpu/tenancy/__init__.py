@@ -1,0 +1,22 @@
+"""Multi-tenant serving plane (L7): per-tenant isolation over one
+engine + the A/B / shadow experimentation plane. `TenantFront` speaks
+the engine's exact ``submit() -> Future`` surface; see
+docs/SERVING.md ("Multi-tenancy + experiments")."""
+
+from genrec_tpu.tenancy.experiment import (
+    ARMS,
+    Experiment,
+    ExperimentConfig,
+    bucket_arm,
+)
+from genrec_tpu.tenancy.front import TENANT_COUNTERS, TenantConfig, TenantFront
+
+__all__ = [
+    "ARMS",
+    "Experiment",
+    "ExperimentConfig",
+    "TENANT_COUNTERS",
+    "TenantConfig",
+    "TenantFront",
+    "bucket_arm",
+]

@@ -1,0 +1,191 @@
+"""Experimentation plane: deterministic A/B bucketing + shadow mirroring.
+
+The serving provenance fields (``Response.params_step`` /
+``catalog_version`` / ``request_id``, PR 7/9) make offline attribution
+possible; this module adds the ONLINE half — which params version a user
+sees — as a property the infra guarantees rather than the caller
+remembers:
+
+- **Bucketing** is a pure function of ``(seed, user_id)``:
+  ``sha256(f"{seed}:{user_id}")``'s first 8 bytes as a uniform draw on
+  [0, 1) against the split. No process state, no RNG object — the same
+  user lands in the same arm across restarts, hosts, and languages with
+  a sha256 library, and the split is exact within binomial tolerance
+  (both property-tested in tests/test_tenancy.py).
+- **Arms** are duck-typed submit targets (anything with
+  ``submit(req) -> Future``): a second `ServingEngine`, a
+  `FleetRouter`, or one pinned replica of the PR 19 rollout machinery —
+  a canary that survived its guard window graduates into arm "b" by
+  being registered here, no new serving surface.
+- **Shadow** is a third target that sees a COPY of every routed request
+  and whose responses are recorded but never returned: the caller's
+  future is always the primary arm's future, and the shadow future is
+  consumed internally (exceptions included — a broken candidate shows
+  up as ``shadow_errors`` in the report, never in a caller's result).
+
+The report (``snapshot()`` / ``conclude()``) pairs each primary response
+with its shadow response via the provenance fields into ``exp_report``
+records — the artifact offline analysis joins against — written
+atomically (tmp + ``os.replace``, the checkpoint/catalog discipline).
+
+Layering: tenancy imports serving/fleet/obs; nothing imports tenancy.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import threading
+from collections import deque
+from typing import Optional
+
+#: Arm names, in registration order. Bucketing maps [0, split) -> "a".
+ARMS = ("a", "b")
+
+
+def bucket_arm(seed: int, user_id: int, split: float = 0.5) -> str:
+    """Deterministic A/B assignment for ``(seed, user_id)``.
+
+    The first 8 bytes of ``sha256(f"{seed}:{user_id}")`` as a uniform
+    u64 draw: ``draw / 2**64 < split`` -> arm "a". Stable across
+    processes and restarts (no RNG state), split-exact in expectation.
+    """
+    digest = hashlib.sha256(f"{int(seed)}:{int(user_id)}".encode()).digest()
+    draw = int.from_bytes(digest[:8], "big") / 2.0**64
+    return "a" if draw < float(split) else "b"
+
+
+@dataclasses.dataclass(frozen=True)
+class ExperimentConfig:
+    """One A/B experiment on one tenant's traffic.
+
+    ``split`` is arm "a"'s traffic share. ``report_path`` (optional)
+    is where ``conclude()`` writes the exp_report JSON artifact.
+    ``max_records`` bounds the paired-comparison ring (oldest evicted;
+    counters keep the lifetime totals).
+    """
+
+    name: str
+    seed: int
+    split: float = 0.5
+    report_path: Optional[str] = None
+    max_records: int = 8192
+
+    def __post_init__(self):
+        if not 0.0 <= self.split <= 1.0:
+            raise ValueError(f"split {self.split} outside [0, 1]")
+
+
+def _provenance(resp) -> dict:
+    """The response fields offline attribution joins on."""
+    return {
+        "request_id": getattr(resp, "request_id", None),
+        "params_step": getattr(resp, "params_step", None),
+        "catalog_version": getattr(resp, "catalog_version", None),
+        "replica_id": getattr(resp, "replica_id", None),
+        "items": [int(x) for x in getattr(resp, "items", [])],
+    }
+
+
+class Experiment:
+    """Routing + recording state for one running experiment.
+
+    Owned by the `TenantFront` (which counts arm routes and mirrors the
+    shadow copies); thread-safe — callbacks land from batcher threads.
+    """
+
+    def __init__(self, config: ExperimentConfig, arms: dict,
+                 shadow=None):
+        missing = [a for a in ARMS if a not in arms]
+        if missing:
+            raise ValueError(f"experiment {config.name!r} missing arms {missing}")
+        self.config = config
+        self.arms = dict(arms)
+        self.shadow = shadow
+        self._lock = threading.Lock()
+        self._records: deque = deque(maxlen=config.max_records)
+        self._routed = {a: 0 for a in ARMS}
+        self._shadow_mirrored = 0
+        self._shadow_errors = 0
+        self._shadow_mismatches = 0
+
+    # -- routing -------------------------------------------------------------
+
+    def route(self, user_id: int):
+        """(arm_name, submit_target) for this user — pure bucketing."""
+        arm = bucket_arm(self.config.seed, user_id, self.config.split)
+        with self._lock:
+            self._routed[arm] += 1
+        return arm, self.arms[arm]
+
+    # -- recording -----------------------------------------------------------
+
+    def record_pair(self, user_id: int, arm: str, primary,
+                    shadow_resp=None, shadow_error: Optional[str] = None,
+                    t_submit: Optional[float] = None) -> None:
+        """One completed (primary, shadow) pair: provenance from both
+        sides plus the headline comparison (did the candidate agree?).
+        ``shadow_resp`` is None when no shadow target is registered or
+        the mirror failed (``shadow_error`` carries the refusal)."""
+        rec = {
+            "user_id": int(user_id),
+            "arm": arm,
+            "primary": _provenance(primary),
+        }
+        if t_submit is not None:
+            rec["t_submit"] = float(t_submit)
+        if shadow_resp is not None:
+            rec["shadow"] = _provenance(shadow_resp)
+            rec["items_match"] = rec["shadow"]["items"] == rec["primary"]["items"]
+        elif shadow_error is not None:
+            rec["shadow_error"] = shadow_error
+        with self._lock:
+            if shadow_resp is not None:
+                self._shadow_mirrored += 1
+                if not rec["items_match"]:
+                    self._shadow_mismatches += 1
+            elif shadow_error is not None:
+                self._shadow_errors += 1
+            self._records.append(rec)
+
+    # -- reporting -----------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """Numeric summary (flattens into stats()/Prometheus)."""
+        with self._lock:
+            return {
+                "seed": self.config.seed,
+                "split": self.config.split,
+                "routed_a": self._routed["a"],
+                "routed_b": self._routed["b"],
+                "shadow_mirrored": self._shadow_mirrored,
+                "shadow_errors": self._shadow_errors,
+                "shadow_mismatches": self._shadow_mismatches,
+            }
+
+    def report(self) -> dict:
+        """The full exp_report payload: summary + paired records."""
+        with self._lock:
+            records = list(self._records)
+        return {
+            "experiment": self.config.name,
+            "summary": self.snapshot(),
+            "n_records": len(records),
+            "records": records,
+        }
+
+    def conclude(self) -> dict:
+        """Final report; written atomically when ``report_path`` is set
+        (tmp + os.replace — a reader can never observe a half-written
+        artifact, same as checkpoints/catalog snapshots)."""
+        data = self.report()
+        path = self.config.report_path
+        if path:
+            os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as fh:
+                json.dump(data, fh, indent=1, sort_keys=True)
+            os.replace(tmp, path)
+        return data

@@ -1,0 +1,502 @@
+"""TenantFront: per-tenant isolation over one serving engine.
+
+The engine (PR 5..19) already carries every mechanism a tenancy layer
+needs — versioned per-head catalogs, an enforced HBM ledger, per-head
+SLO shed, response provenance, rooted traces — but nothing GROUPS them:
+a head is an implementation detail, a tenant is a contract. This front
+speaks the engine's exact ``submit() -> Future`` surface (the same
+duck-type the `FleetRouter` exposes, so it stacks on either) and owns
+the grouping:
+
+- **Binding**: each tenant binds exactly one head (1:1 — the head IS the
+  tenant's model surface), its own catalog directory (a per-tenant
+  `CatalogWatcher` publishes corpus snapshots independently), its own
+  `SLOTarget`, and an HBM sub-budget carved out of the engine ledger's
+  per-head groups (``ledger()`` reports per-tenant sub-totals that sum
+  to the engine total — the check_tenancy invariant).
+- **Admission/shed**: per-tenant in-flight accounting (submitted minus
+  resolved, bounded by ``max_inflight``) plus a per-tenant `SLOMonitor`
+  fed from the metrics rings' TENANT key
+  (`ServingMetrics.record_tenant_response`) — so a hot tenant sheds the
+  typed `OverloadError` at THIS layer while co-hosted tenants' requests
+  never queue behind it. Engine-level per-head shed stays as the inner
+  backstop.
+- **Experiments**: per-tenant A/B routing + shadow mirroring
+  (tenancy/experiment.py) over duck-typed submit targets, so a PR 19
+  canary replica graduates into an arm without new serving surface.
+- **Attribution**: when the front is the outermost submitter it mints
+  the request's lineage and stamps ``tenant=`` on the root "request"
+  span — `trace_report.py --critical-path --tenant <t>` filters on it.
+
+Threading: ``submit()`` runs on caller threads; completion callbacks on
+the engine's batcher thread. One lock guards the tenant table and
+counters; never held across an engine call or a Future result.
+
+Layering: L7 beside fleet/ and disagg/ — imports serving/fleet/obs;
+nothing imports tenancy.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from collections import Counter
+from concurrent.futures import Future
+from typing import Optional
+
+from genrec_tpu.obs.flight_recorder import get_flight_recorder
+from genrec_tpu.obs.slo import SLOMonitor, SLOTarget
+from genrec_tpu.obs.spans import NULL_TRACER, SpanTracer, TraceContext
+from genrec_tpu.serving.catalog import CatalogWatcher
+from genrec_tpu.serving.metrics import ServingMetrics
+from genrec_tpu.serving.types import OverloadError, Request
+from genrec_tpu.tenancy.experiment import Experiment, ExperimentConfig
+
+#: stats()["tenancy"] counter keys, in emission order (obs/export.py
+#: types each as a Prometheus counter; inflight/p99_ms/shedding are the
+#: gauges).
+TENANT_COUNTERS = (
+    "submitted", "completed", "failed", "shed", "shadow_mirrored",
+    "exp_arm_a", "exp_arm_b",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantConfig:
+    """One tenant's contract: head binding + isolation knobs.
+
+    ``slo`` drives the per-tenant shed state machine (None = this tenant
+    never sheds at the front); ``max_inflight`` is the hard queue-
+    accounting bound (admission fails typed once this many submissions
+    are unresolved); ``hbm_budget_bytes`` is the ledger sub-budget
+    ``ledger()`` audits the bound head's group against;
+    ``catalog_dir`` gets a dedicated CatalogWatcher.
+    """
+
+    name: str
+    head: str
+    slo: Optional[SLOTarget] = None
+    catalog_dir: Optional[str] = None
+    hbm_budget_bytes: Optional[int] = None
+    max_inflight: Optional[int] = None
+
+    def __post_init__(self):
+        if not self.name:
+            raise ValueError("tenant needs a name")
+        if self.max_inflight is not None and self.max_inflight <= 0:
+            raise ValueError(f"max_inflight must be positive, got {self.max_inflight}")
+
+
+class _Tenant:
+    """Mutable per-tenant state (guarded by the front's lock)."""
+
+    __slots__ = ("cfg", "counters", "inflight", "watcher", "experiment",
+                 "next_poll", "shedding")
+
+    def __init__(self, cfg: TenantConfig):
+        self.cfg = cfg
+        self.counters: Counter = Counter()
+        self.inflight = 0
+        self.watcher: Optional[CatalogWatcher] = None
+        self.experiment: Optional[Experiment] = None
+        self.next_poll = 0.0
+        self.shedding = False  # front-observed SLO state (for transitions)
+
+
+class TenantFront:
+    """The engine surface, tenant-aware. See module docstring."""
+
+    def __init__(self, engine, tenants=(), tracer: Optional[SpanTracer] = None,
+                 slo_poll_s: float = 0.05,
+                 logger: Optional[logging.Logger] = None):
+        self._engine = engine
+        self._lock = threading.Lock()
+        self._tenants: dict[str, _Tenant] = {}
+        self._by_head: dict[str, str] = {}
+        self._slo: Optional[SLOMonitor] = None
+        self._slo_poll_s = float(slo_poll_s)
+        self._log = logger or logging.getLogger("genrec_tpu")
+        self._flight = get_flight_recorder().scoped("tenant_front")
+        if tracer is None:
+            tracer = getattr(engine, "tracer", None)
+        self._tracer = tracer if tracer is not None else NULL_TRACER
+        # Tenant p99 rings live in the ENGINE's metrics when it has them
+        # (one ring store per serving process); a router front without
+        # metrics gets a private store — the rings are front-fed either
+        # way (record_tenant_response).
+        self._metrics = getattr(engine, "metrics", None)
+        if self._metrics is None:
+            self._metrics = ServingMetrics()
+        for cfg in tenants:
+            self.add_tenant(cfg)
+
+    # -- tenant table --------------------------------------------------------
+
+    def add_tenant(self, cfg: TenantConfig) -> None:
+        """Bind a tenant. Head bindings are exclusive (1:1): the head is
+        the tenant's model surface, and per-head engine metrics/SLO
+        attribution would smear if two tenants shared one."""
+        with self._lock:
+            if cfg.name in self._tenants:
+                raise ValueError(f"tenant {cfg.name!r} already bound")
+            holder = self._by_head.get(cfg.head)
+            if holder is not None:
+                raise ValueError(
+                    f"head {cfg.head!r} already bound to tenant {holder!r}"
+                )
+            st = _Tenant(cfg)
+            self._tenants[cfg.name] = st
+            self._by_head[cfg.head] = cfg.name
+            # SLOMonitor's target set is fixed at construction; rebuild
+            # with the grown set (shed state restarts clean for everyone
+            # — add_tenant is a control-plane op, not a hot-path one).
+            targets = {
+                name: t.cfg.slo
+                for name, t in self._tenants.items() if t.cfg.slo is not None
+            }
+        if cfg.catalog_dir is not None:
+            st.watcher = CatalogWatcher(
+                self._engine, cfg.head, cfg.catalog_dir, logger=self._log
+            ).start()
+        with self._lock:
+            self._slo = SLOMonitor(targets) if targets else None
+        self._flight.record(
+            "tenant_added", tenant=cfg.name, head=cfg.head,
+            has_slo=cfg.slo is not None,
+            has_catalog_dir=cfg.catalog_dir is not None,
+            hbm_budget_bytes=cfg.hbm_budget_bytes,
+            max_inflight=cfg.max_inflight,
+        )
+        self._log.info(
+            f"tenancy: tenant {cfg.name!r} bound to head {cfg.head!r}"
+        )
+
+    def set_tracer(self, tracer: Optional[SpanTracer]) -> None:
+        """Swap tracing live (same contract as the engine/router: build
+        fronts and engines on ONE tracer instance so span ids stay one
+        id space; None turns front-minted lineage off)."""
+        self._tracer = tracer if tracer is not None else NULL_TRACER
+
+    def tenants(self) -> list[str]:
+        with self._lock:
+            return sorted(self._tenants)
+
+    def tenant_of(self, head: str) -> Optional[str]:
+        with self._lock:
+            return self._by_head.get(head)
+
+    def stop(self) -> None:
+        """Stop the front's own machinery (watchers; running experiments
+        are concluded so their reports are not lost). The engine's
+        lifecycle belongs to its owner."""
+        with self._lock:
+            tenants = list(self._tenants.values())
+        for st in tenants:
+            if st.experiment is not None:
+                try:
+                    self.conclude_experiment(st.cfg.name)
+                except Exception:  # noqa: BLE001 — stop() must not throw
+                    self._log.exception(
+                        f"tenancy: concluding experiment for {st.cfg.name!r} failed"
+                    )
+            if st.watcher is not None:
+                st.watcher.stop()
+                st.watcher = None
+
+    # -- experiments ---------------------------------------------------------
+
+    def start_experiment(self, tenant: str, config: ExperimentConfig,
+                         arms: dict, shadow=None) -> Experiment:
+        """Register an A/B experiment on ``tenant``'s traffic. ``arms``
+        maps {"a": target, "b": target} to duck-typed submit targets
+        (engines, routers, pinned rollout replicas); ``shadow`` is an
+        optional third target that is mirrored to but never answered
+        from."""
+        for arm_name, target in dict(arms).items():
+            if not callable(getattr(target, "submit", None)):
+                raise ValueError(f"arm {arm_name!r} target has no submit()")
+        if shadow is not None and not callable(getattr(shadow, "submit", None)):
+            raise ValueError("shadow target has no submit()")
+        exp = Experiment(config, arms, shadow)
+        with self._lock:
+            st = self._tenants[tenant]
+            if st.experiment is not None:
+                raise ValueError(
+                    f"tenant {tenant!r} already runs experiment "
+                    f"{st.experiment.config.name!r}"
+                )
+            st.experiment = exp
+        self._flight.record(
+            "experiment_started", tenant=tenant, experiment=config.name,
+            seed=config.seed, split=config.split, shadow=shadow is not None,
+        )
+        return exp
+
+    def conclude_experiment(self, tenant: str) -> dict:
+        """Detach + conclude the tenant's experiment; returns (and, when
+        configured, atomically writes) the exp_report artifact."""
+        with self._lock:
+            st = self._tenants[tenant]
+            exp, st.experiment = st.experiment, None
+        if exp is None:
+            raise ValueError(f"tenant {tenant!r} has no running experiment")
+        data = exp.conclude()
+        summary = data["summary"]
+        self._flight.record(
+            "experiment_concluded", tenant=tenant,
+            experiment=data["experiment"], n_records=data["n_records"],
+            routed_a=summary["routed_a"], routed_b=summary["routed_b"],
+            shadow_mirrored=summary["shadow_mirrored"],
+            shadow_errors=summary["shadow_errors"],
+            report_path=exp.config.report_path,
+        )
+        return data
+
+    # -- request path --------------------------------------------------------
+
+    def submit(self, req: Request) -> Future:
+        """The engine surface, tenant-aware: typed `OverloadError` names
+        the shedding TENANT; heads no tenant bound stay untouched
+        (pass-through), so tenanted and plain traffic co-host."""
+        with self._lock:
+            tenant = self._by_head.get(req.head)
+            st = self._tenants.get(tenant) if tenant else None
+        if st is None:
+            return self._engine.submit(req)
+        self._poll_slo(tenant, st)
+        with self._lock:
+            cfg = st.cfg
+            if cfg.max_inflight is not None and st.inflight >= cfg.max_inflight:
+                st.counters["shed"] += 1
+                reason = f"inflight {st.inflight} >= max_inflight {cfg.max_inflight}"
+                shed = True
+            elif self._slo is not None and cfg.slo is not None \
+                    and self._slo.is_shedding(tenant):
+                st.counters["shed"] += 1
+                reason = self._slo.shed_reason(tenant)
+                shed = True
+            else:
+                shed = False
+        if shed:
+            raise OverloadError(f"tenant {tenant!r} shedding: {reason}")
+        exp = st.experiment
+        target, arm = self._engine, None
+        if exp is not None:
+            arm, target = exp.route(req.user_id)
+        tracer = self._tracer
+        minted = None
+        if req.trace is None and tracer.enabled:
+            # Outermost submit: mint the lineage; the root "request"
+            # span (recorded when the caller's future resolves) carries
+            # the tenant attribution the trace reports filter on.
+            tid = tracer.new_trace()
+            root = tracer.allocate_span_id()
+            req = dataclasses.replace(
+                req, trace=TraceContext(tid, root, "tenant_front")
+            )
+            minted = (tid, root)
+        t_sub = time.monotonic()
+        try:
+            fut = target.submit(req)
+        except OverloadError:
+            # The inner engine/router shed this head — count it against
+            # the tenant (its callers see the identical typed error).
+            with self._lock:
+                st.counters["shed"] += 1
+            raise
+        with self._lock:
+            st.counters["submitted"] += 1
+            if arm is not None:
+                st.counters[f"exp_arm_{arm}"] += 1
+            st.inflight += 1
+        head = req.head
+
+        def _done(f, tenant=tenant, st=st, t_sub=t_sub, minted=minted,
+                  head=head, arm=arm):
+            dt = time.monotonic() - t_sub
+            try:
+                err = f.exception()
+            except Exception:  # noqa: BLE001 — cancelled future
+                err = True
+            with self._lock:
+                st.inflight -= 1
+                st.counters["failed" if err else "completed"] += 1
+            if not err:
+                self._metrics.record_tenant_response(tenant, dt)
+            if minted is not None:
+                attrs = dict(
+                    head=head, origin="tenant_front",
+                    component="tenant_front", tenant=tenant,
+                    outcome="error" if err else "ok",
+                )
+                if arm is not None:
+                    attrs["exp_arm"] = arm
+                tracer.record_span(
+                    "request", minted[0], t_sub, time.monotonic(),
+                    span_id=minted[1], **attrs,
+                )
+
+        fut.add_done_callback(_done)
+        if exp is not None and exp.shadow is not None:
+            self._mirror_shadow(st, exp, req, arm, fut, t_sub)
+        return fut
+
+    def _mirror_shadow(self, st: _Tenant, exp: Experiment, req: Request,
+                       arm: str, primary_fut: Future, t_sub: float) -> None:
+        """Submit a COPY to the shadow target and pair its answer with
+        the primary's into the experiment record. The shadow future is
+        consumed HERE — its result (or failure) can never surface in the
+        caller's future. The copy drops the caller's trace context: the
+        candidate's spans must not pollute the primary's critical path
+        (the shadow run roots its own trace inside its engine)."""
+        shadow_req = dataclasses.replace(req, trace=None)
+        holder: dict = {}
+        hlock = threading.Lock()
+        user_id = int(req.user_id)
+
+        def _maybe_record():
+            with hlock:
+                if "primary" not in holder or "shadow" not in holder:
+                    return
+                p_kind, p_val = holder["primary"]
+                s_kind, s_val = holder["shadow"]
+            if p_kind != "ok":
+                return  # primary failed: nothing to attribute against
+            if s_kind == "ok":
+                exp.record_pair(user_id, arm, p_val, shadow_resp=s_val,
+                                t_submit=t_sub)
+            else:
+                exp.record_pair(user_id, arm, p_val, shadow_error=s_val,
+                                t_submit=t_sub)
+
+        def _settle(key):
+            def cb(f):
+                try:
+                    val = ("ok", f.result())
+                except BaseException as e:  # noqa: BLE001 — recorded, never raised
+                    val = ("err", repr(e))
+                with hlock:
+                    holder[key] = val
+                _maybe_record()
+            return cb
+
+        primary_fut.add_done_callback(_settle("primary"))
+        try:
+            shadow_fut = exp.shadow.submit(shadow_req)
+        except Exception as e:  # noqa: BLE001 — a shedding candidate is data
+            with hlock:
+                holder["shadow"] = ("err", repr(e))
+            _maybe_record()
+            return
+        with self._lock:
+            st.counters["shadow_mirrored"] += 1
+        shadow_fut.add_done_callback(_settle("shadow"))
+
+    # -- SLO plumbing --------------------------------------------------------
+
+    def _poll_slo(self, tenant: str, st: _Tenant) -> None:
+        """Opportunistic per-tenant SLO evaluation on the submit path
+        (rate-limited; no background thread — an idle tenant needs no
+        shed decision). Feeds the tenant's windowed p99 (tenant metrics
+        ring) + live in-flight depth; fires the tenant_shed_* flight
+        events on transitions."""
+        if self._slo is None or st.cfg.slo is None:
+            return
+        now = time.monotonic()
+        with self._lock:
+            if now < st.next_poll:
+                return
+            st.next_poll = now + self._slo_poll_s
+            depth = st.inflight
+        p99 = self._metrics.recent_p99_ms(st.cfg.slo.window_s, tenant=tenant)
+        shedding = self._slo.observe(
+            tenant, p99_ms=p99, queue_depth=depth, now=now
+        )
+        with self._lock:
+            was, st.shedding = st.shedding, shedding
+        if shedding and not was:
+            self._flight.record(
+                "tenant_shed_started", tenant=tenant,
+                reason=self._slo.shed_reason(tenant), inflight=depth,
+                p99_ms=None if p99 is None else round(p99, 3),
+            )
+        elif was and not shedding:
+            self._flight.record("tenant_shed_stopped", tenant=tenant)
+
+    # -- observability -------------------------------------------------------
+
+    def stats(self) -> dict:
+        """{"tenancy": {tenant: counters+gauges}, "experiments": {...}}.
+        Counter leaves are typed as Prometheus counters through
+        obs/export.py; ``inflight``/``p99_ms``/``shedding`` are gauges."""
+        with self._lock:
+            items = sorted(self._tenants.items())
+        tenancy: dict = {}
+        experiments: dict = {}
+        for name, st in items:
+            with self._lock:
+                entry = {k: st.counters.get(k, 0) for k in TENANT_COUNTERS}
+                entry["inflight"] = st.inflight
+                entry["shedding"] = st.shedding
+            if st.cfg.slo is not None:
+                p99 = self._metrics.recent_p99_ms(
+                    st.cfg.slo.window_s, tenant=name
+                )
+                if p99 is not None:
+                    entry["p99_ms"] = round(p99, 3)
+            tenancy[name] = entry
+            if st.experiment is not None:
+                experiments[st.experiment.config.name] = st.experiment.snapshot()
+        out: dict = {"tenancy": tenancy}
+        if experiments:
+            out["experiments"] = experiments
+        if self._slo is not None:
+            out["slo"] = self._slo.snapshot()
+        return out
+
+    def ledger(self) -> dict:
+        """Per-tenant HBM sub-totals carved from the engine ledger's
+        per-head groups, plus the unassigned remainder — built so the
+        parts PROVABLY sum back to the engine total (the check_tenancy
+        invariant): Σ tenant operand_bytes + unassigned_operand_bytes +
+        transient_peak_bytes == total_bytes (one executable runs at a
+        time, so the cross-group transient peak is a single shared
+        term, exactly as `MemoryLedger.summary` accounts it)."""
+        mem = getattr(self._engine, "memory", None)
+        if mem is None:
+            return {}
+        summary = mem.summary()
+        heads = summary["heads"]
+        with self._lock:
+            by_head = {st.cfg.head: name for name, st in self._tenants.items()}
+            budgets = {name: st.cfg.hbm_budget_bytes
+                       for name, st in self._tenants.items()}
+        tenants: dict = {}
+        unassigned = 0
+        for gname in sorted(heads):
+            g = heads[gname]
+            tname = by_head.get(gname)
+            if tname is None:
+                unassigned += g["operand_bytes"]
+                continue
+            entry = {
+                "head": gname,
+                "operand_bytes": g["operand_bytes"],
+                "transient_peak_bytes": g["transient_peak_bytes"],
+                "total_bytes": g["total_bytes"],
+            }
+            budget = budgets.get(tname)
+            if budget is not None:
+                entry["budget_bytes"] = int(budget)
+                entry["over_budget"] = g["total_bytes"] > int(budget)
+            tenants[tname] = entry
+        return {
+            "tenants": tenants,
+            "unassigned_operand_bytes": unassigned,
+            "transient_peak_bytes": max(
+                (h["transient_peak_bytes"] for h in heads.values()), default=0
+            ),
+            "total_bytes": summary["total_bytes"],
+        }

@@ -94,6 +94,17 @@ GATED_METRICS: dict[str, tuple[str, float]] = {
     # (the zero-baseline rule above).
     "serve/fleet/p99_under_burst_ms": ("lower", 50.0),
     "serve/fleet/shed_rate": ("lower", 100.0),
+    # Multi-tenant serving plane (PR 20): the victim tenant's p99 with
+    # an admission-capped aggressor surging vs serving its share alone
+    # (both sides saturated-CPU walls: wide band), the A/B arm split's
+    # absolute error vs the pure bucket_arm hash (deterministic routing
+    # -> 0.0 baseline, banded in ABSOLUTE units by the zero-baseline
+    # rule — any drift means the router stopped honoring the hash), and
+    # the shadow mirror's closed-loop qps tax at identical arms (the
+    # mirror machinery alone; shadow compute runs on its own engine).
+    "serve/tenancy/victim_p99_with_aggressor_vs_alone": ("lower", 80.0),
+    "serve/tenancy/ab_split_abs_err": ("lower", 0.02),
+    "serve/tenancy/shadow_overhead_pct": ("lower", 100.0),
     # Disaggregated serving (PR 13): the serializing handoff's
     # send->admit p50 (latency on a shared CPU host: wide band), the
     # mean wire bytes per handoff (measured packed payloads on the

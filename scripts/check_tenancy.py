@@ -1,0 +1,273 @@
+"""Multi-tenant serving-plane check (shared graftlint harness,
+genrec_tpu/analysis/ir.py — CLI, verdict JSON and rc conventions
+unchanged): does the tenancy front really keep tenants apart while the
+experiment plane runs underneath?
+
+One scenario, end to end: a `TenantFront` binds two tenants (two TIGER
+heads with DISJOINT catalogs) over one engine, tenant A runs an A/B
+experiment (arm "b" = a second engine) with a SHADOW engine mirroring
+every routed request, and a deterministic multi-tenant burst trace
+(genrec_tpu/fleet/traffic.py tenant mix) replays open-loop while BOTH
+tenants' catalogs churn mid-trace (staged same-rung swaps). Asserts:
+
+- **zero steady-state recompiles** across primary, arm-b, and shadow
+  engines — catalog churn under tenancy holds the AOT ladder;
+- **zero cross-tenant version mixing** — every response's
+  ``catalog_version`` belongs to ITS tenant's head (version sets are
+  disjoint by construction, so one wrong provenance stamp fails);
+- **the shadow never surfaces** — every caller-visible response comes
+  from the deterministically bucketed arm (`bucket_arm`), never from
+  the shadow replica, while the exp_report proves the shadow ran;
+- **ledger sub-totals sum to the engine total** — per-tenant HBM
+  accounting is a partition, not an estimate.
+
+Run:  python scripts/check_tenancy.py             (default shapes)
+      python scripts/check_tenancy.py --small     (CI-speed shapes)
+Appends a verdict line to docs/PERF.md when --write-note is passed.
+Prints ONE JSON verdict line on stdout; rc 0 ok / 1 failed.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from genrec_tpu.analysis import ir  # noqa: E402
+
+
+def main(argv=None):
+    args = ir.check_args(argv)
+
+    import jax
+
+    if args.platform:
+        from genrec_tpu.parallel.mesh import pin_platform
+
+        pin_platform(args.platform)
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from genrec_tpu.catalog import CatalogSnapshot
+    from genrec_tpu.fleet import (
+        Burst, TenantTraffic, TraceConfig, generate_trace, replay,
+    )
+    from genrec_tpu.models.tiger import Tiger
+    from genrec_tpu.serving import BucketLadder, ServingEngine
+    from genrec_tpu.serving.heads import TigerGenerativeHead
+    from genrec_tpu.tenancy import (
+        ExperimentConfig, TenantConfig, TenantFront, bucket_arm,
+    )
+
+    backend = jax.default_backend()
+    if args.small:
+        n_corpus = 40
+        arch = dict(embedding_dim=16, attn_dim=32, dropout=0.0, num_heads=4,
+                    n_layers=2, num_item_embeddings=8, num_user_embeddings=20,
+                    sem_id_dim=3)
+        ladder = BucketLadder((1, 2), (8,))
+        max_batch = 2
+        n_requests = 32
+        rate = 60.0
+    else:
+        n_corpus = 400
+        arch = dict(embedding_dim=64, attn_dim=128, dropout=0.0, num_heads=4,
+                    n_layers=4, num_item_embeddings=64,
+                    num_user_embeddings=10_000, sem_id_dim=3)
+        ladder = BucketLadder((1, 4), (8, 16))
+        max_batch = 4
+        n_requests = 64
+        rate = 40.0
+    D = arch["sem_id_dim"]
+    Kcb = arch["num_item_embeddings"]
+    max_hist = ladder.history_buckets[-1]
+
+    model = Tiger(**arch)
+    rng = np.random.default_rng(0)
+
+    def corpus(seed, n):
+        r = np.random.default_rng(seed)
+        return np.unique(r.integers(0, Kcb, (n, D)), axis=0)
+
+    B0, L0 = 2, 2 * D
+    params = model.init(
+        jax.random.key(0),
+        jnp.zeros((B0,), jnp.int32), jnp.zeros((B0, L0), jnp.int32),
+        jnp.zeros((B0, L0), jnp.int32), jnp.zeros((B0, D), jnp.int32),
+        jnp.zeros((B0, D), jnp.int32), jnp.ones((B0, L0), jnp.int32),
+    )["params"]
+
+    corpus_a0, corpus_b0 = corpus(1, n_corpus), corpus(2, n_corpus)
+    # Same capacity rung for the churn snapshots: the swap must be a
+    # zero-recompile operand exchange, not a precompile event mid-trace.
+    corpus_a1, corpus_b1 = corpus(3, len(corpus_a0)), corpus(4, len(corpus_b0))
+
+    def engine(heads_corpora, rid):
+        heads = [TigerGenerativeHead(model, ids, top_k=5, name=n)
+                 for n, ids in heads_corpora]
+        return ServingEngine(
+            heads, {h.name: params for h in heads}, ladder=ladder,
+            max_batch=max_batch, max_wait_ms=2.0, handle_signals=False,
+            replica_id=rid, params_by_head=True,
+        )
+
+    eng = engine([("t_a", corpus_a0), ("t_b", corpus_b0)], "primary")
+    eng_b = engine([("t_a", corpus_a0)], "arm_b")
+    eng_sh = engine([("t_a", corpus_a0)], "shadow")
+    for e in (eng, eng_b, eng_sh):
+        e.start()
+
+    front = TenantFront(eng, tenants=[
+        TenantConfig(name="acme", head="t_a", hbm_budget_bytes=4 << 30),
+        TenantConfig(name="globex", head="t_b", hbm_budget_bytes=4 << 30),
+    ])
+    report_path = os.path.join(REPO, "out", "exp_report_check.json")
+    exp = front.start_experiment(
+        "acme",
+        ExperimentConfig(name="tenancy-check", seed=29, split=0.5,
+                         report_path=report_path),
+        arms={"a": eng, "b": eng_b}, shadow=eng_sh,
+    )
+
+    # Deterministic multi-tenant mix: acme surges 4x mid-burst while
+    # globex (the victim) keeps its share — the co-tenancy shape the
+    # isolation bench gates, here driven through the front.
+    trace = generate_trace(TraceConfig(
+        n_requests=n_requests, n_users=10_000, max_items=max_hist,
+        corpus_size=min(len(corpus_a0), len(corpus_b0)), seed=9,
+        base_rate_qps=rate, diurnal_period_s=4.0, diurnal_amplitude=0.3,
+        bursts=(Burst(0.15, 0.3, 3.0),),
+        tenants=(TenantTraffic("acme", "t_a", burst_mult=4.0),
+                 TenantTraffic("globex", "t_b")),
+    ))
+
+    # Mid-trace catalog churn on BOTH tenants (and the arm/shadow
+    # engines, so every submit target swaps): same-rung staged swaps.
+    snap_a1 = CatalogSnapshot.build(corpus_a1, Kcb)
+    snap_b1 = CatalogSnapshot.build(corpus_b1, Kcb)
+    t_mid = trace.arrivals[len(trace) // 2].t
+
+    def churn():
+        eng.stage_catalog("t_a", snap_a1)
+        eng.stage_catalog("t_b", snap_b1)
+        eng_b.stage_catalog("t_a", snap_a1)
+        eng_sh.stage_catalog("t_a", snap_a1)
+
+    versions = {
+        "t_a": {CatalogSnapshot.build(corpus_a0, Kcb).version, snap_a1.version},
+        "t_b": {CatalogSnapshot.build(corpus_b0, Kcb).version, snap_b1.version},
+    }
+
+    responses = []  # (head, user_id, response); head -> tenant is 1:1
+    orig_submit = front.submit
+
+    def submit(req):
+        fut = orig_submit(req)
+
+        def check(f):
+            if f.exception() is None:
+                responses.append((req.head, int(req.user_id), f.result()))
+
+        fut.add_done_callback(check)
+        return fut
+
+    report = replay(trace, submit, chaos=[(t_mid, churn)],
+                    gather_timeout_s=600.0)
+
+    # Wait for the shadow mirrors to settle before concluding.
+    import time as _time
+    deadline = _time.monotonic() + 60
+    while _time.monotonic() < deadline:
+        snap = exp.snapshot()
+        acme_sub = front.stats()["tenancy"]["acme"]["completed"]
+        if snap["shadow_mirrored"] + snap["shadow_errors"] >= acme_sub:
+            break
+        _time.sleep(0.05)
+    exp_data = front.conclude_experiment("acme")
+    ledger = front.ledger()
+    front.stop()
+    stats = [e.stats() for e in (eng, eng_b, eng_sh)]
+    for e in (eng, eng_b, eng_sh):
+        e.stop()
+
+    recompiles = sum(s["recompilations"] for s in stats)
+    version_mixing = 0
+    shadow_surfaced = 0
+    wrong_arm = 0
+    for head, uid, resp in responses:
+        tenant = "acme" if head == "t_a" else "globex"
+        if resp.catalog_version not in versions[head]:
+            version_mixing += 1
+        if resp.replica_id == "shadow":
+            shadow_surfaced += 1
+        if tenant == "acme":
+            want = "primary" if bucket_arm(29, uid, 0.5) == "a" else "arm_b"
+            if resp.replica_id != want:
+                wrong_arm += 1
+    tenant_ops = sum(t["operand_bytes"] for t in ledger["tenants"].values())
+    ledger_identity = (
+        tenant_ops + ledger["unassigned_operand_bytes"]
+        + ledger["transient_peak_bytes"] == ledger["total_bytes"]
+    )
+
+    verdict = {
+        "backend": backend,
+        "submitted": report.submitted,
+        "completed": report.completed,
+        "shed": report.shed,
+        "failed": report.failed,
+        "lost": report.lost,
+        "recompilations": recompiles,
+        "version_mixing": version_mixing,
+        "shadow_surfaced": shadow_surfaced,
+        "wrong_arm": wrong_arm,
+        "shadow_mirrored": exp_data["summary"]["shadow_mirrored"],
+        "shadow_errors": exp_data["summary"]["shadow_errors"],
+        "exp_records": exp_data["n_records"],
+        "ledger_identity": ledger_identity,
+        "tenants": report.tenants,
+        "ok": False,
+    }
+    ok = (
+        report.lost == 0
+        and report.failed == 0
+        and report.completed + report.shed == report.submitted
+        and report.completed > 0
+        and recompiles == 0
+        and version_mixing == 0
+        and shadow_surfaced == 0
+        and wrong_arm == 0
+        and exp_data["n_records"] > 0
+        and exp_data["summary"]["shadow_errors"] == 0
+        and ledger_identity
+        and os.path.exists(report_path)
+    )
+    verdict["ok"] = ok
+    ir.emit_verdict(verdict)
+
+    if args.write_note:
+        if ok:
+            msg = (
+                f"OK: {report.submitted} mixed-tenant requests "
+                f"({report.completed} completed) through a two-tenant "
+                f"front with mid-trace catalog churn on both tenants — "
+                f"0 recompiles, 0 cross-tenant version mixes, "
+                f"{exp_data['summary']['shadow_mirrored']} shadow mirrors "
+                "with 0 surfacing in caller futures, ledger sub-totals "
+                "partition the engine total exactly"
+            )
+        else:
+            msg = ("ATTENTION: tenancy front mixed versions, surfaced a "
+                   "shadow, recompiled, or lost ledger bytes")
+        ir.append_perf_note(
+            f"\n- Tenancy check (scripts/check_tenancy.py, "
+            f"backend={backend}): {msg}\n"
+        )
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -41,6 +41,16 @@
 #                            autoscaler standby backfill, zero lost
 #                            accepted requests, typed errors only,
 #                            zero recompiles, parity vs co-located
+#   check_tenancy.py       — multi-tenant serving plane: a two-tenant
+#                            TenantFront (disjoint TIGER catalogs) runs
+#                            an A/B experiment with a shadow engine
+#                            while a deterministic multi-tenant burst
+#                            trace replays and BOTH catalogs churn
+#                            mid-trace — zero recompiles across all
+#                            three engines, zero cross-tenant version
+#                            mixing, the shadow never surfaces, and
+#                            per-tenant ledger sub-totals partition the
+#                            engine total exactly
 #   check_pipeline.py      — streaming pipeline: seeded log -> stream
 #                            trainer -> publish -> canary -> promote on
 #                            ONE tiny TIGER, with real SIGKILLs at the
@@ -192,6 +202,15 @@ if [ "$MODE" = "--smoke" ]; then
     if [ -z "${GENREC_CI_SKIP_CROSSHOST:-}" ]; then
         run python scripts/check_crosshost.py --small --platform cpu
     fi
+    # Tenancy smoke: two tenants on one front, A/B + shadow experiment
+    # live, both catalogs churned mid-trace — zero recompiles on all
+    # three engines, zero version mixing, shadow never surfaces,
+    # ledger partitions exactly. GENREC_CI_SKIP_TENANCY=1 skips it for
+    # callers whose pytest pass already runs tests/test_tenancy.py
+    # directly (same contract as the knobs above).
+    if [ -z "${GENREC_CI_SKIP_TENANCY:-}" ]; then
+        run python scripts/check_tenancy.py --small --platform cpu
+    fi
     # Chaos-net smoke: the same two-process TIGER split under a SEEDED
     # fault schedule — a blackholed peer (liveness deadline -> reconnect),
     # an injected corrupt frame (CRC -> typed reconnect), a SIGKILL
@@ -303,6 +322,7 @@ else
     run python scripts/check_fleet.py --write-note
     run python scripts/check_disagg.py --write-note
     run python scripts/check_crosshost.py --write-note
+    run python scripts/check_tenancy.py --write-note
     run python scripts/check_chaosnet.py --write-note
     run python scripts/check_pipeline.py --write-note
     run python scripts/check_spec_hlo.py --write-note

@@ -6,6 +6,7 @@ Usage:
     python scripts/trace_report.py trace.json --json      # machine-readable
     python scripts/trace_report.py trace.json --phase decode_step
     python scripts/trace_report.py trace.json --critical-path
+    python scripts/trace_report.py trace.json --critical-path --tenant acme
     python scripts/trace_report.py --compare A.json B.json
     python scripts/trace_report.py --compare A.json B.json --critical-path
 
@@ -181,6 +182,9 @@ def _trace_forest(data: dict) -> dict:
             "parent": args.get("parent_id"),
             "name": ev.get("name", "?"),
             "component": args.get("component", ""),
+            # Tenant attribution (tenancy/front.py stamps the root
+            # request span): lets --tenant slice the critical path.
+            "tenant": args.get("tenant"),
             "t0": t0,
             "t1": t0 + float(ev.get("dur", 0.0)) / 1e3,
         })
@@ -236,6 +240,7 @@ def _request_segments(spans: list[dict]) -> "tuple[dict, dict] | None":
             components[seg].add(s["component"])
     meta = {
         "root_ms": root["t1"] - root["t0"],
+        "tenant": root.get("tenant"),
         "components": {seg: sorted(c) for seg, c in components.items()},
         "span_components": sorted({s["component"] for s in spans
                                    if s["component"]}),
@@ -243,16 +248,21 @@ def _request_segments(spans: list[dict]) -> "tuple[dict, dict] | None":
     return dict(segments), meta
 
 
-def critical_path_report(data: dict, tail_q: float = 0.95) -> dict:
+def critical_path_report(data: dict, tail_q: float = 0.95,
+                         tenant: "str | None" = None) -> dict:
     """Per-segment latency attribution across every rooted request
     trace in the file: p50/p95/p99/total of each segment's exclusive
     time, each request's segments summing to its root span, and the
     same segments re-ranked over the TAIL (requests whose root duration
-    sits at/above the ``tail_q`` quantile) — the p99's blame list."""
+    sits at/above the ``tail_q`` quantile) — the p99's blame list.
+    ``tenant`` keeps only requests whose root span carries that
+    ``tenant=`` attribution (tenancy front traffic): "whose p99" is
+    one flag."""
     forest = _trace_forest(data)
     per_request: list[tuple[float, dict]] = []
     seg_components: dict[str, set] = defaultdict(set)
     unrooted = 0
+    other_tenant = 0
     max_sum_err = 0.0
     for tid, spans in forest.items():
         out = _request_segments(spans)
@@ -260,6 +270,9 @@ def critical_path_report(data: dict, tail_q: float = 0.95) -> dict:
             unrooted += 1
             continue
         segments, meta = out
+        if tenant is not None and meta["tenant"] != tenant:
+            other_tenant += 1
+            continue
         max_sum_err = max(
             max_sum_err, abs(sum(segments.values()) - meta["root_ms"])
         )
@@ -269,6 +282,8 @@ def critical_path_report(data: dict, tail_q: float = 0.95) -> dict:
     report: dict = {
         "n_requests": len(per_request),
         "unrooted_traces": unrooted,
+        "tenant": tenant,
+        "other_tenant_requests": other_tenant,
         "max_segment_sum_error_ms": round(max_sum_err, 6),
         "segments": {},
         "tail": {},
@@ -321,9 +336,15 @@ def critical_path_report(data: dict, tail_q: float = 0.95) -> dict:
 
 
 def print_critical_path(report: dict) -> None:
-    print(f"requests: {report['n_requests']} rooted"
-          + (f" ({report['unrooted_traces']} unrooted traces skipped)"
-             if report["unrooted_traces"] else ""))
+    tenant = report.get("tenant")
+    scope = f" for tenant {tenant!r}" if tenant is not None else ""
+    skipped = []
+    if report["unrooted_traces"]:
+        skipped.append(f"{report['unrooted_traces']} unrooted")
+    if report.get("other_tenant_requests"):
+        skipped.append(f"{report['other_tenant_requests']} other-tenant")
+    print(f"requests: {report['n_requests']} rooted{scope}"
+          + (f" ({', '.join(skipped)} skipped)" if skipped else ""))
     if not report["segments"]:
         print("no rooted request span trees found")
         return
@@ -452,6 +473,10 @@ def main(argv=None) -> int:
                     help="decompose each rooted request trace into "
                          "exclusive-time segments (sum == root span) and "
                          "rank the tail's blame per segment")
+    ap.add_argument("--tenant", default=None,
+                    help="with --critical-path: keep only requests whose "
+                         "root span carries this tenant= attribution "
+                         "(tenancy front traffic)")
     ap.add_argument("--compare", nargs=2, metavar=("A", "B"), default=None,
                     help="diff two trace files per phase (p50/p95/p99 "
                          "deltas A -> B); with --critical-path, per "
@@ -459,14 +484,16 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     if (args.trace is None) == (args.compare is None):
         ap.error("pass one trace file, or --compare A.json B.json")
+    if args.tenant is not None and not args.critical_path:
+        ap.error("--tenant requires --critical-path")
     try:
         if args.compare is not None:
             path_a, path_b = args.compare
             data_a, data_b = load_trace(path_a), load_trace(path_b)
             if args.critical_path:
                 cmp = compare_critical_paths(
-                    critical_path_report(data_a),
-                    critical_path_report(data_b),
+                    critical_path_report(data_a, tenant=args.tenant),
+                    critical_path_report(data_b, tenant=args.tenant),
                 )
                 cmp = {"phases": cmp["segments"],
                        "only_in_a": cmp["only_in_a"],
@@ -487,7 +514,7 @@ def main(argv=None) -> int:
         print(f"trace_report: {e}", file=sys.stderr)
         return 1
     if args.critical_path:
-        report = critical_path_report(data)
+        report = critical_path_report(data, tenant=args.tenant)
         if args.json:
             json.dump(report, sys.stdout, indent=2)
             print()

@@ -67,6 +67,11 @@ LINEAGE_KEYS = {"backend", "submitted", "completed", "traces_checked",
                 "max_segment_sum_error_ms", "segments", "wire_trace_ok",
                 "recompilations", "trace_path", "ok"}
 QUANT_KEYS = {"backend", "churn", "pool_hlo", "recompilations", "ok"}
+TENANCY_KEYS = {"backend", "submitted", "completed", "shed", "failed",
+                "lost", "recompilations", "version_mixing",
+                "shadow_surfaced", "wrong_arm", "shadow_mirrored",
+                "shadow_errors", "exp_records", "ledger_identity",
+                "tenants", "ok"}
 PIPELINE_KEYS = {"backend", "records_appended", "records_lost",
                  "records_duplicated", "sigkills", "steps_trained",
                  "published_steps", "loss_parity_max_err",
@@ -126,7 +131,7 @@ def test_check_scripts_keep_their_cli():
                    "check_catalog_hlo", "check_fleet", "check_disagg",
                    "check_crosshost", "check_chaosnet", "check_spec_hlo",
                    "check_lineage", "check_obs", "check_quant_hlo",
-                   "check_pipeline"):
+                   "check_pipeline", "check_tenancy"):
         proc = subprocess.run(
             [sys.executable, os.path.join(REPO, "scripts", f"{script}.py"),
              "--help"],
@@ -140,16 +145,18 @@ def test_check_scripts_keep_their_cli():
 def test_ci_checks_smoke_entrypoint():
     """The consolidated entrypoint runs every smoke check and exits 0
     (rc=2 inconclusives tolerated, real failures propagated)."""
-    # The chaos-unit, obs, graftlint, catalog, quant, chaosnet and
-    # pipeline subsets are skipped here: this test runs INSIDE the suite
-    # that already executes tests/test_fault_tolerance.py,
-    # tests/test_obs.py, tests/test_analysis.py, tests/test_catalog.py,
-    # tests/test_quantized.py, tests/test_chaosnet.py and
-    # tests/test_pipeline.py directly, and nesting them would double-pay
-    # their cold-start (~30s-4min each) for no coverage
-    # (check_quant_hlo's, check_chaosnet's and check_pipeline's verdict
-    # schemas are pinned by the slow-marked tests below). The
-    # (jax-free, sub-second) bench_gate self-test stays.
+    # The chaos-unit, obs, graftlint, catalog, quant, chaosnet,
+    # pipeline and tenancy subsets are skipped here: this test runs
+    # INSIDE the suite that already executes
+    # tests/test_fault_tolerance.py, tests/test_obs.py,
+    # tests/test_analysis.py, tests/test_catalog.py,
+    # tests/test_quantized.py, tests/test_chaosnet.py,
+    # tests/test_pipeline.py and tests/test_tenancy.py directly, and
+    # nesting them would double-pay their cold-start (~30s-4min each)
+    # for no coverage (check_quant_hlo's, check_chaosnet's,
+    # check_pipeline's and check_tenancy's verdict schemas are pinned
+    # by the slow-marked tests below). The (jax-free, sub-second)
+    # bench_gate self-test stays.
     proc = subprocess.run(
         ["bash", os.path.join(REPO, "scripts", "ci_checks.sh"), "--smoke"],
         capture_output=True, text=True, timeout=900,
@@ -158,13 +165,14 @@ def test_ci_checks_smoke_entrypoint():
              "GENREC_CI_SKIP_LINT": "1", "GENREC_CI_SKIP_CATALOG": "1",
              "GENREC_CI_SKIP_QUANT": "1",
              "GENREC_CI_SKIP_CHAOSNET": "1",
-             "GENREC_CI_SKIP_PIPELINE": "1"},
+             "GENREC_CI_SKIP_PIPELINE": "1",
+             "GENREC_CI_SKIP_TENANCY": "1"},
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     # One verdict JSON per check on stdout (decode, fused-ce, packed,
     # serving, fleet, disagg, crosshost, spec, lineage, bench-gate
-    # self-test; the quant, chaosnet and pipeline checks are env-skipped
-    # above, so the unfiltered smoke emits three more).
+    # self-test; the quant, chaosnet, pipeline and tenancy checks are
+    # env-skipped above, so the unfiltered smoke emits four more).
     verdicts = [json.loads(l) for l in proc.stdout.splitlines() if l.strip()]
     assert len(verdicts) == 10
     lineage = [v for v in verdicts if "segment_sum_ok" in v]
@@ -262,6 +270,38 @@ def test_pipeline_check_small():
     assert verdict["pages_in_use_final"] == 0
     assert verdict["slots_active_final"] == 0
     assert 0.0 < verdict["freshness_s"] < 120.0
+
+
+@pytest.mark.slow
+def test_tenancy_check_small():
+    """check_tenancy's verdict schema + the isolation/experiment pins
+    (slow: it warms three engines — primary, arm-b, shadow — and
+    replays a multi-tenant burst trace with mid-trace catalog churn,
+    ~30s — the tier-1 suite covers the same machinery via
+    tests/test_tenancy.py; this pins the SMOKE CHECK's contract for
+    the shell entrypoint, which runs it unless GENREC_CI_SKIP_TENANCY
+    is set)."""
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "scripts", "check_tenancy.py"),
+         "--small", "--platform", "cpu"],
+        capture_output=True, text=True, timeout=900,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    verdict = json.loads(lines[-1])
+    assert set(verdict) == TENANCY_KEYS
+    assert verdict["lost"] == 0 and verdict["failed"] == 0
+    assert verdict["recompilations"] == 0
+    assert verdict["version_mixing"] == 0
+    assert verdict["shadow_surfaced"] == 0
+    assert verdict["wrong_arm"] == 0
+    assert verdict["shadow_mirrored"] > 0
+    assert verdict["shadow_errors"] == 0
+    assert verdict["exp_records"] > 0
+    assert verdict["ledger_identity"]
+    assert set(verdict["tenants"]) == {"acme", "globex"}
 
 
 @pytest.mark.slow

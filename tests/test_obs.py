@@ -726,6 +726,19 @@ def test_prometheus_text_exposition():
             "watcher_errors": 1, "last_good_step": 120, "canary_step": -1,
             "quarantined_steps": 1, "freshness_s": 0.42,
         },
+        # Multi-tenant front (genrec_tpu/tenancy/, TenantFront.stats()):
+        # per-tenant admission/shed/mirror and per-arm routing totals
+        # are counters; inflight depth, windowed p99, shed state, and
+        # the experiment split are gauges.
+        "tenancy": {
+            "acme": {"submitted": 31, "shed": 2, "shadow_mirrored": 29,
+                     "exp_arm_a": 14, "exp_arm_b": 15, "inflight": 1,
+                     "p99_ms": 7.5, "shedding": False},
+        },
+        "experiments": {
+            "ranker-v2": {"split": 0.5, "routed_a": 14, "routed_b": 15,
+                          "shadow_errors": 0, "shadow_mismatches": 3},
+        },
     })
     lines = text.splitlines()
     assert "# TYPE genrec_completed counter" in lines
@@ -753,6 +766,19 @@ def test_prometheus_text_exposition():
     assert "# TYPE genrec_rollout_canary_step gauge" in lines
     assert "# TYPE genrec_rollout_quarantined_steps gauge" in lines
     assert "# TYPE genrec_rollout_freshness_s gauge" in lines
+    assert "# TYPE genrec_tenancy_acme_submitted counter" in lines
+    assert "# TYPE genrec_tenancy_acme_shed counter" in lines
+    assert "# TYPE genrec_tenancy_acme_shadow_mirrored counter" in lines
+    assert "# TYPE genrec_tenancy_acme_exp_arm_a counter" in lines
+    assert "# TYPE genrec_tenancy_acme_exp_arm_b counter" in lines
+    assert "# TYPE genrec_tenancy_acme_inflight gauge" in lines
+    assert "# TYPE genrec_tenancy_acme_p99_ms gauge" in lines
+    assert "# TYPE genrec_tenancy_acme_shedding gauge" in lines
+    assert "# TYPE genrec_experiments_ranker_v2_routed_a counter" in lines
+    assert "# TYPE genrec_experiments_ranker_v2_routed_b counter" in lines
+    assert "# TYPE genrec_experiments_ranker_v2_shadow_errors counter" in lines
+    assert "# TYPE genrec_experiments_ranker_v2_shadow_mismatches counter" in lines
+    assert "# TYPE genrec_experiments_ranker_v2_split gauge" in lines
 
 
 def test_trace_report_cli_summarizes(tmp_path, capsys):
@@ -924,6 +950,45 @@ def test_critical_path_segments_sum_to_root(tmp_path):
     # --compare --critical-path: identical files diff to zero
     cmp = trace_report.compare_critical_paths(rep, rep)
     assert cmp["segments"]["prefill"]["p50_ms_delta"] == 0.0
+
+
+def test_critical_path_tenant_filter(tmp_path, capsys):
+    """--critical-path --tenant <t>: root spans stamped with the
+    tenancy front's ``tenant=`` attribution slice the report to one
+    tenant's requests; everything else is counted, not mixed in."""
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+    import trace_report
+
+    tracer = SpanTracer(capacity=128)
+    for tenant, dur in (("acme", 0.050), ("acme", 0.030), ("globex", 0.200)):
+        tid = tracer.new_trace()
+        root = tracer.allocate_span_id()
+        tracer.record_span("queue_wait", tid, 0.0, dur / 2, parent_id=root,
+                           component="serving_engine")
+        tracer.record_span("request", tid, 0.0, dur, span_id=root,
+                           component="tenant_front", tenant=tenant)
+    # One untenanted trace rides along (plain engine traffic).
+    tid = tracer.new_trace()
+    root = tracer.allocate_span_id()
+    tracer.record_span("request", tid, 0.0, 0.005, span_id=root,
+                       component="serving_engine")
+    path = tracer.dump(str(tmp_path / "tenants.json"))
+    data = trace_report.load_trace(path)
+    rep_all = trace_report.critical_path_report(data)
+    assert rep_all["n_requests"] == 4
+    rep = trace_report.critical_path_report(data, tenant="acme")
+    assert rep["n_requests"] == 2 and rep["other_tenant_requests"] == 2
+    assert rep["tenant"] == "acme"
+    # globex's 200ms request is OUT of acme's percentiles.
+    assert rep["root_ms"]["p99"] == pytest.approx(50.0, abs=1e-3)
+    # CLI: the flag wires through; --tenant without --critical-path errors.
+    assert trace_report.main([path, "--critical-path", "--tenant", "acme"]) == 0
+    out = capsys.readouterr().out
+    assert "2 rooted for tenant 'acme'" in out
+    with pytest.raises(SystemExit):
+        trace_report.main([path, "--tenant", "acme"])
+    capsys.readouterr()
 
 
 def test_log_serving_stats_hbm_line_per_head():

@@ -1,0 +1,195 @@
+"""LCRec (generative, trie-constrained beam) and NoteLLM (retrieval,
+last_hidden -> item_topk) serving heads: offline-parity, catalog-swap
+conformance, and the zero-steady-state-recompile pin on the AOT ladder.
+
+Uses its own tiny-Qwen fixtures (tests/test_lcrec.py is wholly
+slow-marked; tests/test_notellm.py's fixture shape reused here) so the
+fast tier exercises both heads end-to-end through the engine.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from genrec_tpu.catalog import CatalogSnapshot
+from genrec_tpu.models.backbones.qwen import QwenConfig, QwenLM
+from genrec_tpu.models.lcrec import extend_vocab, generate_topk_constrained
+from genrec_tpu.models.notellm import add_emb_token, query2embedding_forward
+from genrec_tpu.serving import (
+    BucketLadder,
+    LCRecGenerativeHead,
+    NoteLLMRetrievalHead,
+    Request,
+    ServingEngine,
+)
+
+C, K = 3, 8
+N_ITEMS = 12
+
+
+@pytest.fixture(scope="module")
+def qwen():
+    cfg = QwenConfig(vocab_size=40, hidden_size=32, intermediate_size=64,
+                     num_hidden_layers=2, num_attention_heads=4,
+                     num_key_value_heads=2, max_position_embeddings=64,
+                     rope_theta=10000.0, tie_word_embeddings=False)
+    model = QwenLM(cfg)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 4), jnp.int32))["params"]
+    return cfg, model, params
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    rng = np.random.default_rng(0)
+    valid = np.unique(rng.integers(0, K, (20, C)), axis=0)
+    vecs = rng.standard_normal((N_ITEMS, 32)).astype(np.float32)
+    nl_sem = rng.integers(0, 8, (N_ITEMS, 2))
+    return valid, vecs, nl_sem
+
+
+@pytest.fixture(scope="module")
+def served(qwen, corpus):
+    """One engine serving both heads; module-scoped so the ladder warms
+    once for the whole file."""
+    cfg, model, params = qwen
+    valid, vecs, nl_sem = corpus
+    lc_cfg, lc_params, base = extend_vocab(cfg, params, C, K, jax.random.key(1))
+    nl_cfg, nl_params, emb_id = add_emb_token(cfg, params, jax.random.key(2))
+    lc_head = LCRecGenerativeHead(QwenLM(lc_cfg), base, C, K,
+                                  item_sem_ids=valid, top_k=4, name="lcrec")
+    nl_head = NoteLLMRetrievalHead(QwenLM(nl_cfg), emb_id, item_sem_ids=nl_sem,
+                                   item_vecs=vecs, top_k=5, name="notellm")
+    eng = ServingEngine(
+        heads=[lc_head, nl_head],
+        params={"lcrec": lc_params, "notellm": nl_params},
+        ladder=BucketLadder((1, 2), (4,)), max_batch=2, max_wait_ms=1.0,
+        handle_signals=False,
+    )
+    eng.start()
+    yield eng, lc_head, nl_head, (lc_params, nl_params, base, emb_id)
+    eng.stop()
+
+
+def _wait_version(eng, head, version, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while eng.catalog_version(head) != version:
+        assert time.monotonic() < deadline, "catalog swap never applied"
+        time.sleep(0.02)
+
+
+def test_lcrec_served_matches_offline_constrained_beam(served):
+    eng, lc_head, _nl, (lc_params, _np, base, _e) = served
+    req = Request(head="lcrec", history=np.array([1, 3, 5]))
+    r = eng.submit(req).result(30)
+    # Trie constraint: every returned tuple is IN the corpus (non -1
+    # items), ranked by beam log-prob.
+    assert (r.items >= 0).all()
+    assert r.sem_ids.shape == (4, C)
+    corpus_set = {tuple(row) for row in lc_head.item_sem_ids}
+    assert all(tuple(row) in corpus_set for row in r.sem_ids)
+    # Bit-parity with the offline constrained beam on the same bucket.
+    ids, mask = lc_head.make_batch([req], 1, 4)
+    out = generate_topk_constrained(
+        lc_head.model, lc_params, ids, mask, base, C, K, beam_width=4,
+        max_cache=4 * C + C, trie=lc_head.catalog.device_trie(),
+    )
+    np.testing.assert_array_equal(np.asarray(out.sem_ids[0]), r.sem_ids)
+    np.testing.assert_allclose(np.asarray(out.log_probas[0]), r.scores,
+                               atol=1e-5)
+
+
+def test_notellm_served_matches_offline_embedding_topk(served):
+    eng, _lc, nl_head, (_lp, nl_params, _b, _e) = served
+    req = Request(head="notellm", history=np.array([4, 9, 2, 7]))
+    r = eng.submit(req).result(30)
+    assert (r.items >= 0).all() and (r.items < N_ITEMS).all()
+    # Offline: [EMB]-position embedding against the raw item vectors.
+    ids, mask, emb_idx = nl_head.make_batch([req], 1, 4)
+    emb = query2embedding_forward(
+        nl_head.model, nl_params, ids, mask, emb_idx,
+        tau=jnp.float32(0.0), return_loss=False,
+    ).sentence_embedding
+    scores = np.asarray(emb @ nl_head.catalog.item_vecs.T)[0]
+    top = np.argsort(-scores)[:5]
+    assert {int(x) for x in r.items} == {int(x) for x in top}
+    np.testing.assert_allclose(np.sort(r.scores)[::-1], np.sort(scores[top])[::-1],
+                               atol=1e-5)
+
+
+def test_catalog_swaps_same_rung_zero_recompiles(served, rng):
+    eng, lc_head, nl_head, _ = served
+    pre = eng.stats()["recompilations"]
+    # LCRec: new corpus at the same trie capacity rung.
+    valid2 = np.unique(rng.integers(0, K, (25, C)), axis=0)
+    snap_lc = CatalogSnapshot.build(valid2, K)
+    assert eng.stage_catalog("lcrec", snap_lc)
+    # NoteLLM: refreshed vectors at the same bank rung.
+    vecs2 = rng.standard_normal((N_ITEMS, 32)).astype(np.float32)
+    snap_nl = CatalogSnapshot.build(nl_head.catalog.item_sem_ids, 8,
+                                    item_vecs=vecs2)
+    assert eng.stage_catalog("notellm", snap_nl)
+    _wait_version(eng, "lcrec", snap_lc.version)
+    _wait_version(eng, "notellm", snap_nl.version)
+    r_lc = eng.submit(Request(head="lcrec", history=np.array([0, 2]))).result(30)
+    r_nl = eng.submit(Request(head="notellm", history=np.array([1]))).result(30)
+    # Provenance names the swapped-in versions; the swap recompiled
+    # NOTHING (same avals -> same executables).
+    assert r_lc.catalog_version == snap_lc.version
+    assert r_nl.catalog_version == snap_nl.version
+    assert eng.stats()["recompilations"] == pre == 0
+    # The new LCRec corpus constrains the beam (parity with new trie).
+    corpus2 = {tuple(row) for row in valid2}
+    assert all(tuple(row) in corpus2 for row in r_lc.sem_ids)
+
+
+def test_notellm_bank_rung_growth_precompiled_not_recompiled(served, rng):
+    eng, _lc, nl_head, _ = served
+    # 80 items crosses the 64-capacity rung -> stage precompiles the
+    # larger-bank executables; steady state still recompiles nothing.
+    big_n = 80
+    snap = CatalogSnapshot.build(rng.integers(0, 8, (big_n, 2)), 8,
+                                 item_vecs=rng.standard_normal(
+                                     (big_n, 32)).astype(np.float32))
+    pre_cc = eng.stats()["catalog_compiles"]
+    assert eng.stage_catalog("notellm", snap)
+    _wait_version(eng, "notellm", snap.version)
+    r = eng.submit(Request(head="notellm", history=np.array([6, 3]))).result(30)
+    assert (r.items >= 0).all() and (r.items < big_n).all()
+    assert eng.stats()["recompilations"] == 0
+    assert eng.stats()["catalog_compiles"] > pre_cc
+
+
+def test_lcrec_head_validation(qwen, corpus):
+    cfg, model, params = qwen
+    valid, _v, _s = corpus
+    lc_cfg, _p, base = extend_vocab(cfg, params, C, K, jax.random.key(1))
+    head = LCRecGenerativeHead(QwenLM(lc_cfg), base, C, K,
+                               item_sem_ids=valid, top_k=4)
+    # Snapshot depth/codebook mismatches are rejected at staging time.
+    with pytest.raises(ValueError):
+        head.validate_snapshot(CatalogSnapshot.build(valid[:, :2], K))
+    with pytest.raises(ValueError):
+        head.validate_snapshot(CatalogSnapshot.build(valid % 4, 4))
+    # The codebook region must fit inside the extended vocab.
+    with pytest.raises(ValueError):
+        LCRecGenerativeHead(QwenLM(lc_cfg), base, C, 10_000,
+                            item_sem_ids=valid)
+
+
+def test_notellm_head_validation(qwen, corpus):
+    cfg, model, params = qwen
+    _valid, vecs, nl_sem = corpus
+    nl_cfg, _p, emb_id = add_emb_token(cfg, params, jax.random.key(2))
+    head = NoteLLMRetrievalHead(QwenLM(nl_cfg), emb_id, item_sem_ids=nl_sem,
+                                item_vecs=vecs, top_k=5)
+    # A snapshot without item vectors cannot serve a retrieval bank.
+    with pytest.raises(ValueError):
+        head.validate_snapshot(CatalogSnapshot.build(nl_sem, 8))
+    # Vector dim must match the model's hidden size.
+    with pytest.raises(ValueError):
+        head.validate_snapshot(CatalogSnapshot.build(
+            nl_sem, 8, item_vecs=np.zeros((N_ITEMS, 16), np.float32)))

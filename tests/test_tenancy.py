@@ -1,0 +1,376 @@
+"""Multi-tenant serving plane (genrec_tpu/tenancy/): per-tenant
+isolation, deterministic A/B bucketing, shadow mirroring, ledger
+sub-totals.
+
+Engine-backed tests use the fleet fixture discipline (one history
+bucket, tiny SASRec retrieval head — 2 executables per engine) so the
+file stays inside the tier-1 budget; the churn-heavy tenancy e2e lives
+in scripts/check_tenancy.py.
+"""
+
+import dataclasses
+import hashlib
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from genrec_tpu.fleet import Burst, TenantTraffic, TraceConfig, generate_trace
+from genrec_tpu.models.sasrec import SASRec
+from genrec_tpu.obs.flight_recorder import get_flight_recorder
+from genrec_tpu.obs.spans import SpanTracer
+from genrec_tpu.serving import (
+    BucketLadder,
+    OverloadError,
+    Request,
+    ServingEngine,
+    SLOTarget,
+)
+from genrec_tpu.serving.heads import RetrievalHead
+from genrec_tpu.serving.metrics import ServingMetrics
+from genrec_tpu.tenancy import (
+    ARMS,
+    Experiment,
+    ExperimentConfig,
+    TenantConfig,
+    TenantFront,
+    bucket_arm,
+)
+
+N_ITEMS = 30
+
+
+@pytest.fixture(scope="module")
+def sas():
+    model = SASRec(num_items=N_ITEMS, max_seq_len=8, embed_dim=16,
+                   num_heads=2, num_blocks=1, ffn_dim=32, dropout=0.0)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((2, 8), jnp.int32))["params"]
+    return model, params
+
+
+def _engine(sas, heads=("alpha", "beta"), replica_id="r0", **kw):
+    model, params = sas
+    return ServingEngine(
+        [RetrievalHead(h, model, top_k=5) for h in heads],
+        {h: params for h in heads},
+        ladder=BucketLadder((1, 2), (8,)), max_batch=2,
+        max_wait_ms=1.0, handle_signals=False, replica_id=replica_id,
+        params_by_head=True, **kw,
+    )
+
+
+def _req(head, rng, user_id=None):
+    return Request(
+        head=head, history=rng.integers(1, N_ITEMS + 1, 5),
+        user_id=int(rng.integers(0, 1000)) if user_id is None else user_id,
+    )
+
+
+# ---- bucketing (pure, no engines) -------------------------------------------
+
+
+def test_bucket_arm_deterministic_split_exact_and_seed_sensitive():
+    # Restart-stable: the assignment is a pure sha256 of (seed, user) —
+    # recompute it from the spec and demand equality, then demand the
+    # split lands within binomial tolerance (4 sigma) of the target.
+    for seed, user in ((0, 0), (11, 7), (2**31, 10**9)):
+        digest = hashlib.sha256(f"{seed}:{user}".encode()).digest()
+        expect = "a" if int.from_bytes(digest[:8], "big") / 2.0**64 < 0.5 else "b"
+        assert bucket_arm(seed, user) == expect
+        assert bucket_arm(seed, user) == bucket_arm(seed, user)
+    n = 20_000
+    for split in (0.5, 0.3):
+        frac = sum(bucket_arm(42, u, split) == "a" for u in range(n)) / n
+        tol = 4.0 * (split * (1 - split) / n) ** 0.5
+        assert abs(frac - split) < tol, (split, frac)
+    # Different seeds shuffle users across arms (not a constant map).
+    diff = sum(bucket_arm(1, u) != bucket_arm(2, u) for u in range(1000))
+    assert diff > 300
+    # Split edges are total: 0 -> all "b", 1 -> all "a".
+    assert all(bucket_arm(5, u, 0.0) == "b" for u in range(50))
+    assert all(bucket_arm(5, u, 1.0) == "a" for u in range(50))
+
+
+def test_experiment_validation_and_report_roundtrip(tmp_path):
+    with pytest.raises(ValueError):
+        ExperimentConfig(name="x", seed=0, split=1.5)
+
+    class _T:
+        def submit(self, req):  # pragma: no cover - never called here
+            raise AssertionError
+
+    with pytest.raises(ValueError):
+        Experiment(ExperimentConfig(name="x", seed=0), {"a": _T()})
+    path = tmp_path / "exp_report.json"
+    exp = Experiment(
+        ExperimentConfig(name="x", seed=3, report_path=str(path)),
+        {a: _T() for a in ARMS},
+    )
+    prim = type("R", (), {"request_id": "p1", "params_step": 5,
+                          "catalog_version": "v1", "replica_id": "a",
+                          "items": np.array([1, 2])})()
+    shad = type("R", (), {"request_id": "s1", "params_step": 9,
+                          "catalog_version": "v2", "replica_id": "sh",
+                          "items": np.array([1, 3])})()
+    exp.record_pair(7, "a", prim, shadow_resp=shad)
+    exp.record_pair(8, "b", prim, shadow_error="OverloadError('x')")
+    data = exp.conclude()
+    on_disk = json.loads(path.read_text())
+    assert on_disk == data
+    assert data["summary"]["shadow_mirrored"] == 1
+    assert data["summary"]["shadow_errors"] == 1
+    assert data["summary"]["shadow_mismatches"] == 1  # [1,2] vs [1,3]
+    rec = data["records"][0]
+    assert rec["primary"]["params_step"] == 5
+    assert rec["shadow"]["catalog_version"] == "v2"
+    assert rec["items_match"] is False
+
+
+# ---- metrics tenant rings (pure) --------------------------------------------
+
+
+def test_metrics_tenant_ring_precedence():
+    m = ServingMetrics()
+    for _ in range(25):
+        m.record_response(0.0, 0.0, 0.010, head="alpha")
+        m.record_tenant_response("acme", 0.050)
+    pooled = m.recent_p99_ms(60.0)
+    tenant = m.recent_p99_ms(60.0, tenant="acme")
+    assert tenant is not None and pooled is not None
+    assert tenant > pooled  # the tenant ring, not the pooled one
+    assert m.recent_p99_ms(60.0, tenant="nobody") is None
+
+
+# ---- tenant front (engine-backed) -------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def front_setup(sas):
+    eng = _engine(sas)
+    eng.start()
+    tracer = SpanTracer()
+    eng.set_tracer(tracer)
+    front = TenantFront(eng, tenants=[
+        TenantConfig(name="acme", head="alpha", hbm_budget_bytes=1 << 30),
+        TenantConfig(name="globex", head="beta", max_inflight=256),
+    ], tracer=tracer)
+    yield eng, front, tracer
+    front.stop()
+    eng.stop()
+
+
+def test_front_binding_and_passthrough(front_setup, rng):
+    eng, front, _ = front_setup
+    with pytest.raises(ValueError):
+        front.add_tenant(TenantConfig(name="acme2", head="alpha"))
+    with pytest.raises(ValueError):
+        front.add_tenant(TenantConfig(name="acme", head="nohead"))
+    assert front.tenants() == ["acme", "globex"]
+    assert front.tenant_of("alpha") == "acme"
+    r = front.submit(_req("alpha", rng)).result(30)
+    assert len(r.items) == 5
+    s = front.stats()["tenancy"]
+    assert s["acme"]["submitted"] >= 1
+    # Unbound head -> engine error surface unchanged (pass-through).
+    from genrec_tpu.serving import UnknownHeadError
+    with pytest.raises(UnknownHeadError):
+        front.submit(_req("nothead", rng))
+
+
+def test_front_root_span_carries_tenant(front_setup, rng):
+    eng, front, tracer = front_setup
+    fut = front.submit(_req("beta", rng, user_id=5))
+    fut.result(30)
+    time.sleep(0.1)
+    roots = [s for s in tracer.spans()
+             if s.name == "request" and s.attrs.get("tenant") == "globex"]
+    assert roots, "front-minted root request span must carry tenant="
+    assert roots[-1].attrs["component"] == "tenant_front"
+    assert roots[-1].attrs["outcome"] == "ok"
+
+
+def test_front_inflight_bound_sheds_typed_while_other_tenant_serves(sas, rng):
+    eng = _engine(sas, replica_id="shed_eng")
+    eng.start()
+    front = TenantFront(eng, tenants=[
+        TenantConfig(name="hot", head="alpha", max_inflight=1),
+        TenantConfig(name="calm", head="beta"),
+    ])
+    try:
+        first = front.submit(_req("alpha", rng, user_id=1))
+        shed = None
+        try:
+            front.submit(_req("alpha", rng, user_id=2))
+        except OverloadError as e:
+            shed = str(e)
+        # The bound tenant shed typed — naming the TENANT — while the
+        # co-hosted tenant's traffic flows untouched.
+        assert shed is not None and "hot" in shed
+        calm = front.submit(_req("beta", rng, user_id=3)).result(30)
+        assert len(calm.items) == 5
+        first.result(30)
+        time.sleep(0.05)
+        s = front.stats()["tenancy"]
+        assert s["hot"]["shed"] == 1
+        assert s["calm"]["shed"] == 0 and s["calm"]["completed"] == 1
+        # Inflight accounting drains back to zero.
+        assert s["hot"]["inflight"] == 0
+    finally:
+        front.stop()
+        eng.stop()
+
+
+def test_front_slo_shed_transition_fires_flight_events(sas, rng):
+    eng = _engine(sas, heads=("alpha",), replica_id="slo_eng")
+    eng.start()
+    front = TenantFront(eng, tenants=[
+        TenantConfig(name="t0", head="alpha",
+                     slo=SLOTarget(p99_ms=10_000.0, max_queue_depth=2,
+                                   breach_s=0.0, recover_s=3600.0)),
+    ], slo_poll_s=0.0)
+    fr = get_flight_recorder()
+    try:
+        # Open-loop burst: with max_queue_depth=2, breach_s=0 and a poll
+        # on every submit, in-flight depth crossing 2 trips the front's
+        # monitor and the NEXT submit sheds typed, naming the tenant.
+        futs, shed_msg = [], None
+        for uid in range(40):
+            try:
+                futs.append(front.submit(_req("alpha", rng, user_id=uid)))
+            except OverloadError as e:
+                shed_msg = str(e)
+        for f in futs:
+            f.result(30)
+        assert shed_msg is not None and "t0" in shed_msg
+        events = [e for e in fr.events("tenant_shed_started")
+                  if e.get("tenant") == "t0"]
+        assert events, "shed transition must land in the flight ring"
+        # recover_s is an hour: the shed state latches for stats().
+        assert front.stats()["tenancy"]["t0"]["shedding"] is True
+        assert front.stats()["tenancy"]["t0"]["shed"] >= 1
+    finally:
+        front.stop()
+        eng.stop()
+
+
+def test_front_ab_routing_exact_and_shadow_never_surfaces(sas, rng, tmp_path):
+    eng_a = _engine(sas, heads=("alpha",), replica_id="arm_a")
+    eng_b = _engine(sas, heads=("alpha",), replica_id="arm_b")
+    eng_sh = _engine(sas, heads=("alpha",), replica_id="shadow")
+    for e in (eng_a, eng_b, eng_sh):
+        e.start()
+    front = TenantFront(eng_a, tenants=[TenantConfig(name="acme", head="alpha")])
+    report_path = tmp_path / "exp_report.json"
+    cfg = ExperimentConfig(name="ranker-v2", seed=17, split=0.5,
+                           report_path=str(report_path))
+    exp = front.start_experiment("acme", cfg, arms={"a": eng_a, "b": eng_b},
+                                 shadow=eng_sh)
+    with pytest.raises(ValueError):  # one experiment per tenant
+        front.start_experiment("acme", cfg, arms={"a": eng_a, "b": eng_b})
+    n = 30
+    futs = {uid: front.submit(_req("alpha", rng, user_id=uid))
+            for uid in range(n)}
+    try:
+        for uid, fut in futs.items():
+            resp = fut.result(30)
+            # THE isolation property: the caller's response came from
+            # the deterministically bucketed arm — never the shadow.
+            assert resp.replica_id == f"arm_{bucket_arm(17, uid, 0.5)}"
+        deadline = time.monotonic() + 30
+        while True:
+            snap = exp.snapshot()
+            if snap["shadow_mirrored"] + snap["shadow_errors"] >= n:
+                break
+            assert time.monotonic() < deadline, snap
+            time.sleep(0.02)
+        # Split exactness: routed counts equal the pure function's, not
+        # approximately but exactly (same seed, same users).
+        want_a = sum(1 for u in range(n) if bucket_arm(17, u, 0.5) == "a")
+        assert snap["routed_a"] == want_a
+        assert snap["routed_b"] == n - want_a
+        assert snap["shadow_errors"] == 0
+        data = front.conclude_experiment("acme")
+        assert data["n_records"] == n
+        for rec in data["records"]:
+            # Provenance on both sides of every pair; the shadow's
+            # provenance proves it RAN (replica "shadow") while the
+            # caller-visible side never names it.
+            assert rec["primary"]["replica_id"] == f"arm_{rec['arm']}"
+            assert rec["shadow"]["replica_id"] == "shadow"
+            assert isinstance(rec["items_match"], bool)
+            assert len(rec["shadow"]["items"]) == 5
+        assert json.loads(report_path.read_text())["n_records"] == n
+        # Counters flowed into front stats too.
+        s = front.stats()["tenancy"]["acme"]
+        assert s["exp_arm_a"] == want_a and s["exp_arm_b"] == n - want_a
+        assert s["shadow_mirrored"] == n
+        with pytest.raises(ValueError):  # nothing left to conclude
+            front.conclude_experiment("acme")
+    finally:
+        front.stop()
+        for e in (eng_a, eng_b, eng_sh):
+            e.stop()
+
+
+def test_front_ledger_subtotals_sum_to_engine_total(front_setup, rng):
+    eng, front, _ = front_setup
+    led = front.ledger()
+    assert set(led["tenants"]) == {"acme", "globex"}
+    tenant_ops = sum(t["operand_bytes"] for t in led["tenants"].values())
+    assert (tenant_ops + led["unassigned_operand_bytes"]
+            + led["transient_peak_bytes"]) == led["total_bytes"]
+    assert led["total_bytes"] == eng.memory.summary()["total_bytes"]
+    acme = led["tenants"]["acme"]
+    assert acme["budget_bytes"] == 1 << 30
+    assert acme["over_budget"] is False
+    # A sub-budget below the group's footprint flags over_budget.
+    tight = TenantFront(eng, tenants=[
+        TenantConfig(name="tight", head="alpha", hbm_budget_bytes=1),
+    ])
+    assert tight.ledger()["tenants"]["tight"]["over_budget"] is True
+
+
+# ---- multi-tenant traffic mixes (no engines) --------------------------------
+
+
+def test_tenant_trace_deterministic_and_base_schedule_unperturbed():
+    base = TraceConfig(
+        n_requests=96, n_users=1000, max_items=6, corpus_size=N_ITEMS,
+        head="alpha", item_lo=1, seed=7, base_rate_qps=60.0,
+        bursts=(Burst(0.4, 0.5, 5.0),),
+    )
+    mixed = dataclasses.replace(base, tenants=(
+        TenantTraffic("victim", "alpha", rate_share=1.0),
+        TenantTraffic("aggr", "beta", rate_share=1.0, burst_mult=6.0,
+                      n_users=100),
+    ))
+    a, b = generate_trace(mixed), generate_trace(mixed)
+    assert (a.schedule() == b.schedule()).all()
+    assert [x.tenant for x in a.arrivals] == [x.tenant for x in b.arrivals]
+    # Adding tenants must not perturb the base stream: the arrival
+    # schedule is bit-identical to the tenant-free config's.
+    assert (a.schedule() == generate_trace(base).schedule()).all()
+    # Tenant user spaces are namespaced and bounded.
+    for x in a.arrivals:
+        if x.tenant == "aggr":
+            assert 1000 <= x.user_id < 1100 and x.head == "beta"
+        else:
+            assert x.user_id < 1000 and x.head == "alpha"
+    # The burst knob concentrates the aggressor inside burst windows.
+    def share(pred):
+        hit = [x for x in a.arrivals if pred(x)]
+        return (sum(1 for x in hit if x.tenant == "aggr") / len(hit)
+                if hit else 0.0)
+    assert share(lambda x: x.in_burst) > share(lambda x: not x.in_burst)
+    # requests() routes each arrival to its tenant's head.
+    heads = {r.head for r in a.requests()}
+    assert heads == {"alpha", "beta"}
+    with pytest.raises(ValueError):
+        dataclasses.replace(base, tenants=(
+            TenantTraffic("dup", "alpha"), TenantTraffic("dup", "beta")))
+    with pytest.raises(ValueError):
+        TenantTraffic("x", "alpha", rate_share=0.0)
